@@ -1,0 +1,44 @@
+"""distributed_learning_tpu — a TPU-native decentralized-learning framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the reference
+``Malkovsky/distributed-learning`` repository: decentralized consensus
+(gossip) optimization — N agents, each holding a data shard and a local model
+replica, run local (sub)gradient steps and repeatedly average parameters with
+graph neighbors, with edge weights optionally optimized for fastest mixing.
+
+Where the reference runs agents as asyncio tasks or TCP processes exchanging
+pickled values, this framework compiles the whole gossip round into a single
+SPMD program over a ``jax.sharding.Mesh``: neighbor exchange is
+``jax.lax.ppermute`` over the ICI interconnect, mixing weights are baked into
+a precompiled matching schedule, and the local-SGD + gossip loop is jitted
+end to end.
+
+Subpackages
+-----------
+``parallel``  topology, fastest-mixing weights, mixing schedules, consensus
+              engines (single-device vmap and multi-device shard_map), mesh
+              helpers, multi-host init.
+``ops``       jitted mixing/residual primitives operating on pytrees.
+``models``    logreg / MLP / LeNet / VGG / ResNet / WideResNet (flax linen).
+``data``      Titanic and CIFAR pipelines with per-agent sharding.
+``training``  gossip-SGD trainer (the reference's documented ``MasterNode``
+              surface), checkpointing, telemetry.
+``utils``     logging, metrics, tree utilities.
+"""
+
+from distributed_learning_tpu.parallel.topology import Topology, gamma, spectral_gap
+from distributed_learning_tpu.parallel.fast_averaging import (
+    find_optimal_weights,
+    solve_fastest_mixing,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Topology",
+    "gamma",
+    "spectral_gap",
+    "find_optimal_weights",
+    "solve_fastest_mixing",
+    "__version__",
+]

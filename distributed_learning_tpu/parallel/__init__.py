@@ -1,0 +1,23 @@
+"""Topology, mixing weights/schedules, and consensus engines."""
+
+from distributed_learning_tpu.parallel.topology import (
+    Topology,
+    gamma,
+    spectral_gap,
+    is_connected,
+)
+from distributed_learning_tpu.parallel.fast_averaging import (
+    find_optimal_weights,
+    solve_fastest_mixing,
+    FastAveragingResult,
+)
+
+__all__ = [
+    "Topology",
+    "gamma",
+    "spectral_gap",
+    "is_connected",
+    "find_optimal_weights",
+    "solve_fastest_mixing",
+    "FastAveragingResult",
+]

@@ -1,0 +1,17 @@
+"""Test configuration: run JAX on 8 virtual CPU devices.
+
+This is the TPU-framework analogue of the reference's asyncio fake-network
+fixture (``utils/consensus_asyncio.py``): N logical agents, the real SPMD
+protocol, one process, no hardware.  Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep CPU tests deterministic and fast.
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,70 @@
+"""Golden-value tests for the native fastest-mixing solver.
+
+Golden numbers are recorded outputs of the reference's cvxpy SDP
+(``Fast Averaging.ipynb`` cells 2-9; see BASELINE.md).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.parallel import (
+    Topology,
+    find_optimal_weights,
+    solve_fastest_mixing,
+    gamma,
+)
+
+
+def test_golden_five_edge_example():
+    # Reference cell 2: weights (1/3, 1/3, 1/2, 1/3, 1/3), gamma = 2/3.
+    edges = [(0, 1), (0, 2), (0, 3), (1, 4), (4, 2)]
+    w, g = find_optimal_weights(edges)
+    assert g == pytest.approx(2.0 / 3.0, abs=5e-3)
+    np.testing.assert_allclose(
+        w, [1 / 3, 1 / 3, 1 / 2, 1 / 3, 1 / 3], atol=2e-2
+    )
+
+
+def test_complete_graph_exact_averaging():
+    # K4 optimum: every edge weight 1/4, W = J/4, gamma = 0.
+    w, g = find_optimal_weights(list(Topology.complete(4).edges))
+    assert g == pytest.approx(0.0, abs=5e-3)
+    np.testing.assert_allclose(w, 0.25, atol=2e-2)
+
+
+def test_realized_matrix_is_valid_and_beats_metropolis():
+    for topo in [Topology.ring(6), Topology.grid2d(2, 3), Topology.star(5)]:
+        W, g = solve_fastest_mixing(topo)
+        # Doubly stochastic by construction; gamma strictly better than (or
+        # equal to) the Metropolis heuristic.
+        np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-8)
+        np.testing.assert_allclose(W, W.T, atol=1e-8)
+        g_metro = gamma(topo.metropolis_weights())
+        assert g <= g_metro + 1e-3
+        assert g < 1.0
+
+
+def test_laplacian_psd_at_solution():
+    topo = Topology.watts_strogatz(12, 4, 0.5, seed=3)
+    weights, _ = find_optimal_weights(list(topo.edges))
+    L = topo.incidence() @ np.diag(weights) @ topo.incidence().T
+    mu = np.linalg.eigvalsh(L)
+    assert mu[0] >= -1e-6
+
+
+def test_weights_align_with_input_edge_order_and_self_loops():
+    # Self-loop columns exist in the reference's A matrix but carry no
+    # weight; duplicates collapse onto the first occurrence.
+    edges = [(0, 0), (0, 1), (1, 2), (0, 1)]
+    w, g = find_optimal_weights(edges)
+    assert len(w) == 4
+    assert w[0] == 0.0
+    assert w[3] == 0.0
+    assert g < 1.0
+
+
+def test_token_graphs_supported():
+    w, g = find_optimal_weights([("a", "b"), ("b", "c"), ("c", "a")])
+    # Triangle optimum: W = J/3 via w = 1/3 each, gamma = 0.
+    assert g == pytest.approx(0.0, abs=5e-3)
+    np.testing.assert_allclose(w, 1 / 3, atol=2e-2)

@@ -1,0 +1,123 @@
+"""Tier-1 unit tests for topology analytics.
+
+Derived from the reference's analytical notebook checks
+(``Fast Averaging.ipynb``, ``wiki/consensus_basics.ipynb``) and the spectral
+code in ``consensus_asyncio.py:59-86``.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.parallel import Topology, gamma, is_connected
+
+
+def test_from_edges_first_seen_token_order():
+    t = Topology.from_edges([("b", "a"), ("a", "c")])
+    assert t.tokens == ("b", "a", "c")
+    assert t.n_agents == 3
+    assert t.n_edges == 2
+
+
+def test_self_loops_and_duplicates_dropped():
+    t = Topology.from_edges([(0, 1), (1, 0), (0, 0), (0, 1)])
+    assert t.edges == ((0, 1),)
+
+
+def test_ring_structure():
+    t = Topology.ring(5)
+    assert t.n_agents == 5
+    assert t.n_edges == 5
+    assert all(len(t.neighbors(i)) == 2 for i in range(5))
+    assert t.connected()
+
+
+def test_laplacian_ring4_known_eigenvalues():
+    # C4 Laplacian eigenvalues are {0, 2, 2, 4}.
+    t = Topology.ring(4)
+    eig = t.laplacian_eigenvalues()
+    np.testing.assert_allclose(eig, [0.0, 2.0, 2.0, 4.0], atol=1e-9)
+    assert t.algebraic_connectivity() == pytest.approx(2.0)
+
+
+def test_uniform_epsilon_reference_rule():
+    # Parity: eps = 0.95 / max_degree (consensus_asyncio.py:78-86).
+    t = Topology.star(5)  # center degree 4
+    assert t.uniform_epsilon() == pytest.approx(0.95 / 4)
+
+
+def test_perron_is_doubly_stochastic_and_contracts():
+    t = Topology.grid2d(2, 3)
+    P = t.perron()
+    np.testing.assert_allclose(P.sum(axis=0), 1.0, atol=1e-12)
+    np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-12)
+    assert gamma(P) < 1.0
+
+
+def test_metropolis_weights_doubly_stochastic_convergent():
+    for t in [Topology.ring(6), Topology.star(5), Topology.grid2d(3, 3),
+              Topology.hypercube(3)]:
+        W = t.metropolis_weights()
+        np.testing.assert_allclose(W, W.T, atol=1e-12)
+        np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+        assert gamma(W) < 1.0
+
+
+def test_mixing_matrix_from_edge_weights():
+    # Uniform edge weight w on K4 with w = 1/4 gives exact averaging W = J/4.
+    t = Topology.complete(4)
+    W = t.mixing_matrix([0.25] * t.n_edges)
+    np.testing.assert_allclose(W, np.full((4, 4), 0.25), atol=1e-12)
+    assert gamma(W) == pytest.approx(0.0, abs=1e-12)
+
+
+def test_convergence_speed_matches_perron_lambda2():
+    t = Topology.ring(6)
+    P = t.perron()
+    eigs = np.sort(np.linalg.eigvalsh(P))
+    assert t.convergence_speed() == pytest.approx(
+        max(abs(e) for e in eigs[:-1])
+    )
+
+
+def test_describe_contains_reference_fields():
+    s = Topology.ring(4).describe()
+    for key in ["Laplacian", "Algebraic connectivity", "Perron matrix",
+                "Convergence speed"]:
+        assert key in s
+
+
+def test_from_neighbor_dict_man_colab_format():
+    # Parity: Man_Colab.ipynb cell 14 topology dict.
+    topo = {
+        "Alice": {"Alice": 0.9, "Bob": 0.05, "Charlie": 0.05},
+        "Bob": {"Alice": 0.05, "Bob": 0.9, "Charlie": 0.05},
+        "Charlie": {"Alice": 0.05, "Bob": 0.05, "Charlie": 0.9},
+    }
+    t, W = Topology.from_neighbor_dict(topo)
+    assert t.tokens == ("Alice", "Bob", "Charlie")
+    assert t.n_edges == 3  # complete triangle
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+    np.testing.assert_allclose(np.diag(W), 0.9)
+    assert gamma(W) < 1.0
+
+
+def test_is_connected():
+    assert is_connected([(0, 1), (1, 2)], 3)
+    assert not is_connected([(0, 1)], 3)
+
+
+def test_graph_families_connected():
+    for t in [
+        Topology.chain(5),
+        Topology.torus2d(2, 4),
+        Topology.hypercube(3),
+        Topology.watts_strogatz(25, 6, 0.7, seed=1),
+        Topology.random_regular(3, 12, seed=1),
+        Topology.erdos_renyi(10, 0.3, seed=1),
+    ]:
+        assert t.connected()
+
+
+def test_random_regular_degree():
+    t = Topology.random_regular(3, 12, seed=2)
+    assert all(len(t.neighbors(i)) == 3 for i in range(12))
