@@ -1,0 +1,141 @@
+"""Jitted mixing and disagreement primitives on stacked parameter pytrees.
+
+State convention: per-agent values live in one pytree whose every leaf has a
+leading *agent* axis of size N ("stacked" layout).  On a single device this
+axis is a batch dimension and one gossip round is a single MXU matmul; over a
+device mesh the axis is sharded (one agent per device) and the same functions
+are applied under ``shard_map`` with ``ppermute`` doing the neighbor exchange
+(see ``parallel/consensus.py``).
+
+These primitives replace the reference's host-side numpy path
+(``utils/consensus_simple/mixer.py``): its flatten -> O(N^2 P) dense mixing ->
+unflatten round-trip (``mixer.py:43-49, 68-76``) becomes a device-resident
+``W @ x`` per leaf with no reshape churn, and its deviation metrics
+(``mixer.py:51-66, 78-84``) become jitted tree reductions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+__all__ = [
+    "stack_trees",
+    "unstack_tree",
+    "dense_mix",
+    "agent_deviations",
+    "max_deviation",
+    "max_std",
+    "weighted_lift",
+    "weighted_readout",
+]
+
+
+def stack_trees(trees: Sequence[Pytree]) -> Pytree:
+    """Stack N per-agent pytrees into one tree with a leading agent axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def unstack_tree(stacked: Pytree, n: int) -> List[Pytree]:
+    """Split the leading agent axis back into N per-agent pytrees."""
+    return [jax.tree.map(lambda x: x[i] if hasattr(x, "__getitem__") else x, stacked) for i in range(n)]
+
+
+def dense_mix(
+    stacked: Pytree,
+    W: jax.Array,
+    *,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+) -> Pytree:
+    """One gossip round on the whole stacked state: ``x_a <- sum_b W[a,b] x_b``.
+
+    The mixing math of ``mixer.py:43-49`` / ``consensus_asyncio.py:295`` as a
+    single batched matmul per leaf — on TPU this rides the MXU.  ``precision``
+    defaults to HIGHEST because consensus residuals are driven to ~1e-4 and
+    below, which bf16 matmul accumulation would floor.
+    """
+
+    def mix_leaf(x: jax.Array) -> jax.Array:
+        # Mix in float32 regardless of storage dtype (matches the sharded
+        # path); cast back so bf16/int leaves keep their layout.
+        xf = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        out = jnp.matmul(W.astype(jnp.float32), xf, precision=precision)
+        return out.reshape(x.shape).astype(x.dtype)
+
+    return jax.tree.map(mix_leaf, stacked)
+
+
+def _sq_dev_from_mean(stacked: Pytree) -> jax.Array:
+    """Per-agent squared L2 distance from the across-agent mean, summed over
+    every leaf (i.e. over the agent's whole flattened parameter vector)."""
+    leaves = jax.tree.leaves(stacked)
+    total = None
+    for x in leaves:
+        mean = x.mean(axis=0, keepdims=True)
+        d = (x - mean).astype(jnp.float32)
+        sq = jnp.sum(d * d, axis=tuple(range(1, d.ndim)))
+        total = sq if total is None else total + sq
+    return total
+
+
+def agent_deviations(stacked: Pytree) -> jax.Array:
+    """(N,) array: each agent's L2 distance from the mean parameter vector.
+
+    Parity: ``basic_deviation_metric`` + ``_get_deviation_dict``
+    (``mixer.py:5-6, 57-66``) — the norm is over the agent's *entire*
+    flattened parameter vector.
+    """
+    return jnp.sqrt(_sq_dev_from_mean(stacked))
+
+
+def max_deviation(stacked: Pytree) -> jax.Array:
+    """Scalar: max over agents of :func:`agent_deviations` — the residual the
+    eps-stopping rule compares against (``mixer.py:40-41, 51-55``)."""
+    return jnp.max(agent_deviations(stacked))
+
+
+def max_std(stacked: Pytree) -> jax.Array:
+    """Max over parameters of the across-agent standard deviation.
+
+    Parity: ``Mixer.get_max_parameters_std`` (``mixer.py:82-84``).
+    """
+    leaves = jax.tree.leaves(stacked)
+    return jnp.max(
+        jnp.stack([jnp.max(jnp.std(x.astype(jnp.float32), axis=0)) for x in leaves])
+    )
+
+
+def weighted_lift(stacked: Pytree, weights: jax.Array) -> Pytree:
+    """Rescale each agent's value by ``w_i / mean(w)`` so that plain average
+    consensus computes the *weighted* average.
+
+    This is the reference's weighting trick: ``y_i = x_i w_i / mean_w``
+    implies ``(1/n) sum y_i = (sum w_i x_i) / (sum w_i)``
+    (``consensus_asyncio.py:231`` and the derivation at :288-293).
+    """
+    w = weights / jnp.mean(weights)
+
+    def lift(x: jax.Array) -> jax.Array:
+        return x * w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+
+    return jax.tree.map(lift, stacked)
+
+
+def weighted_readout(stacked_num: Pytree, stacked_den: jax.Array) -> Pytree:
+    """Finish a push-sum style weighted consensus: divide the mixed numerator
+    by the mixed scalar weight channel.
+
+    Used when per-agent weights are themselves gossiped alongside the values
+    (the generalization of the reference's master-computed ``mean_weight``,
+    which a masterless SPMD program cannot get for free).
+    """
+
+    def div(x: jax.Array) -> jax.Array:
+        return x / stacked_den.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+
+    return jax.tree.map(div, stacked_num)

@@ -1,0 +1,479 @@
+"""Consensus (gossip) engines: the TPU-native runtime replacing the
+reference's three backends.
+
+The reference implements one conceptual protocol three times — shared-memory
+(``utils/consensus_simple/mixer.py``), asyncio queues
+(``utils/consensus_asyncio.py``), TCP + pickle (``utils/consensus_tcp/``) —
+all interpreting "each agent averages with its neighbors until converged" as
+runtime message passing coordinated by a master.
+
+Here the protocol is *compiled*: a :class:`ConsensusEngine` owns a mixing
+matrix and executes whole gossip rounds as jitted XLA programs.
+
+Two execution modes, one API:
+
+* **dense** (``mesh=None``): all N agents' replicas live on the current
+  device as a leading axis; one round is one batched matmul (MXU).  This is
+  the analogue of the asyncio simulator — N logical nodes, no cluster — and
+  is also the fastest layout when N models fit on one chip.
+* **sharded** (``mesh=`` a ``jax.sharding.Mesh`` with an ``agents`` axis):
+  one agent per device; one round is ``chromatic_index`` many
+  ``jax.lax.ppermute`` steps over ICI (compiled from
+  :class:`~distributed_learning_tpu.parallel.schedule.MatchingSchedule`),
+  residuals via ``pmean``/``pmax``.  The master's round lifecycle
+  (NEW_ROUND -> CONVERGED -> DONE, ``consensus_asyncio.py:120-174``)
+  collapses into a ``lax.while_loop`` on the device.
+
+The eps-or-times stopping rule, deviation metrics, and the weighted
+(sample-count) averaging trick all keep the reference's semantics — see the
+per-method parity notes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_learning_tpu.ops import mixing as ops
+from .schedule import MatchingSchedule, chebyshev_omegas, validate_mixing_matrix
+from .topology import Topology, gamma as exact_gamma
+
+Pytree = Any
+
+__all__ = ["ConsensusEngine", "Mixer", "make_agent_mesh"]
+
+
+def make_agent_mesh(n: int, *, axis_name: str = "agents") -> Mesh:
+    """Mesh over the first ``n`` available devices with a single agent axis."""
+    devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for {n} agents, have {len(devices)}")
+    return Mesh(np.array(devices[:n]), (axis_name,))
+
+
+class ConsensusEngine:
+    """Executes gossip rounds on stacked per-agent pytrees.
+
+    Parameters
+    ----------
+    W:
+        (n, n) symmetric row-stochastic mixing matrix.
+    mesh:
+        Optional mesh with ``axis_name`` of size n; if given, rounds run as
+        SPMD ppermute schedules, else as dense batched matmuls.
+    precision:
+        Matmul precision for the dense path (HIGHEST: consensus residuals
+        of ~1e-4 would be floored by bf16 accumulation).
+    """
+
+    def __init__(
+        self,
+        W: np.ndarray,
+        *,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = "agents",
+        precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+    ):
+        self.W = validate_mixing_matrix(W)
+        self.n = self.W.shape[0]
+        self.axis_name = axis_name
+        self.mesh = mesh
+        self.precision = precision
+        self.gamma = exact_gamma(self.W)
+        self.schedule = MatchingSchedule.from_matrix(self.W)
+        if mesh is not None:
+            if axis_name not in mesh.axis_names:
+                raise ValueError(f"mesh has no axis {axis_name!r}")
+            if mesh.shape[axis_name] != self.n:
+                raise ValueError(
+                    f"mesh axis {axis_name!r} has size {mesh.shape[axis_name]}, "
+                    f"need {self.n} (one device per agent)"
+                )
+        self._W_dev = jnp.asarray(self.W, dtype=jnp.float32)
+        self._self_w = jnp.asarray(self.schedule.self_weights, dtype=jnp.float32)
+        self._match_w = jnp.asarray(self.schedule.weights, dtype=jnp.float32)
+        self._jit_cache: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------ #
+    # Local (per-shard) building blocks                                  #
+    # ------------------------------------------------------------------ #
+    def _local_mix_once(self, x: Pytree, self_w: jax.Array, match_w: jax.Array) -> Pytree:
+        """One gossip round on the local shard: self term + one ppermute per
+        matching (color class) of the mixing graph."""
+        ax = self.axis_name
+
+        def scale(v: jax.Array, s: jax.Array) -> jax.Array:
+            return (v.astype(jnp.float32) * s).astype(v.dtype)
+
+        acc = jax.tree.map(lambda v: scale(v, self_w[0]), x)
+        for r in range(self.schedule.num_rounds):
+            pairs = self.schedule.ppermute_pairs(r)
+            nb = jax.tree.map(lambda v: lax.ppermute(v, ax, pairs), x)
+            acc = jax.tree.map(
+                lambda a, b: a + scale(b, match_w[r, 0]), acc, nb
+            )
+        return acc
+
+    def _local_sq_deviation(self, x: Pytree) -> jax.Array:
+        """This agent's squared L2 distance from the global mean vector."""
+        total = jnp.float32(0.0)
+        for leaf in jax.tree.leaves(x):
+            mean = lax.pmean(leaf.astype(jnp.float32), self.axis_name)
+            d = leaf.astype(jnp.float32) - mean
+            total = total + jnp.sum(d * d)
+        return total
+
+    # ------------------------------------------------------------------ #
+    # Global (dense) building blocks                                     #
+    # ------------------------------------------------------------------ #
+    def _dense_mix_once(self, x: Pytree) -> Pytree:
+        return ops.dense_mix(x, self._W_dev, precision=self.precision)
+
+    # ------------------------------------------------------------------ #
+    # Public API                                                         #
+    # ------------------------------------------------------------------ #
+    def shard(self, stacked: Pytree) -> Pytree:
+        """Place a stacked pytree on the mesh, agent axis sharded."""
+        if self.mesh is None:
+            return jax.tree.map(jnp.asarray, stacked)
+        sharding = NamedSharding(self.mesh, P(self.axis_name))
+        return jax.tree.map(lambda v: jax.device_put(v, sharding), stacked)
+
+    def mix(self, stacked: Pytree, times: int = 1) -> Pytree:
+        """Run exactly ``times`` gossip rounds (``Mixer.mix(times, eps=None)``
+        semantics, ``mixer.py:18-41``)."""
+        fn = self._get_jitted("mix")
+        return fn(stacked, jnp.int32(times))
+
+    def mix_until(
+        self,
+        stacked: Pytree,
+        *,
+        eps: float,
+        min_times: int = 0,
+        max_rounds: int = 10_000,
+    ) -> Tuple[Pytree, jax.Array, jax.Array]:
+        """Gossip until ``max_deviation < eps`` (and at least ``min_times``
+        rounds), returning ``(state, rounds_done, final_residual)``.
+
+        This is the reference's eps-stopping rule (``mixer.py:40-41``:
+        ``(eps is None or max_dev < eps) and times_done >= times``) compiled
+        into a ``lax.while_loop`` — no host round-trip per gossip iteration,
+        unlike the asyncio/TCP masters which exchange CONVERGED /
+        NOT_CONVERGED messages every round (``consensus_asyncio.py:297-310``).
+        ``max_rounds`` bounds the loop (the reference's is unbounded).
+        """
+        fn = self._get_jitted("mix_until")
+        return fn(
+            stacked,
+            jnp.float32(eps),
+            jnp.int32(min_times),
+            jnp.int32(max_rounds),
+        )
+
+    def mix_chebyshev(self, stacked: Pytree, times: int) -> Pytree:
+        """``times`` rounds of Chebyshev-accelerated gossip (BASELINE
+        config 5: "Chebyshev-accelerated averaging").
+
+        Uses this engine's exact ``gamma``; residual after k rounds decays
+        like the scaled Chebyshev polynomial — quadratically faster in the
+        spectral gap than plain mixing.  ``times`` is static (it fixes the
+        scalar schedule).
+        """
+        key = ("cheby", int(times))
+        if key not in self._jit_cache:
+            omegas = chebyshev_omegas(self.gamma, int(times))
+            self._jit_cache[key] = jax.jit(
+                lambda x: self._run_chebyshev(x, omegas)
+            )
+        return self._jit_cache[key](stacked)
+
+    def run_round(
+        self,
+        stacked: Pytree,
+        weights: jax.Array | np.ndarray,
+        *,
+        convergence_eps: float = 1e-4,
+        max_rounds: int = 10_000,
+    ) -> Pytree:
+        """Weighted average consensus round: every agent contributes its
+        value with weight ``w_i`` (e.g. local sample count) and receives the
+        weighted average.
+
+        Parity with ``ConsensusAgent.run_round(value, weight)``
+        (``consensus_asyncio.py:209-312``): values are lifted to
+        ``y_i = x_i * w_i / mean(w)`` — the reference's master computes
+        ``mean(w)`` centrally (:165); here it is a closed-form rescale —
+        then gossiped until the residual drops below ``convergence_eps``.
+        The reference's convergence check is one-sided and per-agent
+        (``(y - v) <= eps``, :297 — a recorded defect); ours is the global
+        symmetric residual.
+        """
+        w = jnp.asarray(weights, dtype=jnp.float32)
+        if w.shape != (self.n,):
+            raise ValueError(f"weights must have shape ({self.n},), got {w.shape}")
+        total = float(jnp.sum(w))
+        if not np.isfinite(total) or total <= 0.0:
+            raise ValueError(
+                f"agent weights must sum to a positive finite value, got {total}"
+            )
+        lifted = ops.weighted_lift(stacked, w)
+        mixed, _, _ = self.mix_until(
+            lifted, eps=convergence_eps, min_times=1, max_rounds=max_rounds
+        )
+        return mixed
+
+    def deviations(self, stacked: Pytree) -> jax.Array:
+        """(N,) per-agent L2 deviations from the mean parameter vector
+        (parity: ``Mixer.get_parameters_deviation``, ``mixer.py:78-80``)."""
+        return self._get_jitted("deviations")(stacked)
+
+    def max_deviation(self, stacked: Pytree) -> jax.Array:
+        return jnp.max(self.deviations(stacked))
+
+    def max_std(self, stacked: Pytree) -> jax.Array:
+        """Max across-agent parameter std (parity: ``mixer.py:82-84``)."""
+        return self._get_jitted("max_std")(stacked)
+
+    # ------------------------------------------------------------------ #
+    # Jit plumbing                                                       #
+    # ------------------------------------------------------------------ #
+    def _get_jitted(self, name: str):
+        if name in self._jit_cache:
+            return self._jit_cache[name]
+
+        def wrap(f):
+            return jax.jit(f)
+
+        if self.mesh is None:
+            if name == "mix":
+                fn = wrap(lambda x, t: self._run_times(x, t, self._dense_mix_once))
+            elif name == "mix_until":
+                fn = wrap(
+                    lambda x, eps, mn, mx: self._run_until(
+                        x,
+                        eps,
+                        mn,
+                        mx,
+                        self._dense_mix_once,
+                        lambda s: jnp.max(ops.agent_deviations(s)),
+                    )
+                )
+            elif name == "deviations":
+                fn = wrap(ops.agent_deviations)
+            elif name == "max_std":
+                fn = wrap(ops.max_std)
+            else:
+                raise KeyError(name)
+        else:
+            mesh, ax = self.mesh, self.axis_name
+
+            def sharded(f, out_specs, extra_in=()):
+                return jax.jit(
+                    jax.shard_map(
+                        f,
+                        mesh=mesh,
+                        in_specs=(P(ax),) + extra_in,
+                        out_specs=out_specs,
+                    )
+                )
+
+            if name == "mix":
+                def local_mix(x, t, sw, mw):
+                    return self._run_times(
+                        x, t, lambda s: self._local_mix_once(s, sw, mw)
+                    )
+
+                inner = sharded(
+                    local_mix, P(ax), extra_in=(P(), P(ax), P(None, ax))
+                )
+                fn = lambda x, t: inner(x, t, self._self_w, self._match_w)
+            elif name == "mix_until":
+                def local_until(x, eps, mn, mx, sw, mw):
+                    return self._run_until(
+                        x,
+                        eps,
+                        mn,
+                        mx,
+                        lambda s: self._local_mix_once(s, sw, mw),
+                        lambda s: lax.pmax(
+                            jnp.sqrt(self._local_sq_deviation(s)), ax
+                        ),
+                    )
+
+                inner = sharded(
+                    local_until,
+                    (P(ax), P(), P()),
+                    extra_in=(P(), P(), P(), P(ax), P(None, ax)),
+                )
+                fn = lambda x, eps, mn, mx: inner(
+                    x, eps, mn, mx, self._self_w, self._match_w
+                )
+            elif name == "deviations":
+                inner = sharded(
+                    lambda x: jnp.sqrt(self._local_sq_deviation(x))[None],
+                    P(ax),
+                )
+                fn = inner
+            elif name == "max_std":
+                def local_max_std(x):
+                    m = jnp.float32(0.0)
+                    for leaf in jax.tree.leaves(x):
+                        lf = leaf.astype(jnp.float32)
+                        mean = lax.pmean(lf, ax)
+                        var = lax.pmean((lf - mean) ** 2, ax)
+                        m = jnp.maximum(m, jnp.max(jnp.sqrt(var)))
+                    return m
+
+                fn = sharded(local_max_std, P())
+            else:
+                raise KeyError(name)
+
+        self._jit_cache[name] = fn
+        return fn
+
+    # ------------------------------------------------------------------ #
+    # Loop bodies (shared by dense and sharded paths)                    #
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _run_times(x: Pytree, times: jax.Array, mix_once) -> Pytree:
+        return lax.fori_loop(0, times, lambda i, s: mix_once(s), x)
+
+    @staticmethod
+    def _run_until(x, eps, min_times, max_rounds, mix_once, residual):
+        def cond(carry):
+            t, s, res = carry
+            return (t < min_times) | ((res >= eps) & (t < max_rounds))
+
+        def body(carry):
+            t, s, _ = carry
+            s = mix_once(s)
+            return (t + 1, s, residual(s))
+
+        t0 = jnp.int32(0)
+        t, s, res = lax.while_loop(cond, body, (t0, x, residual(x)))
+        return s, t, res
+
+    def _run_chebyshev(self, x: Pytree, omegas: np.ndarray) -> Pytree:
+        """x_{k+1} = omega_{k+1} (W x_k - x_{k-1}) + x_{k-1}; mean-preserving
+        at every step.  Runs dense or inside shard_map depending on mode."""
+        if self.mesh is None:
+            mix_once = self._dense_mix_once
+
+            def run(xx):
+                return self._cheby_loop(xx, omegas, mix_once)
+
+            return run(x)
+        mesh, ax = self.mesh, self.axis_name
+
+        def local(xx, sw, mw):
+            return self._cheby_loop(
+                xx, omegas, lambda s: self._local_mix_once(s, sw, mw)
+            )
+
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(ax), P(ax), P(None, ax)),
+            out_specs=P(ax),
+        )(x, self._self_w, self._match_w)
+
+    @staticmethod
+    def _cheby_loop(x: Pytree, omegas: np.ndarray, mix_once) -> Pytree:
+        if len(omegas) == 0:
+            return x
+        x_prev, xk = x, mix_once(x)  # omega_1 = 1 step
+        for omega in omegas[1:]:
+            om = jnp.float32(omega)
+            wx = mix_once(xk)
+            x_next = jax.tree.map(
+                lambda wv, pv: (om * (wv.astype(jnp.float32) - pv.astype(jnp.float32))
+                                + pv.astype(jnp.float32)).astype(wv.dtype),
+                wx,
+                x_prev,
+            )
+            x_prev, xk = xk, x_next
+        return xk
+
+
+class Mixer:
+    """Drop-in equivalent of the reference's synchronous in-process mixer
+    (``utils/consensus_simple/mixer.py:9-84``), device-resident.
+
+    Takes per-agent parameter pytrees plus the reference's
+    ``{agent: {neighbor: weight}}`` topology dict (``Man_Colab.ipynb`` cell
+    14 format), stacks them on device, and gossips with a
+    :class:`ConsensusEngine` — eliminating the torch->numpy flatten /
+    unflatten round-trip of ``mixer.py:68-76``.
+    """
+
+    def __init__(
+        self,
+        params: Mapping[Hashable, Pytree],
+        topology: Mapping[Hashable, Mapping[Hashable, float]] | np.ndarray,
+        *,
+        tokens: Sequence[Hashable] | None = None,
+        mesh: Optional[Mesh] = None,
+        logger=None,
+        max_rounds: int = 10_000,
+    ):
+        if isinstance(topology, Mapping):
+            topo, W = Topology.from_neighbor_dict(topology)
+            self.tokens = topo.tokens
+        else:
+            W = np.asarray(topology)
+            self.tokens = tuple(tokens) if tokens is not None else tuple(range(W.shape[0]))
+            if len(self.tokens) != W.shape[0]:
+                raise ValueError(
+                    f"expected {W.shape[0]} tokens for a {W.shape} mixing "
+                    f"matrix, got {len(self.tokens)}"
+                )
+        missing = [t for t in self.tokens if t not in params]
+        if missing:
+            raise ValueError(f"params missing for agents: {missing}")
+        self.engine = ConsensusEngine(W, mesh=mesh)
+        self._logger = logger
+        self._max_rounds = max_rounds
+        self._stacked = self.engine.shard(
+            ops.stack_trees([params[t] for t in self.tokens])
+        )
+
+    def mix(self, times: int = 1, eps: float | None = None) -> int:
+        """Gossip ``times`` rounds; with ``eps`` keep going until the max
+        deviation drops below it (at least ``times`` rounds).  Returns the
+        number of rounds executed (parity: ``mixer.py:18-41``)."""
+        if len(self.tokens) <= 1:
+            return 0
+        if self._logger is not None:
+            self._logger.debug(f"Mixer start with times= {times}, eps= {eps}")
+        if eps is None:
+            self._stacked = self.engine.mix(self._stacked, times)
+            done = int(times)
+        else:
+            self._stacked, t, _res = self.engine.mix_until(
+                self._stacked, eps=eps, min_times=times, max_rounds=self._max_rounds
+            )
+            done = int(t)
+        if self._logger is not None:
+            self._logger.debug(f"Mixer finished with {done} times")
+        return done
+
+    def parameters(self) -> Dict[Hashable, Pytree]:
+        """Current per-agent parameter pytrees."""
+        trees = ops.unstack_tree(self._stacked, len(self.tokens))
+        return dict(zip(self.tokens, trees))
+
+    def stacked_parameters(self) -> Pytree:
+        return self._stacked
+
+    def get_parameters_deviation(self) -> Dict[Hashable, float]:
+        devs = np.asarray(self.engine.deviations(self._stacked))
+        return {t: float(d) for t, d in zip(self.tokens, devs)}
+
+    def get_max_parameters_std(self) -> float:
+        return float(self.engine.max_std(self._stacked))

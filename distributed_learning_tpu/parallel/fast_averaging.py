@@ -73,7 +73,7 @@ def _spectral_state(B: np.ndarray, w: np.ndarray, n: int):
     ones_k = int(np.argmax(np.abs(V.T @ np.ones(n))))
     mu = 1.0 - lam
     mu[ones_k] = 0.0
-    return L, M, lam, V, mu, V
+    return lam, V, mu
 
 
 def _solve(
@@ -96,7 +96,7 @@ def _solve(
     for beta, lr in zip(betas, lrs):
         for _ in range(iters_per_phase):
             t += 1
-            L, M, lam, V, mu, U = _spectral_state(B, w, n)
+            lam, V, mu = _spectral_state(B, w, n)
 
             # Track best exactly-feasible iterate (true, unsmoothed gamma).
             if mu.min() >= -psd_tol:
@@ -116,7 +116,7 @@ def _solve(
             # d/dw_e [ rho * sum_{mu_k<0} (-mu_k) ] = -rho * sum_k (u_k[u]-u_k[v])^2
             neg = mu < 0.0
             if neg.any():
-                DU = B @ U[:, neg]
+                DU = B @ V[:, neg]
                 grad -= rho * (DU**2).sum(axis=1)
 
             m_adam = 0.9 * m_adam + 0.1 * grad
@@ -126,7 +126,7 @@ def _solve(
             w = w - lr * mhat / (np.sqrt(vhat) + 1e-12)
 
     # Final exact evaluation of the last iterate too.
-    L, M, lam, V, mu, U = _spectral_state(B, w, n)
+    lam, V, mu = _spectral_state(B, w, n)
     if mu.min() >= -psd_tol:
         g = max(abs(lam[0]), abs(lam[-1]))
         if g < best_gamma:

@@ -2,16 +2,25 @@
 
 This is the TPU-framework analogue of the reference's asyncio fake-network
 fixture (``utils/consensus_asyncio.py``): N logical agents, the real SPMD
-protocol, one process, no hardware.  Must run before jax is imported.
+protocol, one process, no hardware.
+
+The environment may pin an accelerator platform (e.g. a tunneled TPU) ahead
+of the JAX_PLATFORMS env var, so we both set the env *and* force the config
+after import — tests must always run on the virtual CPU mesh.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Keep CPU tests deterministic and fast.
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)

@@ -1,0 +1,259 @@
+"""Consensus-engine tests on the 8-virtual-device CPU harness.
+
+Mirrors the reference's tier-2 integration pattern (the asyncio fake network,
+``Titanic Consensus GD test.ipynb`` cell 10: "average five numbers") plus the
+mathematical invariants from ``wiki/consensus_basics.ipynb``: mean
+preservation, contraction at rate gamma, weighted-mean fixed point.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.ops import mixing as ops
+from distributed_learning_tpu.parallel import Topology, solve_fastest_mixing
+from distributed_learning_tpu.parallel.consensus import (
+    ConsensusEngine,
+    Mixer,
+    make_agent_mesh,
+)
+from distributed_learning_tpu.parallel.topology import gamma as exact_gamma
+
+
+def _tree_state(n, seed=0):
+    """A small model-shaped pytree stacked over n agents."""
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(n, 4, 3)), dtype=jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(n, 3)), dtype=jnp.float32),
+    }
+
+
+def _tree_mean(x):
+    return jax.tree.map(lambda v: v.mean(axis=0), x)
+
+
+def _make_engine(topo, sharded, W=None):
+    if W is None:
+        W = topo.metropolis_weights()
+    mesh = make_agent_mesh(topo.n_agents) if sharded else None
+    return ConsensusEngine(W, mesh=mesh)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_average_five_numbers(sharded):
+    # The reference's smoke test: 5 agents reach the average of 5 numbers.
+    topo = Topology.from_edges([(0, 1), (1, 2), (2, 3), (3, 4), (4, 0)])
+    eng = _make_engine(topo, sharded)
+    x = {"v": jnp.asarray([[1.0], [2.0], [3.0], [4.0], [5.0]])}
+    x = eng.shard(x)
+    out, t, res = eng.mix_until(x, eps=1e-6, max_rounds=500)
+    np.testing.assert_allclose(np.asarray(out["v"]), 3.0, atol=1e-5)
+    assert int(t) < 500
+    assert float(res) < 1e-6
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_mean_preservation(sharded):
+    topo = Topology.grid2d(2, 4)
+    eng = _make_engine(topo, sharded)
+    x = eng.shard(_tree_state(8))
+    before = _tree_mean(x)
+    out = eng.mix(x, times=7)
+    after = _tree_mean(out)
+    for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_contraction_at_gamma_rate(sharded):
+    topo = Topology.ring(8)
+    W = topo.metropolis_weights()
+    g = exact_gamma(W)
+    eng = _make_engine(topo, sharded, W)
+    x = eng.shard(_tree_state(8, seed=3))
+    r0 = float(eng.max_deviation(x))
+    k = 10
+    out = eng.mix(x, times=k)
+    rk = float(eng.max_deviation(out))
+    # Worst-case bound with sqrt(n) slack between max-norm and 2-norm.
+    assert rk <= g**k * r0 * np.sqrt(8) + 1e-6
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_sharded_matches_dense(sharded):
+    """ppermute matching schedule computes exactly W @ x."""
+    topo = Topology.watts_strogatz(8, 4, 0.4, seed=11)
+    W = topo.metropolis_weights()
+    eng = _make_engine(topo, sharded, W)
+    x = _tree_state(8, seed=4)
+    out = eng.mix(eng.shard(x), times=3)
+    # Direct numpy reference: W^3 applied leaf-wise.
+    W3 = np.linalg.matrix_power(W, 3)
+    for key in x:
+        flat = np.asarray(x[key]).reshape(8, -1)
+        expect = (W3 @ flat).reshape(x[key].shape)
+        np.testing.assert_allclose(np.asarray(out[key]), expect, atol=1e-5)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_weighted_consensus_fixed_point(sharded):
+    # Weighted average: gossip converges to sum(w_i x_i)/sum(w_i)
+    # (the reference's sample-count weighting, consensus_asyncio.py:288-293).
+    topo = Topology.ring(8)
+    eng = _make_engine(topo, sharded)
+    vals = np.arange(8, dtype=np.float32).reshape(8, 1) + 1.0
+    weights = np.asarray([1, 2, 3, 4, 4, 3, 2, 1], dtype=np.float32)
+    expect = float((vals[:, 0] * weights).sum() / weights.sum())
+    x = eng.shard({"v": jnp.asarray(vals)})
+    out = eng.run_round(x, weights, convergence_eps=1e-6, max_rounds=2000)
+    np.testing.assert_allclose(np.asarray(out["v"]), expect, atol=1e-4)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_chebyshev_beats_plain_on_device(sharded):
+    topo = Topology.ring(8)
+    W = topo.metropolis_weights()
+    eng = _make_engine(topo, sharded, W)
+    x = eng.shard(_tree_state(8, seed=5))
+    k = 10
+    plain = eng.mix(x, times=k)
+    cheb = eng.mix_chebyshev(x, times=k)
+    assert float(eng.max_deviation(cheb)) < float(eng.max_deviation(plain)) / 5
+    # Chebyshev preserves the mean too.
+    for b, a in zip(
+        jax.tree.leaves(_tree_mean(x)), jax.tree.leaves(_tree_mean(cheb))
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_optimal_weights_mix_faster(sharded):
+    topo = Topology.grid2d(2, 4)
+    W_opt, g_opt = solve_fastest_mixing(topo)
+    W_met = topo.metropolis_weights()
+    e_opt = _make_engine(topo, sharded, W_opt)
+    e_met = _make_engine(topo, sharded, W_met)
+    x = _tree_state(8, seed=6)
+    k = 15
+    r_opt = float(e_opt.max_deviation(e_opt.mix(e_opt.shard(x), times=k)))
+    r_met = float(e_met.max_deviation(e_met.mix(e_met.shard(x), times=k)))
+    assert r_opt < r_met
+
+
+def test_mix_until_respects_min_times():
+    topo = Topology.complete(4)
+    eng = ConsensusEngine(topo.metropolis_weights())
+    x = _tree_state(4)
+    # Already-converged state (all equal) must still run min_times rounds.
+    x_eq = jax.tree.map(lambda v: jnp.broadcast_to(v[:1], v.shape), x)
+    _, t, res = eng.mix_until(x_eq, eps=1e-3, min_times=3, max_rounds=100)
+    assert int(t) == 3
+    assert float(res) < 1e-3
+
+
+def test_mix_until_bounded_by_max_rounds():
+    # Disconnected graph never converges; loop must stop at max_rounds.
+    W = np.eye(4)  # identity mixing = no progress
+    eng = ConsensusEngine(W)
+    x = _tree_state(4, seed=7)
+    _, t, res = eng.mix_until(x, eps=1e-9, max_rounds=17)
+    assert int(t) == 17
+    assert float(res) > 0
+
+
+class _ListLogger:
+    def __init__(self):
+        self.lines = []
+
+    def debug(self, msg):
+        self.lines.append(str(msg))
+
+
+def test_mixer_reference_api():
+    # The consensus_simple.Mixer surface: dict params + dict topology.
+    topology = {
+        "Alice": {"Alice": 0.9, "Bob": 0.05, "Charlie": 0.05},
+        "Bob": {"Alice": 0.05, "Bob": 0.9, "Charlie": 0.05},
+        "Charlie": {"Alice": 0.05, "Bob": 0.05, "Charlie": 0.9},
+    }
+    params = {
+        name: {"w": jnp.full((2, 2), float(i)), "b": jnp.full((2,), float(i))}
+        for i, name in enumerate(["Alice", "Bob", "Charlie"])
+    }
+    log = _ListLogger()
+    mixer = Mixer(params, topology, logger=log)
+    devs = mixer.get_parameters_deviation()
+    assert set(devs) == {"Alice", "Bob", "Charlie"}
+    assert mixer.get_max_parameters_std() > 0
+    done = mixer.mix(times=2)
+    assert done == 2
+    done = mixer.mix(times=1, eps=1e-5)
+    assert done >= 1
+    assert max(mixer.get_parameters_deviation().values()) < 1e-4
+    # All agents converged to the initial mean (1.0 everywhere).
+    final = mixer.parameters()
+    np.testing.assert_allclose(np.asarray(final["Bob"]["w"]), 1.0, atol=1e-5)
+    assert any("Mixer start" in l for l in log.lines)
+
+
+def test_mixer_single_agent_noop():
+    mixer = Mixer({"a": {"w": jnp.ones((2,))}}, {"a": {"a": 1.0}})
+    assert mixer.mix(times=5) == 0
+
+
+def test_dense_mix_preserves_non_f32_leaf_dtypes():
+    # int32 leaves (e.g. step counters) must mix in f32 and cast back,
+    # matching the sharded path — not be annihilated by W.astype(int).
+    topo = Topology.ring(4)
+    W = topo.metropolis_weights()
+    eng_d = ConsensusEngine(W)
+    x = {
+        "w": jnp.asarray(np.arange(4.0)[:, None], jnp.float32),
+        "step": jnp.asarray([10, 20, 30, 40], jnp.int32)[:, None],
+    }
+    out_d = eng_d.mix(x, times=1)
+    assert out_d["step"].dtype == jnp.int32
+    expect = (W @ np.array([10.0, 20, 30, 40])).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(out_d["step"][:, 0]), expect)
+
+
+def test_chebyshev_times_zero_is_noop():
+    eng = ConsensusEngine(Topology.ring(4).metropolis_weights())
+    x = {"v": jnp.arange(4.0)[:, None]}
+    out = eng.mix_chebyshev(x, times=0)
+    np.testing.assert_array_equal(np.asarray(out["v"]), np.asarray(x["v"]))
+
+
+def test_mixer_token_count_must_match_matrix():
+    W = Topology.ring(4).metropolis_weights()
+    params = {t: {"w": jnp.ones(2)} for t in "abc"}
+    with pytest.raises(ValueError, match="tokens"):
+        Mixer(params, W, tokens=("a", "b", "c"))
+
+
+def test_run_round_rejects_degenerate_weights():
+    eng = ConsensusEngine(Topology.ring(4).metropolis_weights())
+    x = {"v": jnp.arange(4.0)[:, None]}
+    with pytest.raises(ValueError):
+        eng.run_round(x, np.zeros(4))
+    with pytest.raises(ValueError):
+        eng.run_round(x, np.ones(3))
+
+
+def test_weighted_readout_push_sum():
+    # Push-sum style: gossip (w*x, w) jointly, then divide. After full
+    # convergence both channels hit their means, ratio = weighted average.
+    topo = Topology.ring(6)
+    eng = ConsensusEngine(topo.metropolis_weights())
+    rng = np.random.default_rng(1)
+    vals = rng.normal(size=(6, 3)).astype(np.float32)
+    w = np.asarray([1, 2, 3, 1, 2, 3], np.float32)
+    num = {"v": jnp.asarray(vals * w[:, None])}
+    den = jnp.asarray(w)
+    num_mixed, _, _ = eng.mix_until(num, eps=1e-6, max_rounds=2000)
+    den_mixed = eng.mix({"d": den[:, None]}, times=2000)["d"][:, 0]
+    out = ops.weighted_readout(num_mixed, den_mixed)
+    expect = (vals * w[:, None]).sum(0) / w.sum()
+    np.testing.assert_allclose(np.asarray(out["v"]), np.tile(expect, (6, 1)), atol=1e-4)

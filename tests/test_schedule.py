@@ -1,0 +1,105 @@
+"""Tests for the mixing-schedule compiler (matrix -> ppermute matchings)."""
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.parallel.schedule import (
+    MatchingSchedule,
+    chebyshev_omegas,
+    validate_mixing_matrix,
+)
+
+
+def test_validate_rejects_bad_matrices():
+    with pytest.raises(ValueError):
+        validate_mixing_matrix(np.ones((2, 3)))
+    with pytest.raises(ValueError):
+        validate_mixing_matrix(np.array([[0.5, 0.5], [0.1, 0.9]]))  # asymmetric
+    with pytest.raises(ValueError):
+        validate_mixing_matrix(np.array([[0.5, 0.4], [0.4, 0.5]]))  # rows != 1
+
+
+@pytest.mark.parametrize(
+    "topo",
+    [
+        Topology.ring(8),
+        Topology.complete(6),
+        Topology.star(7),
+        Topology.grid2d(2, 4),
+        Topology.hypercube(3),
+        Topology.watts_strogatz(16, 4, 0.3, seed=5),
+    ],
+)
+def test_schedule_roundtrips_matrix(topo):
+    W = topo.metropolis_weights()
+    s = MatchingSchedule.from_matrix(W)
+    np.testing.assert_allclose(s.as_matrix(), W, atol=1e-12)
+
+
+def test_matchings_are_vertex_disjoint():
+    topo = Topology.watts_strogatz(16, 6, 0.5, seed=9)
+    s = MatchingSchedule.from_topology(topo)
+    for cls in s.matchings:
+        seen = set()
+        for (i, j) in cls:
+            assert i not in seen and j not in seen
+            seen.update((i, j))
+
+
+def test_coloring_near_optimal():
+    # Greedy bound is 2*max_degree - 1; in practice expect <= max_degree + 1
+    # for these regular-ish graphs. Ring needs 2 (even) / 3 (odd) colors.
+    assert MatchingSchedule.from_topology(Topology.ring(8)).num_rounds == 2
+    assert MatchingSchedule.from_topology(Topology.ring(5)).num_rounds == 3
+    s = MatchingSchedule.from_topology(Topology.hypercube(3))
+    assert s.num_rounds <= 4  # 3-regular
+
+
+def test_ppermute_pairs_bidirectional():
+    s = MatchingSchedule.from_topology(Topology.ring(4))
+    for r in range(s.num_rounds):
+        pairs = s.ppermute_pairs(r)
+        assert len(pairs) == 2 * len(s.matchings[r])
+        srcs = [p[0] for p in pairs]
+        dsts = [p[1] for p in pairs]
+        assert sorted(srcs) == sorted(dsts)  # an involution
+
+
+def test_chebyshev_accelerates_dense_powering():
+    # Numerically: Chebyshev recurrence beats plain W^k on a slow graph.
+    topo = Topology.ring(12)
+    W = topo.metropolis_weights()
+    from distributed_learning_tpu.parallel.topology import gamma
+
+    g = gamma(W)
+    rng = np.random.default_rng(0)
+    x0 = rng.normal(size=(12,))
+    mean = x0.mean()
+    K = 12
+
+    # plain
+    x = x0.copy()
+    for _ in range(K):
+        x = W @ x
+    plain_res = np.abs(x - mean).max()
+
+    # chebyshev
+    omegas = chebyshev_omegas(g, K)
+    x_prev, xk = x0, W @ x0
+    for om in omegas[1:]:
+        x_next = om * (W @ xk - x_prev) + x_prev
+        x_prev, xk = xk, x_next
+    cheb_res = np.abs(xk - mean).max()
+
+    assert cheb_res < plain_res / 10
+    # Mean preserved exactly.
+    assert xk.mean() == pytest.approx(mean, abs=1e-12)
+
+
+def test_chebyshev_omegas_validation():
+    with pytest.raises(ValueError):
+        chebyshev_omegas(1.0, 5)
+    om = chebyshev_omegas(0.9, 5)
+    assert om[0] == 1.0
+    assert np.all(om[1:] > 1.0)
