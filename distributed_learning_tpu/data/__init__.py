@@ -1,0 +1,33 @@
+"""Data pipelines: Titanic (tabular) and CIFAR-10/100 (vision)."""
+
+from distributed_learning_tpu.data.titanic import (
+    FEATURES,
+    load_titanic,
+    prepare_rows,
+    split_data,
+    synthetic_titanic,
+)
+from distributed_learning_tpu.data.cifar import (
+    CIFAR_MEAN,
+    CIFAR_STD,
+    augment_batch,
+    load_cifar,
+    normalize,
+    shard_dataset,
+    synthetic_cifar,
+)
+
+__all__ = [
+    "FEATURES",
+    "load_titanic",
+    "prepare_rows",
+    "split_data",
+    "synthetic_titanic",
+    "CIFAR_MEAN",
+    "CIFAR_STD",
+    "augment_batch",
+    "load_cifar",
+    "normalize",
+    "shard_dataset",
+    "synthetic_cifar",
+]

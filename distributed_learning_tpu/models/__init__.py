@@ -1,0 +1,72 @@
+"""Model zoo: logreg / MLP / LeNet / VGG / ResNet / WideResNet.
+
+``get_model(name, *args, **kwargs)`` resolves the reference's string model
+names (``MasterNode(model='lenet' | 'vggnet' | 'resnet' | 'wide-resnet')``,
+``Man_Colab.ipynb`` cell 21) to flax modules.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from distributed_learning_tpu.models.logreg import (
+    LogisticRegression,
+    accuracy as logreg_accuracy,
+    grad_step as logreg_grad_step,
+    loss_fn as logreg_loss,
+)
+from distributed_learning_tpu.models.mlp import ANNModel
+from distributed_learning_tpu.models.vision import LeNet, ResNet, VGG, WideResNet
+
+_REGISTRY = {
+    "lenet": LeNet,
+    "vggnet": VGG,
+    "resnet": ResNet,
+    "wide-resnet": WideResNet,
+    "wide_resnet": WideResNet,
+    "ann": ANNModel,
+    "mlp": ANNModel,
+}
+
+
+def get_model(name: str, *args: Any, **kwargs: Any):
+    """Build a model by reference-compatible name.
+
+    Positional args mirror the reference's ``model(*model_args)`` convention
+    — e.g. ``get_model('lenet', 10)`` is LeNet with 10 classes.
+    """
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"unknown model {name!r}; available: {sorted(set(_REGISTRY))}"
+        )
+    cls = _REGISTRY[key]
+    if args:
+        # Reference convention: model_args = [num_classes].
+        size_key = "output_dim" if cls is ANNModel else "num_classes"
+        if size_key in kwargs:
+            raise ValueError(
+                f"{size_key} given both positionally ({args[0]}) and as a "
+                f"keyword ({kwargs[size_key]})"
+            )
+        kwargs[size_key] = args[0]
+        if len(args) > 1:
+            raise ValueError(
+                "positional model_args beyond num_classes are not supported; "
+                "use keyword arguments"
+            )
+    return cls(**kwargs)
+
+
+__all__ = [
+    "ANNModel",
+    "LeNet",
+    "VGG",
+    "ResNet",
+    "WideResNet",
+    "LogisticRegression",
+    "logreg_loss",
+    "logreg_grad_step",
+    "logreg_accuracy",
+    "get_model",
+]

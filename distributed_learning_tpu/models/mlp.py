@@ -1,0 +1,33 @@
+"""4-layer MLP (parity: ``networks/ann_model.py:4-45`` ``ANNModel``).
+
+The reference's torch module is Linear->ReLU->Linear->Tanh->Linear->ELU->
+Linear, sized for MNIST-like 784 -> hidden -> 10.  Same topology here in
+flax linen, with an optional compute dtype for bf16 MXU execution.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ANNModel"]
+
+
+class ANNModel(nn.Module):
+    """Linear/ReLU, Linear/Tanh, Linear/ELU, Linear readout."""
+
+    hidden_dim: int = 150
+    output_dim: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.Dense(self.hidden_dim, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden_dim, dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        x = nn.Dense(self.hidden_dim, dtype=self.dtype)(x)
+        x = nn.elu(x)
+        x = nn.Dense(self.output_dim, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

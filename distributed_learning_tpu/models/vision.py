@@ -1,0 +1,220 @@
+"""CIFAR-scale vision model zoo: LeNet, VGG, ResNet, Wide-ResNet.
+
+Parity: the reference trains lenet / vggnet / resnet / wide-resnet from the
+``meliketoy/wide-resnet.pytorch`` git submodule (``.gitmodules:1-3``; model
+selection in ``Man_Colab.ipynb`` cell 19/21, WRN-28-10 baselines in
+``CIFAR_10_Baseline.ipynb`` cell 9).  The submodule is not even checked out
+in the reference snapshot, so these are written fresh from the standard
+architecture definitions, TPU-first: NHWC layouts, ``nn.Conv`` 3x3s that
+XLA tiles onto the MXU, optional bf16 compute dtype with f32 params, and
+BatchNorm statistics kept **per agent** (only parameters are gossiped —
+matching the reference's behavior of mixing every model parameter while each
+node keeps its own running stats, ``mixer.py:68-76``).
+
+All modules share the call convention
+``apply({'params': p, 'batch_stats': s}, x, train=...)`` with
+``mutable=['batch_stats']`` during training.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LeNet", "VGG", "ResNet", "WideResNet"]
+
+ModuleDef = Any
+
+
+class LeNet(nn.Module):
+    """Classic LeNet-5 (the submodule's ``lenet`` option)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+_VGG_CFG = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    13: (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512,
+         512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    """VGG-{11,13,16,19} with BatchNorm (the submodule's ``vggnet``)."""
+
+    depth: int = 16
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.depth not in _VGG_CFG:
+            raise ValueError(f"VGG depth must be one of {sorted(_VGG_CFG)}")
+        x = x.astype(self.dtype)
+        for v in _VGG_CFG[self.depth]:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+                x = nn.BatchNorm(
+                    use_running_average=not train, momentum=0.9, dtype=self.dtype
+                )(x)
+                x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class _BasicBlock(nn.Module):
+    filters: int
+    stride: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            dtype=self.dtype,
+        )
+        residual = x
+        y = nn.Conv(
+            self.filters, (3, 3), strides=self.stride, padding=1,
+            use_bias=False, dtype=self.dtype,
+        )(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), strides=self.stride, use_bias=False,
+                dtype=self.dtype,
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """CIFAR-style ResNet (the submodule's ``resnet`` option): 3 stages of
+    BasicBlocks, depth = 6n + 2 (20/32/44/56/110) or 18/34 ImageNet-style
+    block counts on CIFAR inputs."""
+
+    depth: int = 18
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if (self.depth - 2) % 6 == 0:
+            n = (self.depth - 2) // 6
+            blocks = (n, n, n)
+        elif self.depth == 18:
+            blocks = (2, 2, 2)
+        elif self.depth == 34:
+            blocks = (3, 4, 6)
+        else:
+            raise ValueError(f"unsupported ResNet depth {self.depth}")
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype)(x)
+        x = nn.relu(x)
+        for stage, num in enumerate(blocks):
+            for b in range(num):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = _BasicBlock(16 * (2**stage), stride, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class _WideBasic(nn.Module):
+    """Pre-activation wide basic block: BN-ReLU-conv-(dropout)-BN-ReLU-conv
+    plus projection shortcut — the ``wide_basic`` of the submodule."""
+
+    filters: int
+    stride: int
+    dropout_rate: float
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            dtype=self.dtype,
+        )
+        y = nn.relu(norm()(x))
+        shortcut = x
+        if x.shape[-1] != self.filters or self.stride != 1:
+            shortcut = nn.Conv(
+                self.filters, (1, 1), strides=self.stride, use_bias=True,
+                dtype=self.dtype,
+            )(y)
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=True,
+                    dtype=self.dtype)(y)
+        if self.dropout_rate > 0:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.filters, (3, 3), strides=self.stride, padding=1,
+                    use_bias=True, dtype=self.dtype)(y)
+        return y + shortcut
+
+
+class WideResNet(nn.Module):
+    """WRN-d-k (default 28-10): the reference's flagship model.
+
+    Baselines to match (BASELINE.md): CIFAR-10 93.77% / CIFAR-100 75.71%
+    test Acc@1 at depth 28, widen factor 10, dropout 0.3.
+    """
+
+    depth: int = 28
+    widen_factor: int = 10
+    dropout_rate: float = 0.3
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if (self.depth - 4) % 6 != 0:
+            raise ValueError("WideResNet depth must be 6n + 4")
+        n = (self.depth - 4) // 6
+        k = self.widen_factor
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=True, dtype=self.dtype)(x)
+        for stage, width in enumerate((16 * k, 32 * k, 64 * k)):
+            for b in range(n):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = _WideBasic(
+                    width, stride, self.dropout_rate, self.dtype
+                )(x, train)
+        x = nn.relu(
+            nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype)(x)
+        )
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,143 @@
+"""End-to-end Titanic consensus-GD: the framework's minimum full slice.
+
+Direct analogue of ``notebooks/Titanic Consensus GD test.ipynb`` cells 14-18:
+N agents hold contiguous shards, run subgradient steps with the notebook's
+``alpha * (it+1)^-0.5`` schedule, and reach full consensus after every step.
+Recorded reference results: centralized GD and K4 consensus-GD both score
+0.7978 on the common test set; the 5-node runs score 0.8090 (BASELINE.md).
+
+Here the whole local-SGD + gossip-to-convergence loop is one jitted program.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.data import load_titanic, split_data
+from distributed_learning_tpu.models import logreg_loss
+from distributed_learning_tpu.models.logreg import accuracy as logreg_accuracy
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.parallel.consensus import (
+    ConsensusEngine,
+    make_agent_mesh,
+)
+
+_REFERENCE_TITANIC = os.path.isdir("/root/reference/data/titanic")
+
+ALPHA, TAU = 0.1, 1e-4
+
+
+def _stacked_shards(n_agents):
+    X_tr, y_tr, X_te, y_te = load_titanic()
+    shards = split_data(X_tr, y_tr, n_agents)
+    m = min(len(s[0]) for s in shards.values())
+    Xs = jnp.stack([jnp.asarray(shards[i][0][:m]) for i in range(n_agents)])
+    ys = jnp.stack(
+        [jnp.asarray(shards[i][1][:m], jnp.float32) for i in range(n_agents)]
+    )
+    return Xs, ys, jnp.asarray(X_te), jnp.asarray(y_te, jnp.float32)
+
+
+def _run_consensus_gd(engine, Xs, ys, iters, mix_eps=1e-9):
+    n_agents, _, dim = Xs.shape
+
+    def local_step(w, X, y, lr):
+        g = jax.grad(logreg_loss)(w, X, y, TAU)
+        return w - lr * g
+
+    vstep = jax.vmap(local_step, in_axes=(0, 0, 0, None))
+
+    @jax.jit
+    def run(w0):
+        def body(it, w):
+            lr = ALPHA * (it + 1.0) ** -0.5
+            w = vstep(w, Xs, ys, lr)
+            w, _, _ = engine.mix_until(w, eps=mix_eps, max_rounds=300)
+            return w
+
+        return jax.lax.fori_loop(0, iters, body, w0)
+
+    return run(jnp.zeros((n_agents, dim)))
+
+
+def _centralized_gd(X, y, iters):
+    @jax.jit
+    def run(w0):
+        def body(it, w):
+            lr = ALPHA * (it + 1.0) ** -0.5
+            g = jax.grad(logreg_loss)(w, X, y, TAU)
+            return w - lr * g
+
+        return jax.lax.fori_loop(0, iters, body, w0)
+
+    return run(jnp.zeros(X.shape[1]))
+
+
+def test_k4_consensus_gd_matches_centralized():
+    # Parity scenario: K4 topology, 4000 iterations (notebook cell 15).
+    Xs, ys, X_te, y_te = _stacked_shards(4)
+    topo = Topology.complete(4)
+    engine = ConsensusEngine(topo.perron())  # uniform-eps Perron mixing
+    w = _run_consensus_gd(engine, Xs, ys, iters=2000)
+
+    # 1. All agents agree to consensus precision.
+    spread = float(jnp.max(jnp.abs(w - w.mean(axis=0))))
+    assert spread < 1e-6
+
+    # 2. Accuracy matches the centralized run on the same data.
+    X_all = Xs.reshape(-1, Xs.shape[-1])
+    y_all = ys.reshape(-1)
+    w_cent = _centralized_gd(X_all, y_all, 2000)
+    acc_cons = float(logreg_accuracy(w[0], X_te, y_te))
+    acc_cent = float(logreg_accuracy(w_cent, X_te, y_te))
+    assert abs(acc_cons - acc_cent) <= 0.03
+    assert acc_cons > 0.72
+
+    if _REFERENCE_TITANIC:
+        # Recorded notebook value for this configuration is 0.7978.
+        assert abs(acc_cons - 0.7978) < 0.035
+
+
+def test_grid5_consensus_gd_sharded_mesh():
+    # The 5-node grid scenario (notebook cells 18-21; recorded acc 0.8090),
+    # run in true SPMD: one agent per virtual device, ppermute gossip.
+    Xs, ys, X_te, y_te = _stacked_shards(5)
+    topo = Topology.from_edges([(0, 1), (1, 2), (2, 3), (3, 4), (4, 0)])
+    engine = ConsensusEngine(
+        topo.metropolis_weights(), mesh=make_agent_mesh(5)
+    )
+    w = _run_consensus_gd(engine, Xs, ys, iters=600, mix_eps=1e-7)
+    spread = float(jnp.max(jnp.abs(w - w.mean(axis=0))))
+    assert spread < 1e-4
+    acc = float(logreg_accuracy(w[0], X_te, y_te))
+    assert acc > 0.72
+
+
+def test_weighted_consensus_unequal_shards():
+    # Sample-count weighting: agents with unequal shards still converge to
+    # the sample-weighted solution (consensus_asyncio.py:288-293 semantics).
+    X_tr, y_tr, X_te, y_te = load_titanic()
+    sizes = [100, 200, 400]
+    Xs = [jnp.asarray(X_tr[sum(sizes[:i]) : sum(sizes[: i + 1])]) for i in range(3)]
+    ys = [
+        jnp.asarray(y_tr[sum(sizes[:i]) : sum(sizes[: i + 1])], jnp.float32)
+        for i in range(3)
+    ]
+    topo = Topology.ring(3)
+    engine = ConsensusEngine(topo.metropolis_weights())
+    weights = np.asarray(sizes, np.float32)
+
+    ws = jnp.stack([jnp.zeros(7) for _ in range(3)])
+    for it in range(300):
+        lr = ALPHA * (it + 1.0) ** -0.5
+        new = []
+        for a in range(3):
+            g = jax.grad(logreg_loss)(ws[a], Xs[a], ys[a], TAU)
+            new.append(ws[a] - lr * g)
+        ws = jnp.stack(new)
+        ws = engine.run_round(ws, weights, convergence_eps=1e-8, max_rounds=200)
+    acc = float(logreg_accuracy(ws[0], jnp.asarray(X_te), jnp.asarray(y_te, jnp.float32)))
+    assert acc > 0.7

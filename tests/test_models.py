@@ -1,0 +1,116 @@
+"""Model-zoo tests: shapes, registry parity, known parameter counts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.models import (
+    ANNModel,
+    LeNet,
+    ResNet,
+    VGG,
+    WideResNet,
+    LogisticRegression,
+    get_model,
+)
+
+
+def _n_params(variables):
+    return sum(p.size for p in jax.tree.leaves(variables["params"]))
+
+
+def test_lenet_shapes_and_params():
+    m = LeNet(num_classes=10)
+    v = m.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+    out = m.apply(v, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+    # Classic LeNet-5 on 32x32x3 inputs.
+    assert _n_params(v) == 136_886
+
+
+def test_ann_model_parity_structure():
+    # Parity: networks/ann_model.py — 4 Dense layers 784->150->150->150->10.
+    m = ANNModel(hidden_dim=150, output_dim=10)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 784)))
+    expect = 784 * 150 + 150 + 150 * 150 + 150 + 150 * 150 + 150 + 150 * 10 + 10
+    assert _n_params(v) == expect
+    assert m.apply(v, jnp.zeros((3, 28, 28))).shape == (3, 10)  # auto-flatten
+
+
+@pytest.mark.parametrize("depth", [11, 16])
+def test_vgg_depths(depth):
+    m = VGG(depth=depth, num_classes=10)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    assert m.apply(v, jnp.zeros((2, 32, 32, 3)), train=False).shape == (2, 10)
+
+
+def test_vgg_bad_depth():
+    with pytest.raises(ValueError, match="depth"):
+        VGG(depth=15).init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+
+
+def test_resnet_cifar_depth():
+    m = ResNet(depth=20, num_classes=10)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    assert m.apply(v, jnp.zeros((2, 32, 32, 3)), train=False).shape == (2, 10)
+    # resnet20 is ~0.27M params.
+    assert 0.2e6 < _n_params(v) < 0.35e6
+
+
+def test_wide_resnet_28_10_param_count():
+    # The flagship: WRN-28-10 is ~36.5M parameters (the baseline model of
+    # CIFAR_10_Baseline.ipynb).
+    m = WideResNet(depth=28, widen_factor=10, dropout_rate=0.3, num_classes=10)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    n = _n_params(v)
+    assert 36.0e6 < n < 37.0e6, n
+    out = m.apply(v, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.shape == (2, 10)
+
+
+def test_wide_resnet_train_mode_updates_batch_stats():
+    m = WideResNet(depth=10, widen_factor=1, dropout_rate=0.1, num_classes=10)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32, 32, 3)),
+                    jnp.float32)
+    v = m.init(jax.random.key(0), x, train=False)
+    out, mutated = m.apply(
+        v, x, train=True,
+        rngs={"dropout": jax.random.key(1)},
+        mutable=["batch_stats"],
+    )
+    assert out.shape == (4, 10)
+    # Running stats must actually move.
+    before = jax.tree.leaves(v["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(
+        not np.allclose(np.asarray(b), np.asarray(a)) for b, a in zip(before, after)
+    )
+
+
+def test_wide_resnet_bad_depth():
+    with pytest.raises(ValueError, match="6n"):
+        WideResNet(depth=27).init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+
+
+def test_get_model_registry():
+    assert isinstance(get_model("lenet", 10), LeNet)
+    assert isinstance(get_model("wide-resnet", 100), WideResNet)
+    assert get_model("wide-resnet", 100).num_classes == 100
+    assert get_model("ann", 10).output_dim == 10
+    with pytest.raises(ValueError, match="unknown model"):
+        get_model("transformer")
+
+
+def test_logreg_class_parity_surface():
+    # LogRegTitanic surface: fit() does one GD step returning the loss;
+    # calc_accuracy thresholds at 0.5.
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    w_true = np.asarray([1.5, -2.0, 0.5], np.float32)
+    y = np.where(X @ w_true > 0, 1, -1).astype(np.int32)
+    model = LogisticRegression(dim=3, lr=0.5, tau=1e-4)
+    losses = [model.fit(X, y) for _ in range(200)]
+    assert losses[0] > losses[-1]
+    assert model.calc_accuracy(X, y) > 0.95
+    assert model.parameters().shape == (3,)
