@@ -1,0 +1,25 @@
+"""Gossip-SGD training: MasterNode-surface trainer, checkpointing, telemetry."""
+
+from distributed_learning_tpu.training.trainer import (
+    ConsensusNode,
+    GossipTrainer,
+    MasterNode,
+    get_loss,
+    get_metric,
+    make_optimizer,
+)
+from distributed_learning_tpu.training.checkpoint import (
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = [
+    "ConsensusNode",
+    "GossipTrainer",
+    "MasterNode",
+    "get_loss",
+    "get_metric",
+    "make_optimizer",
+    "restore_checkpoint",
+    "save_checkpoint",
+]

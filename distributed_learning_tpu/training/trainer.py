@@ -1,0 +1,621 @@
+"""Gossip-SGD trainer: the reference's documented ``MasterNode`` surface,
+rebuilt as one jitted SPMD program.
+
+The reference's gossip-CIFAR driver (``utils/master_node.py`` /
+``utils/consensus_node.py``) is **absent from its snapshot** — only its full
+constructor surface survives, documented in ``Man_Colab.ipynb`` cell 21:
+
+    MasterNode(node_names, model, model_args, optimizer, optimizer_kwargs,
+               error, weights, train_loaders, test_loader, stat_step, epoch,
+               epoch_len, epoch_cons_num)
+    master.initialize_nodes(); master.start_consensus()
+    node.show_graphs() for node in master.network.values()
+
+Semantics (per the notebook's comments): train each named node for an epoch
+on its own loader, then average parameters per the ``weights`` topology dict,
+starting from epoch ``epoch_cons_num``; record per-node statistics every
+``stat_step`` batches; evaluate every node on the common test loader.
+
+TPU-native design: all N node replicas live as a leading *agent* axis
+(stacked pytrees).  An epoch is a ``lax.scan`` over batches of a ``vmap``-ped
+train step — N forward/backward passes batched onto the MXU — and mixing is
+a :class:`~distributed_learning_tpu.parallel.consensus.ConsensusEngine`
+round.  Only *parameters* are mixed; optimizer slots and BatchNorm running
+stats stay per-node (parity: torch ``model.parameters()`` excludes buffers,
+``mixer.py:68-69``).  All nodes start from one shared init, matching
+``master.initialize_nodes()`` (averaging differently-initialized nets is
+destructive under permutation symmetry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Hashable, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_learning_tpu.models import get_model
+from distributed_learning_tpu.ops import mixing as ops
+from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+from distributed_learning_tpu.parallel.topology import Topology
+from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
+
+Pytree = Any
+
+__all__ = ["MasterNode", "ConsensusNode", "GossipTrainer", "make_optimizer", "get_loss"]
+
+
+# ---------------------------------------------------------------------- #
+# Loss / optimizer registries                                            #
+# ---------------------------------------------------------------------- #
+def get_loss(error: Any) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Resolve the reference's ``error`` argument (a loss) to a function
+    ``(logits, labels) -> scalar``.
+
+    ``'cross_entropy'`` (integer labels; the reference uses
+    ``nn.CrossEntropyLoss``) and ``'binary_logistic'`` ({-1,+1} labels, the
+    Titanic loss) are built in; custom callables ``(logits, y) -> scalar``
+    pass through unchanged.
+    """
+    if error is None or error == "cross_entropy":
+        return lambda logits, y: optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+    if error == "binary_logistic":
+        return lambda margin, y: jnp.mean(jax.nn.softplus(-y * margin.squeeze(-1)))
+    if callable(error):
+        return error
+    raise ValueError(f"unknown loss {error!r}")
+
+
+def get_metric(error: Any) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Accuracy metric matching the loss: multiclass argmax for
+    cross-entropy-style losses, sign agreement for the binary {-1,+1}
+    margin loss."""
+    if error == "binary_logistic":
+        return lambda margin, y: jnp.mean(
+            (jnp.sign(margin.squeeze(-1)) == y).astype(jnp.float32)
+        )
+    return lambda logits, y: jnp.mean(
+        (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+    )
+
+
+def make_optimizer(
+    optimizer: Any = "sgd",
+    optimizer_kwargs: Optional[Mapping[str, Any]] = None,
+    learning_rate: float | optax.Schedule = 0.02,
+) -> optax.GradientTransformation:
+    """Resolve the reference's ``optimizer`` / ``optimizer_kwargs`` pair.
+
+    Accepts optax transformations directly, factory callables
+    ``f(learning_rate, **kwargs)``, or the names ``'sgd'`` / ``'adam'`` with
+    torch-style kwargs (``momentum``, ``weight_decay``, ``nesterov``) — the
+    reference passes ``optim.SGD`` with
+    ``{'momentum': 0.9, 'weight_decay': 5e-4}`` (Man_Colab cell 19).
+    """
+    kw = dict(optimizer_kwargs or {})
+    learning_rate = kw.pop("lr", kw.pop("learning_rate", learning_rate))
+    if isinstance(optimizer, optax.GradientTransformation):
+        if dict(optimizer_kwargs or {}):
+            raise ValueError(
+                "optimizer_kwargs cannot be applied to an already-built "
+                "optax transformation; bake them into the transformation or "
+                "pass the optimizer by name/factory"
+            )
+        return optimizer
+    wd = kw.pop("weight_decay", 0.0)
+    if isinstance(optimizer, str):
+        name = optimizer.lower()
+        if name == "sgd":
+            momentum = kw.pop("momentum", 0.0) or None
+            tx = optax.sgd(
+                learning_rate, momentum=momentum, nesterov=kw.pop("nesterov", False)
+            )
+        elif name == "adam":
+            tx = optax.adam(learning_rate, **kw)
+        elif name == "adamw":
+            tx = optax.adamw(learning_rate, weight_decay=wd, **kw)
+            wd = 0.0
+        else:
+            raise ValueError(f"unknown optimizer {optimizer!r}")
+    elif callable(optimizer):
+        # torch-style class or optax factory: try factory(lr, **kwargs).
+        tx = optimizer(learning_rate, **kw)
+    else:
+        raise ValueError(f"cannot interpret optimizer {optimizer!r}")
+    if wd:
+        # torch SGD weight_decay == L2 added to the gradient before momentum;
+        # optax.add_decayed_weights before the optimizer reproduces it.
+        tx = optax.chain(optax.add_decayed_weights(wd), tx)
+    return tx
+
+
+# ---------------------------------------------------------------------- #
+# Trainer                                                                #
+# ---------------------------------------------------------------------- #
+@dataclasses.dataclass
+class _EpochStats:
+    """Host-side per-node training curves (what show_graphs plots)."""
+
+    steps: List[int] = dataclasses.field(default_factory=list)
+    train_loss: List[float] = dataclasses.field(default_factory=list)
+    train_acc: List[float] = dataclasses.field(default_factory=list)
+    test_acc: List[float] = dataclasses.field(default_factory=list)
+    test_epochs: List[int] = dataclasses.field(default_factory=list)
+
+
+class ConsensusNode:
+    """Per-node stats holder (parity: the reference's ``ConsensusNode``
+    surface used by ``node.show_graphs()``, Man_Colab cell 24)."""
+
+    def __init__(self, name: Hashable):
+        self.name = name
+        self.stats = _EpochStats()
+
+    def show_graphs(self, show: bool = False):
+        """Plot per-node loss/accuracy curves; returns the figure.  Falls
+        back to a text summary when matplotlib is unavailable."""
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg", force=False)
+            import matplotlib.pyplot as plt
+        except Exception:  # pragma: no cover - matplotlib is present in CI
+            print(self.summary())
+            return None
+        fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+        axes[0].plot(self.stats.steps, self.stats.train_loss)
+        axes[0].set_title(f"{self.name}: train loss")
+        axes[0].set_xlabel("batch")
+        axes[1].plot(self.stats.steps, self.stats.train_acc, label="train")
+        if self.stats.test_acc:
+            axes[1].plot(
+                [e for e in self.stats.test_epochs],
+                self.stats.test_acc,
+                label="test (per epoch)",
+            )
+        axes[1].set_title(f"{self.name}: accuracy")
+        axes[1].legend()
+        if show:  # pragma: no cover
+            plt.show()
+        return fig
+
+    def summary(self) -> str:
+        s = self.stats
+        last_loss = s.train_loss[-1] if s.train_loss else float("nan")
+        last_acc = s.test_acc[-1] if s.test_acc else float("nan")
+        return (
+            f"node {self.name}: {len(s.steps)} stat points, "
+            f"final train loss {last_loss:.4f}, final test acc {last_acc:.4f}"
+        )
+
+
+class GossipTrainer:
+    """Core stacked-replica gossip-SGD trainer.
+
+    Parameters mirror the MasterNode surface (see module docstring) but take
+    in-memory arrays: ``train_data[name] = (X, y)`` and
+    ``test_data = (X, y)``.
+    """
+
+    def __init__(
+        self,
+        *,
+        node_names: Sequence[Hashable],
+        model: Any,
+        model_args: Sequence[Any] = (),
+        model_kwargs: Optional[Mapping[str, Any]] = None,
+        optimizer: Any = "sgd",
+        optimizer_kwargs: Optional[Mapping[str, Any]] = None,
+        learning_rate: float = 0.02,
+        error: Any = "cross_entropy",
+        weights: Any = None,
+        train_data: Mapping[Hashable, Tuple[np.ndarray, np.ndarray]],
+        test_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        stat_step: int = 100,
+        epoch: int = 10,
+        epoch_len: Optional[int] = None,
+        epoch_cons_num: int = 1,
+        batch_size: int = 128,
+        mix_times: int = 1,
+        mix_eps: Optional[float] = None,
+        mesh=None,
+        telemetry: Optional[TelemetryProcessor] = None,
+        seed: int = 0,
+        dropout: bool = True,
+        eval_batch_size: int = 1024,
+    ):
+        self.eval_batch_size = int(eval_batch_size)
+        self.node_names = list(node_names)
+        n = len(self.node_names)
+        if n == 0:
+            raise ValueError("need at least one node")
+        if train_data is None:
+            raise ValueError(
+                "train_data (MasterNode: train_loaders) is required: a dict "
+                "mapping each node name to its (X, y) shard"
+            )
+        missing = [t for t in self.node_names if t not in train_data]
+        if missing:
+            raise ValueError(f"train_data missing for nodes: {missing}")
+
+        self.model = (
+            get_model(model, *model_args, **dict(model_kwargs or {}))
+            if isinstance(model, str)
+            else model
+        )
+        self.loss_fn = get_loss(error)
+        self.metric_fn = get_metric(error)
+        self.tx = make_optimizer(optimizer, optimizer_kwargs, learning_rate)
+        self.telemetry = telemetry
+        self.stat_step = int(stat_step)
+        self.num_epochs = int(epoch)
+        self.epoch_cons_num = int(epoch_cons_num)
+        self.batch_size = int(batch_size)
+        self.mix_times = int(mix_times)
+        self.mix_eps = mix_eps
+        self.seed = seed
+        self.dropout = dropout
+
+        # Mixing matrix: MasterNode's `weights` topology dict, a Topology
+        # (-> Metropolis), an explicit matrix, or None (isolated nodes).
+        if weights is None:
+            W = np.eye(n)
+        elif isinstance(weights, Mapping):
+            topo, W = Topology.from_neighbor_dict(weights)
+            if set(topo.tokens) != set(self.node_names):
+                raise ValueError(
+                    "weights topology must cover exactly the trainer's "
+                    f"node_names; topology has {sorted(map(str, topo.tokens))}, "
+                    f"trainer has {sorted(map(str, self.node_names))}"
+                )
+            order = [topo.tokens.index(t) for t in self.node_names]
+            W = W[np.ix_(order, order)]
+        elif isinstance(weights, Topology):
+            W = weights.metropolis_weights()
+        else:
+            W = np.asarray(weights, dtype=np.float64)
+        if W.shape != (n, n):
+            raise ValueError(f"mixing matrix shape {W.shape} != ({n}, {n})")
+        self.engine = ConsensusEngine(W, mesh=mesh)
+
+        # Static per-node data (truncated to a common batch grid).
+        self._Xs, self._ys = self._stack_data(train_data, batch_size)
+        max_len = self._Xs.shape[1] // batch_size
+        self.epoch_len = min(epoch_len or max_len, max_len)
+        if self.epoch_len < 1:
+            raise ValueError(
+                f"shards of {self._Xs.shape[1]} samples cannot fill one "
+                f"batch of {batch_size}"
+            )
+        self.test_data = None
+        if test_data is not None:
+            self.test_data = (
+                jnp.asarray(test_data[0]),
+                jnp.asarray(test_data[1]),
+            )
+
+        self.network: Dict[Hashable, ConsensusNode] = {
+            name: ConsensusNode(name) for name in self.node_names
+        }
+        self._state = None
+        self._global_step = 0
+        self._epochs_done = 0
+        self._build_jitted()
+
+    # ------------------------------------------------------------------ #
+    def _stack_data(self, train_data, batch_size):
+        n = len(self.node_names)
+        lens = [len(train_data[t][0]) for t in self.node_names]
+        m = min(lens)
+        m -= m % batch_size
+        if m == 0:
+            raise ValueError(
+                f"smallest shard ({min(lens)}) is below batch_size {batch_size}"
+            )
+        Xs = jnp.stack(
+            [jnp.asarray(train_data[t][0][:m]) for t in self.node_names]
+        )
+        ys = jnp.stack(
+            [jnp.asarray(train_data[t][1][:m]) for t in self.node_names]
+        )
+        return Xs, ys
+
+    def _build_jitted(self):
+        model, tx, loss_fn = self.model, self.tx, self.loss_fn
+        metric_fn = self.metric_fn
+        n = len(self.node_names)
+        has_dropout = self.dropout
+
+        def init_node(rng, x0):
+            variables = model.init(rng, x0, train=False)
+            return variables
+
+        def train_step(params, batch_stats, opt_state, x, y, rng):
+            def lossf(p):
+                variables = {"params": p}
+                if batch_stats is not None:
+                    variables["batch_stats"] = batch_stats
+                mutable = ["batch_stats"] if batch_stats is not None else False
+                out = model.apply(
+                    variables,
+                    x,
+                    train=True,
+                    rngs={"dropout": rng} if has_dropout else {},
+                    mutable=mutable,
+                )
+                logits, mut = out if mutable else (out, {})
+                loss = loss_fn(logits, y)
+                acc = metric_fn(logits, y)
+                return loss, (mut.get("batch_stats", None), acc)
+
+            (loss, (new_bs, acc)), grads = jax.value_and_grad(
+                lossf, has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_bs, opt_state, loss, acc
+
+        vstep = jax.vmap(train_step)
+
+        def epoch_fn(state, Xb, yb):
+            """scan over epoch_len steps of the vmapped train step.
+
+            ``Xb``: (steps, n, B, ...); ``yb``: (steps, n, B).
+            Returns state plus (steps, n) loss/acc traces.
+            """
+
+            def body(carry, batch):
+                params, bs, opt, rng = carry
+                x, y = batch
+                rng, *subs = jax.random.split(rng, n + 1)
+                subkeys = jnp.stack(subs)
+                params, bs, opt, loss, acc = vstep(params, bs, opt, x, y, subkeys)
+                return (params, bs, opt, rng), (loss, acc)
+
+            (params, bs, opt, rng), (losses, accs) = jax.lax.scan(
+                body, state, (Xb, yb)
+            )
+            return (params, bs, opt, rng), losses, accs
+
+        self._jit_epoch = jax.jit(epoch_fn)
+
+        def eval_fn(params, batch_stats, X, y):
+            def one(p, b):
+                variables = {"params": p}
+                if b is not None:
+                    variables["batch_stats"] = b
+                logits = model.apply(variables, X, train=False)
+                return metric_fn(logits, y)
+
+            if batch_stats is None:
+                return jax.vmap(lambda p: one(p, None))(params)
+            return jax.vmap(one)(params, batch_stats)
+
+        self._jit_eval = jax.jit(eval_fn)
+        self._jit_init = jax.jit(init_node)
+
+    def _eval_accuracy(self, params, bs) -> np.ndarray:
+        """Per-node test accuracy, batched over the test set so activations
+        for n_nodes x eval_batch never all materialize at once."""
+        X, y = self.test_data
+        ebs = self.eval_batch_size
+        total = np.zeros(len(self.node_names))
+        seen = 0
+        for s in range(0, len(X), ebs):
+            xb, yb = X[s : s + ebs], y[s : s + ebs]
+            accs = np.asarray(self._jit_eval(params, bs, xb, yb))
+            total += accs * len(xb)
+            seen += len(xb)
+        return total / max(seen, 1)
+
+    # ------------------------------------------------------------------ #
+    def initialize_nodes(self):
+        """Create the shared init and per-node optimizer/batch-stat state
+        (parity: ``master.initialize_nodes()``)."""
+        rng = jax.random.key(self.seed)
+        x0 = self._Xs[0, : self.batch_size]
+        variables = self._jit_init(rng, x0)
+        params0 = variables["params"]
+        bs0 = variables.get("batch_stats", None)
+        n = len(self.node_names)
+        stack = lambda t: jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (n,) + v.shape), t
+        )
+        params = stack(params0)
+        batch_stats = stack(bs0) if bs0 is not None else None
+        opt_state = jax.vmap(self.tx.init)(params)
+        self._state = (
+            self.engine.shard(params)
+            if self.engine.mesh is not None
+            else params,
+            batch_stats,
+            opt_state,
+            jax.random.key(self.seed + 1),
+        )
+        return self
+
+    # ------------------------------------------------------------------ #
+    def _epoch_batches(self, epoch_idx: int):
+        """Shuffle each node's shard and lay out (steps, n, B, ...) batches."""
+        n, m = self._Xs.shape[0], self._Xs.shape[1]
+        steps = self.epoch_len
+        rng = np.random.default_rng(self.seed * 1000 + epoch_idx)
+        idx = np.stack([rng.permutation(m)[: steps * self.batch_size] for _ in range(n)])
+        idx_j = jnp.asarray(idx)
+        gather = jax.vmap(lambda X, i: X[i])
+        Xb = gather(self._Xs, idx_j).reshape(
+            (n, steps, self.batch_size) + self._Xs.shape[2:]
+        )
+        yb = gather(self._ys, idx_j).reshape((n, steps, self.batch_size))
+        return jnp.swapaxes(Xb, 0, 1), jnp.swapaxes(yb, 0, 1)
+
+    def train_epoch(self) -> Dict[str, Any]:
+        """One epoch: local SGD on every node, then (maybe) gossip."""
+        if self._state is None:
+            self.initialize_nodes()
+        epoch_idx = self._epochs_done
+        Xb, yb = self._epoch_batches(epoch_idx)
+        self._state, losses, accs = self._jit_epoch(self._state, Xb, yb)
+        losses = np.asarray(losses)  # (steps, n)
+        accs = np.asarray(accs)
+
+        # Consensus from epoch_cons_num onward (parity: Man_Colab cell 21
+        # "the first epoch from which consensus begins"; 1-based epochs).
+        mixed = False
+        params, bs, opt, rng = self._state
+        if epoch_idx + 1 >= self.epoch_cons_num and len(self.node_names) > 1:
+            if self.mix_eps is None:
+                params = self.engine.mix(params, times=self.mix_times)
+            else:
+                params, _, _ = self.engine.mix_until(
+                    params, eps=self.mix_eps, min_times=self.mix_times
+                )
+            mixed = True
+            self._state = (params, bs, opt, rng)
+
+        # Stats every stat_step batches.
+        for s in range(0, losses.shape[0], self.stat_step):
+            chunk = slice(s, min(s + self.stat_step, losses.shape[0]))
+            for a, name in enumerate(self.node_names):
+                node = self.network[name]
+                node.stats.steps.append(self._global_step + chunk.stop)
+                node.stats.train_loss.append(float(losses[chunk, a].mean()))
+                node.stats.train_acc.append(float(accs[chunk, a].mean()))
+        self._global_step += losses.shape[0]
+        self._epochs_done += 1
+
+        test_accs = None
+        if self.test_data is not None:
+            test_accs = self._eval_accuracy(params, bs)
+            for a, name in enumerate(self.node_names):
+                node = self.network[name]
+                node.stats.test_acc.append(float(test_accs[a]))
+                node.stats.test_epochs.append(self._global_step)
+
+        payload = {
+            "epoch": epoch_idx,
+            "mixed": mixed,
+            "train_loss": losses.mean(axis=0),
+            "train_acc": accs.mean(axis=0),
+            "test_acc": test_accs,
+            "deviation": float(self.engine.max_deviation(params)),
+        }
+        if self.telemetry is not None:
+            for a, name in enumerate(self.node_names):
+                self.telemetry.process(
+                    name,
+                    {
+                        "epoch": epoch_idx,
+                        "train_loss": float(payload["train_loss"][a]),
+                        "train_acc": float(payload["train_acc"][a]),
+                        "test_acc": None
+                        if test_accs is None
+                        else float(test_accs[a]),
+                        "deviation": payload["deviation"],
+                    },
+                )
+        return payload
+
+    def start_consensus(self) -> List[Dict[str, Any]]:
+        """Run the full training schedule (parity: ``master.start_consensus()``)."""
+        return [self.train_epoch() for _ in range(self.num_epochs - self._epochs_done)]
+
+    # ------------------------------------------------------------------ #
+    @property
+    def state(self):
+        return self._state
+
+    def node_parameters(self) -> Dict[Hashable, Pytree]:
+        params = self._state[0]
+        trees = ops.unstack_tree(params, len(self.node_names))
+        return dict(zip(self.node_names, trees))
+
+    def parameter_deviation(self) -> float:
+        return float(self.engine.max_deviation(self._state[0]))
+
+    # -- checkpointing ------------------------------------------------- #
+    def save_checkpoint(self, path: str) -> None:
+        from distributed_learning_tpu.training.checkpoint import save_checkpoint
+
+        params, bs, opt, rng = self._state
+        save_checkpoint(
+            path,
+            {
+                "params": params,
+                "batch_stats": bs if bs is not None else {},
+                "opt_state": opt,
+                "rng": jax.random.key_data(rng),
+                "epochs_done": self._epochs_done,
+                "global_step": self._global_step,
+            },
+        )
+
+    def restore_checkpoint(self, path: str) -> None:
+        from distributed_learning_tpu.training.checkpoint import restore_checkpoint
+
+        if self._state is None:
+            self.initialize_nodes()
+        params, bs, opt, rng = self._state
+        template = {
+            "params": params,
+            "batch_stats": bs if bs is not None else {},
+            "opt_state": opt,
+            "rng": jax.random.key_data(rng),
+            "epochs_done": 0,
+            "global_step": 0,
+        }
+        restored = restore_checkpoint(path, template)
+        self._state = (
+            restored["params"],
+            restored["batch_stats"] if bs is not None else None,
+            restored["opt_state"],
+            jax.random.wrap_key_data(restored["rng"]),
+        )
+        self._epochs_done = int(restored["epochs_done"])
+        self._global_step = int(restored["global_step"])
+
+
+class MasterNode(GossipTrainer):
+    """Exact constructor parity with the documented reference surface
+    (``Man_Colab.ipynb`` cell 21).  ``train_loaders``/``test_loader`` accept
+    ``(X, y)`` arrays (this framework's pipelines) and are forwarded to
+    :class:`GossipTrainer` as ``train_data``/``test_data``."""
+
+    def __init__(
+        self,
+        node_names,
+        model,
+        model_args=(),
+        optimizer="sgd",
+        optimizer_kwargs=None,
+        error="cross_entropy",
+        weights=None,
+        train_loaders=None,
+        test_loader=None,
+        stat_step=100,
+        epoch=10,
+        epoch_len=None,
+        epoch_cons_num=1,
+        **kwargs,
+    ):
+        super().__init__(
+            node_names=list(node_names),
+            model=model,
+            model_args=model_args,
+            optimizer=optimizer,
+            optimizer_kwargs=optimizer_kwargs,
+            error=error,
+            weights=weights,
+            train_data=train_loaders,
+            test_data=test_loader,
+            stat_step=stat_step,
+            epoch=epoch,
+            epoch_len=epoch_len,
+            epoch_cons_num=epoch_cons_num,
+            **kwargs,
+        )

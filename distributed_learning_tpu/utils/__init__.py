@@ -1,0 +1,9 @@
+"""Utilities: telemetry hooks, logging."""
+
+from distributed_learning_tpu.utils.telemetry import (
+    CallbackTelemetry,
+    RecordingTelemetry,
+    TelemetryProcessor,
+)
+
+__all__ = ["CallbackTelemetry", "RecordingTelemetry", "TelemetryProcessor"]

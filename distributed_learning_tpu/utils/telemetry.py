@@ -1,0 +1,48 @@
+"""Telemetry hook (parity: ``utils/consensus_tcp/telemetry_processor.py``).
+
+The reference's TCP backend lets agents push opaque payloads to the master,
+which forwards them to a user-supplied ``TelemetryProcessor.process(token,
+payload)`` (``master.py:192-199``, ``agent.py:214-218``).  In the SPMD design
+there is no master process; the trainer invokes the processor host-side after
+each jitted chunk with per-agent metric payloads.  The abstract interface is
+kept identical so user subclasses port over unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Hashable, List, Tuple
+
+__all__ = ["TelemetryProcessor", "RecordingTelemetry", "CallbackTelemetry"]
+
+
+class TelemetryProcessor:
+    """Abstract telemetry sink: override :meth:`process`."""
+
+    def process(self, token: Hashable, payload: Any) -> None:
+        raise NotImplementedError
+
+
+class RecordingTelemetry(TelemetryProcessor):
+    """Appends every (token, payload) pair — handy default and test double."""
+
+    def __init__(self) -> None:
+        self.records: List[Tuple[Hashable, Any]] = []
+
+    def process(self, token: Hashable, payload: Any) -> None:
+        self.records.append((token, payload))
+
+    def by_token(self) -> Dict[Hashable, List[Any]]:
+        out: Dict[Hashable, List[Any]] = {}
+        for tok, payload in self.records:
+            out.setdefault(tok, []).append(payload)
+        return out
+
+
+class CallbackTelemetry(TelemetryProcessor):
+    """Adapts a plain function ``f(token, payload)``."""
+
+    def __init__(self, fn: Callable[[Hashable, Any], None]) -> None:
+        self._fn = fn
+
+    def process(self, token: Hashable, payload: Any) -> None:
+        self._fn(token, payload)
