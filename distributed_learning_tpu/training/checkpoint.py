@@ -32,14 +32,21 @@ def save_checkpoint(path: str, state: Any) -> None:
 
     path = os.path.abspath(path)
     tmp = path + ".tmp-save"
+    old = path + ".old-save"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     ckptr = _checkpointer()
     ckptr.save(tmp, state)
     ckptr.wait_until_finished()
+    # Two renames instead of rmtree-then-rename: at every instant either
+    # ``path`` or a fully written sibling holds a complete checkpoint.
     if os.path.exists(path):
-        shutil.rmtree(path)
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.replace(path, old)
     os.replace(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def restore_checkpoint(path: str, template: Any) -> Any:

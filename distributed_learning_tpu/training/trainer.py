@@ -74,13 +74,21 @@ def get_loss(error: Any) -> Callable[[jax.Array, jax.Array], jax.Array]:
 def get_metric(error: Any) -> Callable[[jax.Array, jax.Array], jax.Array]:
     """Accuracy metric matching the loss: multiclass argmax for
     cross-entropy-style losses, sign agreement for the binary {-1,+1}
-    margin loss."""
+    margin loss.  For custom callable losses the output width decides
+    (static under jit): single-output models are margin models."""
+
+    def sign_acc(margin, y):
+        return jnp.mean((jnp.sign(margin.squeeze(-1)) == y).astype(jnp.float32))
+
+    def argmax_acc(logits, y):
+        return jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+
     if error == "binary_logistic":
-        return lambda margin, y: jnp.mean(
-            (jnp.sign(margin.squeeze(-1)) == y).astype(jnp.float32)
-        )
-    return lambda logits, y: jnp.mean(
-        (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        return sign_acc
+    if error is None or error == "cross_entropy":
+        return argmax_acc
+    return lambda out, y: (
+        sign_acc(out, y) if out.ndim >= 1 and out.shape[-1] == 1 else argmax_acc(out, y)
     )
 
 
@@ -107,8 +115,9 @@ def make_optimizer(
                 "pass the optimizer by name/factory"
             )
         return optimizer
-    wd = kw.pop("weight_decay", 0.0)
+    wd = 0.0
     if isinstance(optimizer, str):
+        wd = kw.pop("weight_decay", 0.0)
         name = optimizer.lower()
         if name == "sgd":
             momentum = kw.pop("momentum", 0.0) or None
@@ -124,6 +133,8 @@ def make_optimizer(
             raise ValueError(f"unknown optimizer {optimizer!r}")
     elif callable(optimizer):
         # torch-style class or optax factory: try factory(lr, **kwargs).
+        # All kwargs (including weight_decay) pass through untouched — the
+        # factory owns their semantics (e.g. optax.adamw's decoupled decay).
         tx = optimizer(learning_rate, **kw)
     else:
         raise ValueError(f"cannot interpret optimizer {optimizer!r}")
@@ -277,6 +288,18 @@ class GossipTrainer:
             W = W[np.ix_(order, order)]
         elif isinstance(weights, Topology):
             W = weights.metropolis_weights()
+            if set(weights.tokens) == set(self.node_names):
+                # Align the topology's token order with node_names (same
+                # contract as the Mapping branch).
+                order = [weights.tokens.index(t) for t in self.node_names]
+                W = W[np.ix_(order, order)]
+            elif tuple(weights.tokens) != tuple(range(n)):
+                raise ValueError(
+                    "weights Topology tokens must either match node_names or "
+                    f"be 0..n-1 positional indices; topology has "
+                    f"{sorted(map(str, weights.tokens))}, trainer has "
+                    f"{sorted(map(str, self.node_names))}"
+                )
         else:
             W = np.asarray(weights, dtype=np.float64)
         if W.shape != (n, n):
@@ -316,6 +339,15 @@ class GossipTrainer:
         if m == 0:
             raise ValueError(
                 f"smallest shard ({min(lens)}) is below batch_size {batch_size}"
+            )
+        if max(lens) > m:
+            import warnings
+
+            warnings.warn(
+                f"node shards are imbalanced ({min(lens)}..{max(lens)} "
+                f"samples); every shard is truncated to {m} (the smallest, "
+                "batch-aligned) so the stacked epoch has a common batch grid",
+                stacklevel=3,
             )
         Xs = jnp.stack(
             [jnp.asarray(train_data[t][0][:m]) for t in self.node_names]
@@ -542,6 +574,8 @@ class GossipTrainer:
     def save_checkpoint(self, path: str) -> None:
         from distributed_learning_tpu.training.checkpoint import save_checkpoint
 
+        if self._state is None:
+            self.initialize_nodes()
         params, bs, opt, rng = self._state
         save_checkpoint(
             path,
