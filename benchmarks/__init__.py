@@ -1,0 +1,6 @@
+"""Benchmark harness: one script per BASELINE.json target configuration.
+
+Run everything with ``python -m benchmarks.run_all``; each script also runs
+standalone (``python -m benchmarks.bench_titanic`` etc.).  See
+``common.py`` for the output format and sizing rules.
+"""

@@ -1,0 +1,119 @@
+"""BASELINE config 2: synthetic-vector consensus, ring + Metropolis W.
+
+Two measurements:
+
+1. Gossip throughput & convergence — N agents each hold a large random
+   vector; gossip until the max deviation drops below 1e-4.  Records
+   rounds-to-1e-4 (the BASELINE.json north-star residual) and gossip
+   rounds/sec on both engine paths (dense MXU matmul; sharded ppermute when
+   a big-enough device mesh exists).
+
+2. Fastest-mixing weight solve — the 25-node Watts-Strogatz graph timed in
+   ``Fast Averaging.ipynb`` cell 4 at 176 ms wall (cvxpy SDP).  Our
+   projected-spectral solver is timed on the same graph;
+   ``vs_baseline`` = reference_time / our_time (>1 = faster).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks import common
+from distributed_learning_tpu.parallel import Topology, solve_fastest_mixing
+from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+
+SDP_REFERENCE_S = 0.176  # Fast Averaging.ipynb cell 4 (%time wall)
+
+
+def run(n_agents: int = 8, dim: int | None = None, eps: float = 1e-4):
+    if dim is None:
+        dim = 1 << 22 if common.full_scale() else (1 << 12 if common.smoke() else 1 << 16)
+    topo = Topology.ring(n_agents)
+    W = topo.metropolis_weights()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n_agents, dim)).astype(np.float32))
+
+    results = {}
+    modes = [("dense", None)]
+    mesh = common.agent_mesh_or_none(n_agents)
+    if mesh is not None:
+        modes.append(("ppermute", mesh))
+    for mode, m in modes:
+        engine = ConsensusEngine(W, mesh=m)
+        xs = engine.shard(x)
+        out, t_rounds, res = engine.mix_until(xs, eps=eps, max_rounds=5000)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        rounds = int(t_rounds)
+        # Timed fixed-rounds run (pure gossip, no residual checks).
+        warm = engine.mix(xs, times=2)
+        jax.block_until_ready(jax.tree.leaves(warm)[0])
+        with common.stopwatch() as t:
+            out2 = engine.mix(xs, times=rounds)
+            jax.block_until_ready(jax.tree.leaves(out2)[0])
+        rps = rounds / t["s"]
+        common.emit(
+            {
+                "metric": f"vector_consensus_rounds_per_sec_{mode}",
+                "value": round(rps, 2),
+                "unit": "rounds/sec",
+                "vs_baseline": None,
+                "config": "fast-averaging-ring-metropolis",
+                "rounds_to_eps": rounds,
+                "eps": eps,
+                "residual": float(res),
+                "dim": dim,
+                "n_agents": n_agents,
+                "bytes_gossiped_per_round": int(dim * 4 * n_agents),
+            }
+        )
+        results[mode] = {"rounds": rounds, "rounds_per_sec": rps}
+
+    # Chebyshev acceleration on the same problem.
+    engine = ConsensusEngine(W)
+    k_plain = results["dense"]["rounds"]
+    xs = engine.shard(x)
+    lo, hi = 1, k_plain
+    while lo < hi:  # smallest k with residual < eps (cheby is monotone-ish)
+        mid = (lo + hi) // 2
+        resid = float(engine.max_deviation(engine.mix_chebyshev(xs, times=mid)))
+        if resid < eps:
+            hi = mid
+        else:
+            lo = mid + 1
+    k_cheby = lo
+    common.emit(
+        {
+            "metric": "vector_consensus_chebyshev_round_reduction",
+            "value": round(k_plain / max(k_cheby, 1), 3),
+            "unit": "x fewer rounds",
+            "vs_baseline": None,
+            "config": "fast-averaging-ring-metropolis",
+            "rounds_plain": k_plain,
+            "rounds_chebyshev": k_cheby,
+        }
+    )
+
+    # SDP solve wall-clock on the reference's 25-node Watts-Strogatz graph.
+    ws = Topology.watts_strogatz(25, 4, 0.3, seed=0)
+    solve_fastest_mixing(ws)  # warm (first call may pay numpy setup)
+    with common.stopwatch() as t:
+        weights, gamma = solve_fastest_mixing(ws)
+    common.emit(
+        {
+            "metric": "fastest_mixing_solve_ws25",
+            "value": round(t["s"] * 1e3, 2),
+            "unit": "ms",
+            "vs_baseline": round(SDP_REFERENCE_S / t["s"], 3),
+            "config": "fast-averaging-ring-metropolis",
+            "gamma": float(gamma),
+        }
+    )
+    results["sdp_ms"] = t["s"] * 1e3
+    results["cheby_reduction"] = k_plain / max(k_cheby, 1)
+    return results
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,87 @@
+"""Shared utilities for the benchmark harness.
+
+Each script in this package is one of the five target configurations from
+the driver's ``BASELINE.json`` (``configs`` list).  Every script prints one
+JSON line per recorded metric:
+
+    {"metric": str, "value": float, "unit": str, "vs_baseline": float|null,
+     "config": str, "platform": str, ...}
+
+``vs_baseline`` is the ratio versus the corresponding recorded reference
+number from ``BASELINE.md`` when one exists (>1.0 = better), else null.
+
+Sizing: on TPU (or with ``BENCH_FULL=1``) the full problem sizes run; on CPU
+each script shrinks to a smoke-test size so the whole harness stays runnable
+anywhere (the CI smoke test uses ``BENCH_SMOKE=1`` for the smallest sizes).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "platform",
+    "full_scale",
+    "smoke",
+    "emit",
+    "stopwatch",
+    "agent_mesh_or_none",
+]
+
+
+def platform() -> str:
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        with contextlib.suppress(Exception):
+            jax.config.update("jax_platforms", "cpu")
+    return jax.devices()[0].platform
+
+
+def full_scale() -> bool:
+    """Full problem sizes: on real TPU hardware or when forced."""
+    if os.environ.get("BENCH_SMOKE") == "1":
+        return False
+    return platform() == "tpu" or os.environ.get("BENCH_FULL") == "1"
+
+
+def smoke() -> bool:
+    return os.environ.get("BENCH_SMOKE") == "1"
+
+
+def emit(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Print one JSON metric line (and append to $BENCH_OUT if set)."""
+    record = dict(record)
+    record.setdefault("platform", platform())
+    line = json.dumps(record)
+    print(line, flush=True)
+    out = os.environ.get("BENCH_OUT")
+    if out:
+        with open(out, "a") as f:
+            f.write(line + "\n")
+    return record
+
+
+@contextlib.contextmanager
+def stopwatch() -> Iterator[Dict[str, float]]:
+    """``with stopwatch() as t: ...; t['s']`` — wall seconds of the block."""
+    box: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    try:
+        yield box
+    finally:
+        box["s"] = time.perf_counter() - t0
+
+
+def agent_mesh_or_none(n: int):
+    """An n-agent mesh when n devices exist, else None (dense fallback)."""
+    from distributed_learning_tpu.parallel.consensus import make_agent_mesh
+
+    if len(jax.devices()) >= n:
+        return make_agent_mesh(n)
+    return None

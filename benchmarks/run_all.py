@@ -1,0 +1,45 @@
+"""Run all five BASELINE.json benchmark configurations in sequence.
+
+Each emits JSON metric lines (see ``common.py``); set ``BENCH_OUT=path`` to
+also append every line to a file.
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+
+from benchmarks import (
+    bench_titanic,
+    bench_fast_averaging,
+    bench_cifar_mlp,
+    bench_cifar_wrn,
+    bench_timevarying,
+)
+
+CONFIGS = [
+    ("1: Titanic logreg consensus-GD (4 workers, ring)", bench_titanic.run),
+    ("2: synthetic-vector consensus (ring + Metropolis)", bench_fast_averaging.run),
+    ("3: CIFAR-10 ann_model gossip-SGD (8 workers, torus)", bench_cifar_mlp.run),
+    ("4: CIFAR-10 WRN gossip-SGD (ring)", bench_cifar_wrn.run),
+    ("5: CIFAR-100 WRN time-varying + Chebyshev", bench_timevarying.run),
+]
+
+
+def main() -> int:
+    failed = []
+    for name, fn in CONFIGS:
+        print(f"# config {name}", file=sys.stderr, flush=True)
+        try:
+            fn()
+        except Exception:
+            traceback.print_exc()
+            failed.append(name)
+    if failed:
+        print(f"# FAILED: {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

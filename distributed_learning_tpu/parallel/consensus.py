@@ -119,6 +119,22 @@ class ConsensusEngine:
             )
         return acc
 
+    def _local_allgather_mix(self, x: Pytree, W_row: jax.Array) -> Pytree:
+        """One gossip round against a *traced* mixing row: all_gather the
+        agent axis and contract with this device's row of W (masked
+        all-to-all — the dynamic-topology fallback when no static ppermute
+        schedule exists)."""
+
+        def leaf(v: jax.Array) -> jax.Array:
+            ag = lax.all_gather(v, self.axis_name, axis=0, tiled=True)
+            vf = ag.astype(jnp.float32).reshape(self.n, -1)
+            out = jnp.matmul(
+                W_row.astype(jnp.float32), vf, precision=self.precision
+            )
+            return out.reshape(v.shape).astype(v.dtype)
+
+        return jax.tree.map(leaf, x)
+
     def _local_sq_deviation(self, x: Pytree) -> jax.Array:
         """This agent's squared L2 distance from the global mean vector."""
         total = jnp.float32(0.0)
@@ -192,6 +208,40 @@ class ConsensusEngine:
                 lambda x: self._run_chebyshev(x, omegas)
             )
         return self._jit_cache[key](stacked)
+
+    def mix_with(self, stacked: Pytree, W, times: int = 1) -> Pytree:
+        """Run ``times`` gossip rounds under a *traced* mixing matrix ``W``.
+
+        This is the time-varying-graph path (BASELINE config 5: "time-varying
+        random graph"): the compiled program takes ``W`` as a runtime
+        argument, so resampling the topology every epoch costs a host->device
+        transfer of an (n, n) matrix instead of a recompilation.
+
+        Dense mode contracts with ``W`` directly.  Sharded mode cannot bake a
+        ppermute schedule (the edge set is dynamic), so it emulates the
+        general graph with a masked all-to-all: each device ``all_gather``-s
+        the agent axis and contracts with its own row of ``W`` (the
+        "emulating general graphs with masked all-to-all" strategy for
+        arbitrary topologies on a physical ring/torus).
+        """
+        W = jnp.asarray(W, dtype=jnp.float32)
+        if W.shape != (self.n, self.n):
+            raise ValueError(f"W must have shape ({self.n}, {self.n}), got {W.shape}")
+        return self._get_jitted("mix_with")(stacked, W, jnp.int32(times))
+
+    def mix_chebyshev_with(self, stacked: Pytree, W, omegas) -> Pytree:
+        """Chebyshev-accelerated gossip under a traced ``W`` and traced
+        ``omegas`` schedule (host-computed from that round's graph via
+        :func:`~distributed_learning_tpu.parallel.schedule.chebyshev_omegas`).
+
+        Only the *number* of rounds is static; changing the graph or its
+        gamma between epochs reuses the compiled program.
+        """
+        W = jnp.asarray(W, dtype=jnp.float32)
+        if W.shape != (self.n, self.n):
+            raise ValueError(f"W must have shape ({self.n}, {self.n}), got {W.shape}")
+        omegas = jnp.asarray(omegas, dtype=jnp.float32)
+        return self._get_jitted("mix_chebyshev_with")(stacked, W, omegas)
 
     def run_round(
         self,
@@ -268,6 +318,22 @@ class ConsensusEngine:
                 fn = wrap(ops.agent_deviations)
             elif name == "max_std":
                 fn = wrap(ops.max_std)
+            elif name == "mix_with":
+                fn = wrap(
+                    lambda x, W, t: self._run_times(
+                        x,
+                        t,
+                        lambda s: ops.dense_mix(s, W, precision=self.precision),
+                    )
+                )
+            elif name == "mix_chebyshev_with":
+                fn = wrap(
+                    lambda x, W, om: self._cheby_traced(
+                        x,
+                        om,
+                        lambda s: ops.dense_mix(s, W, precision=self.precision),
+                    )
+                )
             else:
                 raise KeyError(name)
         else:
@@ -331,6 +397,20 @@ class ConsensusEngine:
                     return m
 
                 fn = sharded(local_max_std, P())
+            elif name == "mix_with":
+                def local_mw(x, W_rows, t):
+                    return self._run_times(
+                        x, t, lambda s: self._local_allgather_mix(s, W_rows)
+                    )
+
+                fn = sharded(local_mw, P(ax), extra_in=(P(ax), P()))
+            elif name == "mix_chebyshev_with":
+                def local_cw(x, W_rows, om):
+                    return self._cheby_traced(
+                        x, om, lambda s: self._local_allgather_mix(s, W_rows)
+                    )
+
+                fn = sharded(local_cw, P(ax), extra_in=(P(ax), P()))
             else:
                 raise KeyError(name)
 
@@ -382,6 +462,33 @@ class ConsensusEngine:
             in_specs=(P(ax), P(ax), P(None, ax)),
             out_specs=P(ax),
         )(x, self._self_w, self._match_w)
+
+    @staticmethod
+    def _cheby_traced(x: Pytree, omegas: jax.Array, mix_once) -> Pytree:
+        """Chebyshev recurrence with a *traced* omega schedule: a lax.scan
+        over omegas[1:], so only the round count is compile-time static."""
+        k = omegas.shape[0]
+        if k == 0:
+            return x
+        x_prev, xk = x, mix_once(x)  # omega_1 = 1 step
+        if k == 1:
+            return xk
+
+        def body(carry, om):
+            prev, cur = carry
+            wx = mix_once(cur)
+            nxt = jax.tree.map(
+                lambda wv, pv: (
+                    om * (wv.astype(jnp.float32) - pv.astype(jnp.float32))
+                    + pv.astype(jnp.float32)
+                ).astype(wv.dtype),
+                wx,
+                prev,
+            )
+            return (cur, nxt), None
+
+        (_, xk), _ = lax.scan(body, (x_prev, xk), omegas[1:])
+        return xk
 
     @staticmethod
     def _cheby_loop(x: Pytree, omegas: np.ndarray, mix_once) -> Pytree:

@@ -40,12 +40,20 @@ import optax
 from distributed_learning_tpu.models import get_model
 from distributed_learning_tpu.ops import mixing as ops
 from distributed_learning_tpu.parallel.consensus import ConsensusEngine
-from distributed_learning_tpu.parallel.topology import Topology
+from distributed_learning_tpu.parallel.schedule import chebyshev_omegas
+from distributed_learning_tpu.parallel.topology import Topology, gamma as mixing_gamma
 from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
 
 Pytree = Any
 
-__all__ = ["MasterNode", "ConsensusNode", "GossipTrainer", "make_optimizer", "get_loss"]
+__all__ = [
+    "MasterNode",
+    "ConsensusNode",
+    "GossipTrainer",
+    "make_optimizer",
+    "get_loss",
+    "resolve_mixing_matrix",
+]
 
 
 # ---------------------------------------------------------------------- #
@@ -145,6 +153,51 @@ def make_optimizer(
     return tx
 
 
+def resolve_mixing_matrix(weights: Any, node_names: Sequence[Hashable]) -> np.ndarray:
+    """Resolve MasterNode's ``weights`` argument to an (n, n) mixing matrix
+    aligned with ``node_names`` order.
+
+    Accepts the reference's ``{agent: {neighbor: weight}}`` topology dict
+    (``Man_Colab.ipynb`` cell 14), a :class:`Topology` (-> Metropolis
+    weights), an explicit matrix, or ``None`` (isolated nodes).
+    """
+    n = len(node_names)
+    if weights is None:
+        return np.eye(n)
+    if isinstance(weights, Mapping):
+        topo, W = Topology.from_neighbor_dict(weights)
+        if set(topo.tokens) != set(node_names):
+            raise ValueError(
+                "weights topology must cover exactly the trainer's "
+                f"node_names; topology has {sorted(map(str, topo.tokens))}, "
+                f"trainer has {sorted(map(str, node_names))}"
+            )
+        order = [topo.tokens.index(t) for t in node_names]
+        return W[np.ix_(order, order)]
+    if isinstance(weights, Topology):
+        W = weights.metropolis_weights()
+        if set(weights.tokens) == set(node_names):
+            # Align the topology's token order with node_names (same
+            # contract as the Mapping branch).
+            order = [weights.tokens.index(t) for t in node_names]
+            return W[np.ix_(order, order)]
+        if set(weights.tokens) == set(range(n)):
+            # Positional indices (in any order — from_edges orders tokens by
+            # first appearance): index i maps to node_names[i].
+            order = [weights.tokens.index(i) for i in range(n)]
+            return W[np.ix_(order, order)]
+        raise ValueError(
+            "weights Topology tokens must either match node_names or "
+            f"be 0..n-1 positional indices; topology has "
+            f"{sorted(map(str, weights.tokens))}, trainer has "
+            f"{sorted(map(str, node_names))}"
+        )
+    W = np.asarray(weights, dtype=np.float64)
+    if W.shape != (n, n):
+        raise ValueError(f"mixing matrix shape {W.shape} != ({n}, {n})")
+    return W
+
+
 # ---------------------------------------------------------------------- #
 # Trainer                                                                #
 # ---------------------------------------------------------------------- #
@@ -234,6 +287,8 @@ class GossipTrainer:
         batch_size: int = 128,
         mix_times: int = 1,
         mix_eps: Optional[float] = None,
+        topology_schedule: Optional[Callable[[int], Any]] = None,
+        chebyshev: bool = False,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         seed: int = 0,
@@ -274,37 +329,37 @@ class GossipTrainer:
 
         # Mixing matrix: MasterNode's `weights` topology dict, a Topology
         # (-> Metropolis), an explicit matrix, or None (isolated nodes).
-        if weights is None:
-            W = np.eye(n)
-        elif isinstance(weights, Mapping):
-            topo, W = Topology.from_neighbor_dict(weights)
-            if set(topo.tokens) != set(self.node_names):
-                raise ValueError(
-                    "weights topology must cover exactly the trainer's "
-                    f"node_names; topology has {sorted(map(str, topo.tokens))}, "
-                    f"trainer has {sorted(map(str, self.node_names))}"
-                )
-            order = [topo.tokens.index(t) for t in self.node_names]
-            W = W[np.ix_(order, order)]
-        elif isinstance(weights, Topology):
-            W = weights.metropolis_weights()
-            if set(weights.tokens) == set(self.node_names):
-                # Align the topology's token order with node_names (same
-                # contract as the Mapping branch).
-                order = [weights.tokens.index(t) for t in self.node_names]
-                W = W[np.ix_(order, order)]
-            elif tuple(weights.tokens) != tuple(range(n)):
-                raise ValueError(
-                    "weights Topology tokens must either match node_names or "
-                    f"be 0..n-1 positional indices; topology has "
-                    f"{sorted(map(str, weights.tokens))}, trainer has "
-                    f"{sorted(map(str, self.node_names))}"
-                )
-        else:
-            W = np.asarray(weights, dtype=np.float64)
-        if W.shape != (n, n):
-            raise ValueError(f"mixing matrix shape {W.shape} != ({n}, {n})")
+        # With a topology_schedule, epoch e mixes with
+        # resolve_mixing_matrix(topology_schedule(e)) through the engine's
+        # traced-W path (time-varying graphs, BASELINE config 5); `weights`
+        # then only seeds the engine (residual metrics, mesh placement).
+        self.topology_schedule = topology_schedule
+        self.chebyshev = bool(chebyshev)
+        if self.chebyshev and mix_eps is not None:
+            raise ValueError(
+                "mix_eps (eps-stopping) and chebyshev (fixed accelerated "
+                "schedule) are mutually exclusive; pick one stopping rule"
+            )
+        if topology_schedule is not None and mix_eps is not None:
+            raise ValueError(
+                "mix_eps is not supported with topology_schedule; "
+                "time-varying mixing runs a fixed mix_times rounds per epoch"
+            )
+        if weights is None and topology_schedule is not None:
+            weights = topology_schedule(0)
+        W = resolve_mixing_matrix(weights, self.node_names)
         self.engine = ConsensusEngine(W, mesh=mesh)
+        if (
+            self.chebyshev
+            and topology_schedule is None
+            and n > 1
+            and not (0.0 <= self.engine.gamma < 1.0)
+        ):
+            raise ValueError(
+                "chebyshev=True needs a connected mixing graph with "
+                f"gamma < 1; got gamma={self.engine.gamma} (weights="
+                f"{'None (isolated nodes)' if weights is None else 'given'})"
+            )
 
         # Static per-node data (truncated to a common batch grid).
         self._Xs, self._ys = self._stack_data(train_data, batch_size)
@@ -501,7 +556,27 @@ class GossipTrainer:
         mixed = False
         params, bs, opt, rng = self._state
         if epoch_idx + 1 >= self.epoch_cons_num and len(self.node_names) > 1:
-            if self.mix_eps is None:
+            if self.topology_schedule is not None:
+                # Time-varying graph: resample, resolve, mix via the
+                # traced-W path (no recompilation per epoch).
+                W_e = resolve_mixing_matrix(
+                    self.topology_schedule(epoch_idx), self.node_names
+                )
+                if self.chebyshev:
+                    g_e = mixing_gamma(W_e)
+                    if not (0.0 <= g_e < 1.0):
+                        raise ValueError(
+                            f"topology_schedule({epoch_idx}) produced a "
+                            f"graph with gamma={g_e}; Chebyshev acceleration "
+                            "needs a connected graph with gamma < 1"
+                        )
+                    omegas = chebyshev_omegas(g_e, self.mix_times)
+                    params = self.engine.mix_chebyshev_with(params, W_e, omegas)
+                else:
+                    params = self.engine.mix_with(params, W_e, times=self.mix_times)
+            elif self.chebyshev:
+                params = self.engine.mix_chebyshev(params, times=self.mix_times)
+            elif self.mix_eps is None:
                 params = self.engine.mix(params, times=self.mix_times)
             else:
                 params, _, _ = self.engine.mix_until(

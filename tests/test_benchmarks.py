@@ -1,0 +1,56 @@
+"""Smoke tests for the benchmark harness: every BASELINE.json config runs
+at its smallest size on the virtual CPU mesh and emits sane metrics."""
+
+import json
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _smoke_env(monkeypatch):
+    monkeypatch.setenv("BENCH_SMOKE", "1")
+    monkeypatch.delenv("BENCH_FULL", raising=False)
+    monkeypatch.delenv("BENCH_OUT", raising=False)
+
+
+def test_bench_titanic_smoke(capsys):
+    from benchmarks import bench_titanic
+
+    out = bench_titanic.run(iters=50)
+    assert out["spread"] < 1e-5  # all agents agree after mix_until
+    assert 0.4 < np.mean(out["accs"]) <= 1.0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert {r["metric"] for r in lines} == {
+        "titanic_consensus_gd_iters_per_sec",
+        "titanic_consensus_gd_test_accuracy",
+    }
+    for r in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
+
+
+def test_bench_fast_averaging_smoke(capsys):
+    from benchmarks import bench_fast_averaging
+
+    out = bench_fast_averaging.run(n_agents=8, dim=1 << 10)
+    assert out["dense"]["rounds"] > 0
+    assert out["cheby_reduction"] >= 1.0
+    # 8 CPU devices exist in the test harness -> the sharded path must run.
+    assert "ppermute" in out
+
+
+def test_bench_cifar_mlp_smoke(capsys):
+    from benchmarks import bench_cifar_mlp
+
+    out = bench_cifar_mlp.run(epochs=1)
+    assert out["samples_per_sec"] > 0
+    assert np.isfinite(out["final"]["deviation"])
+
+
+def test_bench_timevarying_smoke(capsys):
+    from benchmarks import bench_timevarying
+
+    out = bench_timevarying.run(epochs=1)
+    assert out["samples_per_sec"] > 0
+    # Chebyshev can't be worse than plain over the same graph sequence.
+    assert out["rounds_chebyshev"] <= out["rounds_plain"]

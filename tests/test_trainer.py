@@ -243,3 +243,81 @@ def test_binary_logistic_metric_reports_sign_accuracy():
     # multiclass default still argmax
     logits = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
     assert float(get_metric("cross_entropy")(logits, jnp.asarray([1, 0]))) == 1.0
+
+
+def test_time_varying_topology_schedule_with_chebyshev():
+    """BASELINE config-5 shape: the trainer resamples a random graph every
+    epoch and mixes with a per-epoch Chebyshev schedule."""
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(0)
+    names = list(range(4))
+    train = {
+        i: (
+            rng.normal(size=(64, 8)).astype(np.float32),
+            rng.integers(0, 3, size=(64,)).astype(np.int32),
+        )
+        for i in names
+    }
+    seen = []
+
+    def schedule(epoch):
+        topo = Topology.erdos_renyi(4, 0.6, seed=500 + epoch)
+        seen.append(epoch)
+        return topo
+
+    tr = GossipTrainer(
+        node_names=names,
+        model="mlp",
+        model_kwargs={"hidden_dim": 16, "output_dim": 3},
+        error="cross_entropy",
+        train_data=train,
+        topology_schedule=schedule,
+        chebyshev=True,
+        mix_times=3,
+        batch_size=16,
+        epoch=2,
+        stat_step=2,
+        dropout=False,
+    )
+    tr.initialize_nodes()
+    out0 = tr.train_epoch()
+    out1 = tr.train_epoch()
+    assert out0["mixed"] and out1["mixed"]
+    # schedule(0) seeds the engine, then each epoch resolves its own graph.
+    assert seen == [0, 0, 1]
+    assert np.isfinite(out1["deviation"])
+
+
+def test_chebyshev_config_validation():
+    """Conflicting or unusable chebyshev configs fail at construction, not
+    mid-training."""
+    rng = np.random.default_rng(0)
+    train = {
+        i: (
+            rng.normal(size=(32, 4)).astype(np.float32),
+            rng.integers(0, 2, size=(32,)).astype(np.int32),
+        )
+        for i in range(3)
+    }
+    kw = dict(
+        node_names=[0, 1, 2],
+        model="mlp",
+        model_kwargs={"hidden_dim": 8, "output_dim": 2},
+        train_data=train,
+        batch_size=8,
+        dropout=False,
+    )
+    # weights=None -> isolated nodes -> gamma=1: chebyshev is meaningless.
+    with pytest.raises(ValueError, match="gamma"):
+        GossipTrainer(chebyshev=True, **kw)
+    # eps-stopping and the fixed chebyshev schedule are mutually exclusive.
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        GossipTrainer(chebyshev=True, mix_eps=1e-4, **kw)
+    # eps-stopping is undefined under a time-varying schedule.
+    with pytest.raises(ValueError, match="topology_schedule"):
+        from distributed_learning_tpu.parallel.topology import Topology
+
+        GossipTrainer(
+            topology_schedule=lambda e: Topology.ring(3), mix_eps=1e-4, **kw
+        )
