@@ -1,0 +1,37 @@
+"""Multi-process communication backend (control plane + CPU data plane).
+
+The reference ships three backends (SURVEY.md §2): shared-memory
+(``consensus_simple``), asyncio queues (``consensus_asyncio``), and
+TCP+pickle (``consensus_tcp``).  In this framework the first two collapse
+into the compiled SPMD engine (``parallel/consensus.py``: dense mode is
+the shared-memory analogue, the CPU virtual mesh is the simulator).  This
+package is the third: a genuinely multi-process master/agent deployment
+over TCP for hosts that are *not* members of one jax.distributed mesh —
+with typed binary framing (no pickle), crc32 integrity, bf16 wire
+compression through the native codec, and the round protocol the
+reference's TCP backend left broken (stub ``run_round``, uninitialized
+master round state).
+
+For TPU pods, prefer ``parallel/multihost.py`` (XLA collectives over
+ICI/DCN); this backend is the interoperability / heterogeneous-cluster
+path.
+"""
+
+from distributed_learning_tpu.comm.agent import AgentStatus, ConsensusAgent, ShutdownError
+from distributed_learning_tpu.comm.framing import FramedStream, FrameError, open_framed_connection
+from distributed_learning_tpu.comm.master import ConsensusMaster
+from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
+from distributed_learning_tpu.comm.tensor_codec import decode_tensor, encode_tensor
+
+__all__ = [
+    "AgentStatus",
+    "ConsensusAgent",
+    "ConsensusMaster",
+    "FramedStream",
+    "FrameError",
+    "ShutdownError",
+    "StreamMultiplexer",
+    "open_framed_connection",
+    "encode_tensor",
+    "decode_tensor",
+]

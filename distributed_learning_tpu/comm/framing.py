@@ -1,0 +1,95 @@
+"""Length-prefixed binary framing over asyncio streams.
+
+Parity: ``utils/consensus_tcp/pickled_socket.py:3-23``
+(``PickledSocketWrapper``: 16-byte little-endian length header + pickled
+payload).  This replacement keeps the same role — ``send(msg)`` /
+``recv()`` over an asyncio stream — with a safe frame:
+
+    u32 body_len | u8 version | u8 msg_type | u16 reserved |
+    body | u32 crc32(body)
+
+No pickle anywhere; bodies are the typed messages of ``protocol.py`` and
+the crc (native codec when available) rejects torn or corrupt frames.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional
+
+from distributed_learning_tpu import native
+from distributed_learning_tpu.comm.protocol import Message, pack_message, unpack_message
+
+__all__ = ["FramedStream", "FrameError", "open_framed_connection"]
+
+WIRE_VERSION = 1
+_HEADER = struct.Struct("<IBBH")
+MAX_FRAME = 1 << 31  # 2 GiB: a full WRN-28-10 f32 vector is ~146 MB
+
+
+class FrameError(ConnectionError):
+    """Corrupt or protocol-violating frame."""
+
+
+class FramedStream:
+    """``send(Message)`` / ``recv() -> Message`` over one TCP connection."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self._send_lock = asyncio.Lock()
+
+    @property
+    def peername(self):
+        return self.writer.get_extra_info("peername")
+
+    async def send(self, msg: Message) -> None:
+        code, body = pack_message(msg)
+        if len(body) > MAX_FRAME:
+            raise FrameError(f"frame of {len(body)} bytes exceeds {MAX_FRAME}")
+        crc = native.crc32(body)
+        header = _HEADER.pack(len(body), WIRE_VERSION, code, 0)
+        async with self._send_lock:
+            self.writer.write(header + body + struct.pack("<I", crc))
+            await self.writer.drain()
+
+    async def recv(self) -> Message:
+        header = await self.reader.readexactly(_HEADER.size)
+        length, version, code, _ = _HEADER.unpack(header)
+        if version != WIRE_VERSION:
+            raise FrameError(f"wire version {version} != {WIRE_VERSION}")
+        if length > MAX_FRAME:
+            raise FrameError(f"frame of {length} bytes exceeds {MAX_FRAME}")
+        body = await self.reader.readexactly(length)
+        (crc,) = struct.unpack("<I", await self.reader.readexactly(4))
+        if native.crc32(body) != crc:
+            raise FrameError("frame checksum mismatch")
+        return unpack_message(code, body)
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    async def wait_closed(self) -> None:
+        try:
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def open_framed_connection(
+    host: str, port: int, *, retries: int = 20, delay: float = 0.1
+) -> FramedStream:
+    """Connect with retry (peers race to start their servers)."""
+    last: Optional[Exception] = None
+    for _ in range(retries):
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            return FramedStream(reader, writer)
+        except OSError as e:
+            last = e
+            await asyncio.sleep(delay)
+    raise ConnectionError(f"cannot connect to {host}:{port}: {last}")

@@ -1,0 +1,81 @@
+"""Async select() over many framed sockets.
+
+Parity: ``utils/consensus_tcp/psocket_multiplexer.py:7-36``
+(``PSocketMultiplexer``): an async iterator yielding
+``(token, message, stream)`` from whichever registered socket produces a
+frame first, built on ``asyncio.wait(FIRST_COMPLETED)`` with pending reads
+carried between iterations (:19-31).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Dict, Hashable, Optional, Tuple
+
+from distributed_learning_tpu.comm.framing import FramedStream
+from distributed_learning_tpu.comm.protocol import Message
+
+__all__ = ["StreamMultiplexer"]
+
+
+class StreamMultiplexer:
+    """``async for token, msg, stream in mux:`` over a dynamic socket set."""
+
+    def __init__(self, streams: Optional[Dict[Hashable, FramedStream]] = None):
+        self._streams: Dict[Hashable, FramedStream] = dict(streams or {})
+        self._pending: Dict[Hashable, asyncio.Task] = {}
+        self._closed = False
+
+    def add(self, token: Hashable, stream: FramedStream) -> None:
+        self._streams[token] = stream
+
+    def remove(self, token: Hashable) -> None:
+        self._streams.pop(token, None)
+        task = self._pending.pop(token, None)
+        if task is not None:
+            task.cancel()
+
+    def tokens(self):
+        return tuple(self._streams)
+
+    def close(self) -> None:
+        self._closed = True
+        for task in self._pending.values():
+            task.cancel()
+        self._pending.clear()
+
+    def __aiter__(self) -> AsyncIterator[Tuple[Hashable, Optional[Message], Optional[FramedStream]]]:
+        return self
+
+    async def __anext__(self):
+        """Yields ``(token, msg, stream)``; a dead peer yields
+        ``(token, None, None)`` exactly once so the caller can decide how to
+        handle the loss (silently shrinking the set would leave callers
+        waiting on a response count that can never be reached)."""
+        if self._closed:
+            raise StopAsyncIteration
+        while True:
+            for token, stream in self._streams.items():
+                if token not in self._pending:
+                    task = asyncio.ensure_future(stream.recv())
+                    # Retrieve exceptions even if this task outlives every
+                    # __anext__ call (e.g. connection dies after close()).
+                    task.add_done_callback(
+                        lambda t: t.exception() if not t.cancelled() else None
+                    )
+                    self._pending[token] = task
+            if not self._pending:
+                raise StopAsyncIteration
+            done, _ = await asyncio.wait(
+                self._pending.values(), return_when=asyncio.FIRST_COMPLETED
+            )
+            for token in list(self._pending):
+                task = self._pending[token]
+                if task in done:
+                    del self._pending[token]
+                    try:
+                        msg = task.result()
+                    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                        self._streams.pop(token, None)
+                        return token, None, None
+                    return token, msg, self._streams[token]

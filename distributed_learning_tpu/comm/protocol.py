@@ -1,0 +1,369 @@
+"""Typed message protocol for the multi-process comm backend.
+
+Parity: ``utils/consensus_tcp/protocol.py:4-84`` — the same message set and
+invariants ("every request gets exactly one response"; agents talk to the
+master for control and to each other for data), but messages serialize to a
+fixed binary layout instead of pickle (see the reference's
+``ProtoErrorException``/dataclass definitions at :15-84 and the security
+note in SURVEY.md §2: pickle-over-TCP must not survive into the new
+design).
+
+Every message is a dataclass with a one-byte type code and explicit
+``_pack``/``_unpack`` methods; tensors go through
+:mod:`~distributed_learning_tpu.comm.tensor_codec`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+from typing import Any, ClassVar, Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+from distributed_learning_tpu.comm.tensor_codec import decode_tensor, encode_tensor
+
+__all__ = [
+    "Message",
+    "Register",
+    "Ok",
+    "ErrorException",
+    "Neighbor",
+    "NeighborhoodData",
+    "NewRoundRequest",
+    "NewRoundNotification",
+    "ValueRequest",
+    "ValueResponse",
+    "Converged",
+    "NotConverged",
+    "Done",
+    "Shutdown",
+    "Telemetry",
+    "pack_message",
+    "unpack_message",
+]
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    if len(b) > 0xFFFF:
+        raise ValueError("string field exceeds 64KiB")
+    return struct.pack("<H", len(b)) + b
+
+
+def _unpack_str(buf: bytes, off: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    return buf[off : off + n].decode("utf-8"), off + n
+
+
+def _pack_tensor(x: np.ndarray, bf16_wire: bool) -> bytes:
+    t = encode_tensor(x, bf16_wire=bf16_wire)
+    return struct.pack("<I", len(t)) + t
+
+
+def _unpack_tensor(buf: bytes, off: int) -> Tuple[np.ndarray, int]:
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    return decode_tensor(buf[off : off + n]), off + n
+
+
+@dataclasses.dataclass
+class Message:
+    """Base: subclasses set ``TYPE_CODE`` and implement pack/unpack."""
+
+    TYPE_CODE: ClassVar[int] = -1
+
+    def _pack(self) -> bytes:  # pragma: no cover - overridden
+        return b""
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "Message":  # pragma: no cover
+        return cls()
+
+
+@dataclasses.dataclass
+class Register(Message):
+    """Agent -> master (and agent -> peer) identification handshake
+    (parity: ``ProtoRegister``, protocol.py:23-27 — token + listen address
+    so the master/peer can route back-connections)."""
+
+    TYPE_CODE: ClassVar[int] = 1
+    token: str = ""
+    host: str = ""
+    port: int = 0
+
+    def _pack(self) -> bytes:
+        return _pack_str(self.token) + _pack_str(self.host) + struct.pack("<I", self.port)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "Register":
+        token, off = _unpack_str(buf, 0)
+        host, off = _unpack_str(buf, off)
+        (port,) = struct.unpack_from("<I", buf, off)
+        return cls(token=token, host=host, port=port)
+
+
+@dataclasses.dataclass
+class Ok(Message):
+    """Positive acknowledgement (parity: ``ProtoOk``, protocol.py:30-32)."""
+
+    TYPE_CODE: ClassVar[int] = 2
+    info: str = ""
+
+    def _pack(self) -> bytes:
+        return _pack_str(self.info)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "Ok":
+        info, _ = _unpack_str(buf, 0)
+        return cls(info=info)
+
+
+@dataclasses.dataclass
+class ErrorException(Message):
+    """Error report (parity: ``ProtoErrorException``, protocol.py:15-20)."""
+
+    TYPE_CODE: ClassVar[int] = 3
+    message: str = ""
+
+    def _pack(self) -> bytes:
+        return _pack_str(self.message)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "ErrorException":
+        message, _ = _unpack_str(buf, 0)
+        return cls(message=message)
+
+
+@dataclasses.dataclass
+class Neighbor:
+    token: str
+    host: str
+    port: int
+    weight: float
+
+
+@dataclasses.dataclass
+class NeighborhoodData(Message):
+    """Master -> agent: neighbor addresses + per-edge mixing weights +
+    self-weight + convergence eps (parity: ``ProtoNeighborhoodData``,
+    protocol.py:35-39, with the SDP weights the master solves at
+    ``master.py:262-266``)."""
+
+    TYPE_CODE: ClassVar[int] = 4
+    self_weight: float = 0.0
+    convergence_eps: float = 1e-4
+    neighbors: List[Neighbor] = dataclasses.field(default_factory=list)
+
+    def _pack(self) -> bytes:
+        out = [struct.pack("<ddH", self.self_weight, self.convergence_eps, len(self.neighbors))]
+        for nb in self.neighbors:
+            out.append(_pack_str(nb.token) + _pack_str(nb.host))
+            out.append(struct.pack("<Id", nb.port, nb.weight))
+        return b"".join(out)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "NeighborhoodData":
+        self_w, eps, count = struct.unpack_from("<ddH", buf, 0)
+        off = 18
+        nbs = []
+        for _ in range(count):
+            token, off = _unpack_str(buf, off)
+            host, off = _unpack_str(buf, off)
+            port, weight = struct.unpack_from("<Id", buf, off)
+            off += 12
+            nbs.append(Neighbor(token=token, host=host, port=port, weight=weight))
+        return cls(self_weight=self_w, convergence_eps=eps, neighbors=nbs)
+
+
+@dataclasses.dataclass
+class NewRoundRequest(Message):
+    """Agent -> master: ready for a weighted consensus round with this
+    sample weight (parity: ``ProtoNewRoundRequest``, protocol.py:52-55)."""
+
+    TYPE_CODE: ClassVar[int] = 5
+    weight: float = 1.0
+
+    def _pack(self) -> bytes:
+        return struct.pack("<d", self.weight)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "NewRoundRequest":
+        (w,) = struct.unpack_from("<d", buf, 0)
+        return cls(weight=w)
+
+
+@dataclasses.dataclass
+class NewRoundNotification(Message):
+    """Master -> agents: round starts; carries the mean sample weight for
+    the weighted-lift trick (parity: ``ProtoNewRoundNotification``,
+    protocol.py:56-59, mean weight computed at ``master.py:145-146,165``)."""
+
+    TYPE_CODE: ClassVar[int] = 6
+    round_id: int = 0
+    mean_weight: float = 1.0
+
+    def _pack(self) -> bytes:
+        return struct.pack("<qd", self.round_id, self.mean_weight)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "NewRoundNotification":
+        r, w = struct.unpack_from("<qd", buf, 0)
+        return cls(round_id=r, mean_weight=w)
+
+
+@dataclasses.dataclass
+class ValueRequest(Message):
+    """Agent -> neighbor: your value for (round, iteration), please
+    (parity: ``ProtoRunOnceValueRequest``, protocol.py:62-65)."""
+
+    TYPE_CODE: ClassVar[int] = 7
+    round_id: int = 0
+    iteration: int = 0
+
+    def _pack(self) -> bytes:
+        return struct.pack("<qq", self.round_id, self.iteration)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "ValueRequest":
+        r, i = struct.unpack_from("<qq", buf, 0)
+        return cls(round_id=r, iteration=i)
+
+
+@dataclasses.dataclass
+class ValueResponse(Message):
+    """Neighbor -> agent: flattened value tensor for (round, iteration)
+    (parity: ``ProtoRunOnceValueResponse``, protocol.py:66-69; bf16 wire
+    narrowing is this framework's addition)."""
+
+    TYPE_CODE: ClassVar[int] = 8
+    round_id: int = 0
+    iteration: int = 0
+    value: Optional[np.ndarray] = None
+    bf16_wire: bool = False
+
+    def _pack(self) -> bytes:
+        v = self.value if self.value is not None else np.zeros(0, np.float32)
+        return struct.pack("<qq", self.round_id, self.iteration) + _pack_tensor(
+            np.asarray(v), self.bf16_wire
+        )
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "ValueResponse":
+        r, i = struct.unpack_from("<qq", buf, 0)
+        value, _ = _unpack_tensor(buf, 16)
+        return cls(round_id=r, iteration=i, value=value)
+
+
+@dataclasses.dataclass
+class Converged(Message):
+    """Agent -> master (parity: ``ProtoConverged``, protocol.py:42-45)."""
+
+    TYPE_CODE: ClassVar[int] = 9
+    round_id: int = 0
+    iteration: int = 0
+
+    def _pack(self) -> bytes:
+        return struct.pack("<qq", self.round_id, self.iteration)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "Converged":
+        r, i = struct.unpack_from("<qq", buf, 0)
+        return cls(round_id=r, iteration=i)
+
+
+@dataclasses.dataclass
+class NotConverged(Message):
+    """Agent -> master (parity: ``ProtoNotConverged``, protocol.py:46-49)."""
+
+    TYPE_CODE: ClassVar[int] = 10
+    round_id: int = 0
+    iteration: int = 0
+
+    def _pack(self) -> bytes:
+        return struct.pack("<qq", self.round_id, self.iteration)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "NotConverged":
+        r, i = struct.unpack_from("<qq", buf, 0)
+        return cls(round_id=r, iteration=i)
+
+
+@dataclasses.dataclass
+class Done(Message):
+    """Master -> agents: round converged globally (parity: ``ProtoDone``,
+    protocol.py:72-74)."""
+
+    TYPE_CODE: ClassVar[int] = 11
+    round_id: int = 0
+
+    def _pack(self) -> bytes:
+        return struct.pack("<q", self.round_id)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "Done":
+        (r,) = struct.unpack_from("<q", buf, 0)
+        return cls(round_id=r)
+
+
+@dataclasses.dataclass
+class Shutdown(Message):
+    """Master -> agents broadcast (parity: ``ProtoShutdown``,
+    protocol.py:77-79, broadcast at ``master.py:48-61``)."""
+
+    TYPE_CODE: ClassVar[int] = 12
+    reason: str = ""
+
+    def _pack(self) -> bytes:
+        return _pack_str(self.reason)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "Shutdown":
+        reason, _ = _unpack_str(buf, 0)
+        return cls(reason=reason)
+
+
+@dataclasses.dataclass
+class Telemetry(Message):
+    """Agent -> master metrics payload, dispatched to a
+    ``TelemetryProcessor`` (parity: ``ProtoTelemetry``, protocol.py:82-84,
+    dispatch at ``master.py:192-199``).  The payload is JSON, not pickle."""
+
+    TYPE_CODE: ClassVar[int] = 13
+    token: str = ""
+    payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def _pack(self) -> bytes:
+        return _pack_str(self.token) + _pack_str(json.dumps(self.payload))
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "Telemetry":
+        token, off = _unpack_str(buf, 0)
+        payload, _ = _unpack_str(buf, off)
+        return cls(token=token, payload=json.loads(payload))
+
+
+_REGISTRY: Dict[int, Type[Message]] = {
+    cls.TYPE_CODE: cls
+    for cls in (
+        Register, Ok, ErrorException, NeighborhoodData, NewRoundRequest,
+        NewRoundNotification, ValueRequest, ValueResponse, Converged,
+        NotConverged, Done, Shutdown, Telemetry,
+    )
+}
+
+
+def pack_message(msg: Message) -> Tuple[int, bytes]:
+    """-> (type_code, body) for the framing layer."""
+    if type(msg).TYPE_CODE not in _REGISTRY:
+        raise TypeError(f"unregistered message type {type(msg).__name__}")
+    return type(msg).TYPE_CODE, msg._pack()
+
+
+def unpack_message(type_code: int, body: bytes) -> Message:
+    cls = _REGISTRY.get(type_code)
+    if cls is None:
+        raise ValueError(f"unknown message type code {type_code}")
+    return cls._unpack(body)

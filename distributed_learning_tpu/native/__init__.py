@@ -1,0 +1,138 @@
+"""Native (C++) components, loaded via ctypes with pure-Python fallbacks.
+
+``codec.cpp`` holds the wire-codec hot path (f32<->bf16 conversion, crc32).
+The shared library is compiled with g++ on first use and cached beside the
+source; environments without a toolchain fall back to numpy/ml_dtypes/zlib
+implementations with identical semantics (the tests assert bit-equality).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import zlib
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "native_available",
+    "f32_to_bf16",
+    "bf16_to_f32",
+    "crc32",
+]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "codec.cpp")
+_LIB = os.path.join(_HERE, "_codec.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    # Per-process temp name: concurrent first-use builds (multi-process
+    # deployments) must not interleave g++ output on a shared path; the
+    # final os.replace is atomic either way.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _LIB)
+        return _LIB
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DLT_NO_NATIVE") == "1":
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.dlt_f32_to_bf16.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.dlt_f32_to_bf16.restype = None
+        lib.dlt_bf16_to_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.dlt_bf16_to_f32.restype = None
+        lib.dlt_crc32.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
+        ]
+        lib.dlt_crc32.restype = ctypes.c_uint32
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def f32_to_bf16(x: np.ndarray) -> np.ndarray:
+    """float32 array -> uint16 array of bfloat16 bit patterns (RNE)."""
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    out = np.empty(x.shape, dtype=np.uint16)
+    lib = _load()
+    if lib is not None and x.size:
+        lib.dlt_f32_to_bf16(
+            x.ctypes.data, out.ctypes.data, ctypes.c_size_t(x.size)
+        )
+        return out
+    import ml_dtypes  # bundled with jax
+
+    return x.astype(ml_dtypes.bfloat16).view(np.uint16)
+
+
+def bf16_to_f32(bits: np.ndarray) -> np.ndarray:
+    """uint16 bfloat16 bit patterns -> float32 array."""
+    bits = np.ascontiguousarray(bits, dtype=np.uint16)
+    out = np.empty(bits.shape, dtype=np.float32)
+    lib = _load()
+    if lib is not None and bits.size:
+        lib.dlt_bf16_to_f32(
+            bits.ctypes.data, out.ctypes.data, ctypes.c_size_t(bits.size)
+        )
+        return out
+    import ml_dtypes
+
+    return bits.view(ml_dtypes.bfloat16).astype(np.float32)
+
+
+def crc32(data, seed: int = 0) -> int:
+    """crc32 (zlib-compatible) of a bytes-like or contiguous array."""
+    lib = _load()
+    if lib is not None:
+        buf = np.frombuffer(memoryview(data).cast("B"), dtype=np.uint8)
+        if buf.size == 0:
+            return zlib.crc32(b"", seed) & 0xFFFFFFFF
+        return int(
+            lib.dlt_crc32(
+                buf.ctypes.data, ctypes.c_size_t(buf.size), ctypes.c_uint32(seed)
+            )
+        )
+    return zlib.crc32(memoryview(data).cast("B"), seed) & 0xFFFFFFFF

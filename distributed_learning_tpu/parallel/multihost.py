@@ -1,0 +1,85 @@
+"""Multi-host TPU support: jax.distributed + hybrid ICI/DCN agent meshes.
+
+The reference scales across processes with asyncio-TCP sockets
+(``utils/consensus_tcp/``, SURVEY.md §2 backend table: "no NCCL/MPI/Gloo/
+UCX anywhere").  The TPU-native equivalent is one SPMD program spanning
+hosts: ``jax.distributed.initialize`` brings every host's chips into a
+single global device set, shardings place one gossip agent per chip, and
+the same ``ppermute``/``psum`` collectives ride ICI within a slice and DCN
+across slices — no framework-level message code at all.
+
+``initialize`` wraps ``jax.distributed.initialize`` with environment
+autodetection; ``hybrid_agent_mesh`` builds the agent mesh so that
+ring-neighbor exchanges map to ICI, keeping only the unavoidable
+slice-boundary hops on DCN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["initialize", "hybrid_agent_mesh", "process_local_agents"]
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
+    """Join this host to the global JAX runtime.
+
+    On TPU pods the three arguments autodetect from the environment, so a
+    bare ``initialize()`` suffices.  Explicit values follow the same
+    contract as ``jax.distributed.initialize``; calling it twice is a
+    no-op (idempotence guard, which the upstream call lacks).
+    """
+    if getattr(initialize, "_done", False):
+        return
+    if coordinator_address is None and os.environ.get("DLT_COORDINATOR"):
+        coordinator_address = os.environ["DLT_COORDINATOR"]
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    initialize._done = True
+
+
+def hybrid_agent_mesh(
+    n_agents: Optional[int] = None, *, axis_name: str = "agents"
+) -> Mesh:
+    """One-axis agent mesh over the global device set, ordered so adjacent
+    agents are physically adjacent.
+
+    Devices are sorted by (process, slice, device id): a ring topology's
+    neighbor exchange then crosses DCN only at process/slice boundaries —
+    every other edge is an ICI hop.  With ``n_agents`` unset, every global
+    device hosts one agent.
+    """
+    devices = sorted(
+        jax.devices(),
+        key=lambda d: (
+            d.process_index,
+            getattr(d, "slice_index", 0) or 0,
+            d.id,
+        ),
+    )
+    n = n_agents or len(devices)
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n]), (axis_name,))
+
+
+def process_local_agents(mesh: Mesh, *, axis_name: str = "agents") -> Sequence[int]:
+    """Agent indices whose device lives on this process — the set this
+    host's data pipeline must feed (global-array addressable shards)."""
+    local = {d.id for d in jax.local_devices()}
+    flat = list(np.asarray(mesh.devices).ravel())
+    return tuple(i for i, d in enumerate(flat) if d.id in local)

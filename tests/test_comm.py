@@ -1,0 +1,349 @@
+"""Comm-backend tests: native codec, wire protocol, and the full TCP
+master/agent deployment on localhost.
+
+Tier-3 parity (SURVEY.md §4): the reference's only multi-process test is
+the manual 4-notebook tcp-consensus-test (master :9000, agents :9001-:9003,
+topology [(1,2),(2,3)], basis-vector values checking consensus hits the
+(weighted) mean).  The same scenarios run here automatically, in-process
+via asyncio on ephemeral ports.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu import native
+from distributed_learning_tpu.comm import (
+    ConsensusAgent,
+    ConsensusMaster,
+    decode_tensor,
+    encode_tensor,
+)
+from distributed_learning_tpu.comm import protocol as P
+from distributed_learning_tpu.utils import RecordingTelemetry
+
+
+# ---------------------------------------------------------------------- #
+# Native codec                                                           #
+# ---------------------------------------------------------------------- #
+def test_native_codec_bit_exact_vs_mldtypes():
+    import ml_dtypes
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=4097).astype(np.float32)
+    x[:4] = [0.0, -0.0, np.inf, -np.inf]
+    bits = native.f32_to_bf16(x)
+    ref = x.astype(ml_dtypes.bfloat16).view(np.uint16)
+    assert np.array_equal(bits, ref)
+    back = native.bf16_to_f32(bits)
+    assert np.array_equal(back, bits.view(ml_dtypes.bfloat16).astype(np.float32))
+
+
+def test_native_codec_nan_stays_nan():
+    x = np.array([np.nan, 1.0], np.float32)
+    back = native.bf16_to_f32(native.f32_to_bf16(x))
+    assert np.isnan(back[0]) and back[1] == 1.0
+
+
+def test_native_crc_matches_zlib():
+    import zlib
+
+    data = np.random.default_rng(1).bytes(65537)
+    assert native.crc32(data) == (zlib.crc32(data) & 0xFFFFFFFF)
+    assert native.crc32(b"") == 0
+
+
+# ---------------------------------------------------------------------- #
+# Tensor wire format & protocol round-trips                              #
+# ---------------------------------------------------------------------- #
+@pytest.mark.parametrize(
+    "arr",
+    [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(5, dtype=np.int64),
+        np.float64(3.5) * np.ones((2, 2, 2)),
+        np.array([], dtype=np.float32),
+        np.array(7.0, dtype=np.float32),  # 0-d
+    ],
+)
+def test_tensor_roundtrip(arr):
+    out = decode_tensor(encode_tensor(arr))
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_tensor_bf16_wire_halves_payload():
+    x = np.random.default_rng(0).normal(size=1024).astype(np.float32)
+    full = encode_tensor(x)
+    narrow = encode_tensor(x, bf16_wire=True)
+    assert len(narrow) < len(full) * 0.6
+    out = decode_tensor(narrow)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, x, rtol=1e-2)
+
+
+def test_tensor_rejects_truncation():
+    buf = encode_tensor(np.ones(10, np.float32))
+    with pytest.raises(ValueError, match="truncated"):
+        decode_tensor(buf[:-5])
+
+
+def test_protocol_message_roundtrips():
+    msgs = [
+        P.Register(token="a", host="1.2.3.4", port=900),
+        P.Ok(info="hi"),
+        P.ErrorException(message="boom"),
+        P.NeighborhoodData(
+            self_weight=0.5,
+            convergence_eps=1e-5,
+            neighbors=[P.Neighbor("b", "h", 1, 0.25), P.Neighbor("c", "h2", 2, 0.25)],
+        ),
+        P.NewRoundRequest(weight=3.0),
+        P.NewRoundNotification(round_id=7, mean_weight=2.0),
+        P.ValueRequest(round_id=7, iteration=3),
+        P.ValueResponse(round_id=7, iteration=3, value=np.ones(4, np.float32)),
+        P.Converged(round_id=7, iteration=3),
+        P.NotConverged(round_id=7, iteration=3),
+        P.Done(round_id=7),
+        P.Shutdown(reason="bye"),
+        P.Telemetry(token="a", payload={"loss": 0.5, "n": 3}),
+    ]
+    for msg in msgs:
+        code, body = P.pack_message(msg)
+        out = P.unpack_message(code, body)
+        assert type(out) is type(msg)
+        for f, v in vars(msg).items():
+            if isinstance(v, np.ndarray):
+                np.testing.assert_array_equal(getattr(out, f), v)
+            elif f != "bf16_wire":  # wire-only hint, not a field
+                assert getattr(out, f) == v, (msg, f)
+
+
+# ---------------------------------------------------------------------- #
+# Full TCP deployment                                                    #
+# ---------------------------------------------------------------------- #
+async def _deploy(topology_edges, tokens, **agent_kw):
+    master = ConsensusMaster(
+        topology_edges, telemetry=agent_kw.pop("telemetry", None),
+        weight_mode=agent_kw.pop("weight_mode", "metropolis"),
+        convergence_eps=agent_kw.pop("convergence_eps", 1e-6),
+    )
+    host, port = await master.start()
+    agents = [
+        ConsensusAgent(t, host, port, **agent_kw) for t in tokens
+    ]
+    await asyncio.gather(*(a.start() for a in agents))
+    return master, agents
+
+
+async def _teardown(master, agents):
+    await master.shutdown()
+    for a in agents:
+        await a.close()
+
+
+def test_tcp_run_once_chain():
+    """The reference's tcp-consensus-test scenario: chain 1-2-3, basis
+    vectors; one run_once must compute x_i <- sum_j W[i,j] x_j."""
+
+    async def main():
+        master, agents = await _deploy([("1", "2"), ("2", "3")], ["1", "2", "3"])
+        W = master.W
+        order = [master._tokens.index(a.token) for a in agents]
+        vals = [np.eye(3, dtype=np.float32)[i].copy() for i in range(3)]
+        outs = await asyncio.gather(
+            *(a.run_once(vals[i]) for i, a in enumerate(agents))
+        )
+        X = np.stack(vals)
+        expect = W @ X  # rows in master token order == agent order here
+        for i, a in enumerate(agents):
+            np.testing.assert_allclose(outs[i], expect[order[i]], atol=1e-6)
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_tcp_run_round_reaches_weighted_mean():
+    """Full round protocol (the reference's TCP stub): weighted values
+    10*e_i with weights -> consensus at the weighted mean."""
+
+    async def main():
+        tokens = ["1", "2", "3"]
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3"), ("3", "1")], tokens, convergence_eps=1e-7
+        )
+        weights = {"1": 1.0, "2": 2.0, "3": 3.0}
+        vals = {
+            t: (10.0 * np.eye(3, dtype=np.float32)[i]).copy()
+            for i, t in enumerate(tokens)
+        }
+        outs = await asyncio.gather(
+            *(a.run_round(vals[a.token], weights[a.token]) for a in agents)
+        )
+        wsum = sum(weights.values())
+        expect = sum(weights[t] * vals[t] for t in tokens) / wsum
+        for out in outs:
+            np.testing.assert_allclose(out, expect, atol=1e-3)
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_tcp_multiple_rounds_and_telemetry():
+    async def main():
+        telemetry = RecordingTelemetry()
+        tokens = ["a", "b"]
+        master, agents = await _deploy(
+            [("a", "b")], tokens, telemetry=telemetry, convergence_eps=1e-8
+        )
+        x = {"a": np.zeros(2, np.float32), "b": np.ones(2, np.float32)}
+        for _ in range(3):
+            outs = await asyncio.gather(
+                *(a.run_round(x[a.token], 1.0) for a in agents)
+            )
+            x = {a.token: outs[i] for i, a in enumerate(agents)}
+        for out in outs:
+            np.testing.assert_allclose(out, 0.5, atol=1e-3)
+        await agents[0].send_telemetry({"acc": 0.9})
+        for _ in range(100):
+            if telemetry.records:
+                break
+            await asyncio.sleep(0.01)
+        assert telemetry.records and telemetry.records[0][0] == "a"
+        assert telemetry.records[0][1]["acc"] == 0.9
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_tcp_bf16_wire_round():
+    """Gossip with bfloat16 wire compression still converges (to bf16
+    resolution)."""
+
+    async def main():
+        tokens = ["1", "2", "3", "4"]
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3"), ("3", "4"), ("4", "1")],
+            tokens,
+            bf16_wire=True,
+            convergence_eps=1e-3,
+        )
+        vals = {t: np.full(8, float(i), np.float32) for i, t in enumerate(tokens)}
+        outs = await asyncio.gather(
+            *(a.run_round(vals[a.token], 1.0) for a in agents)
+        )
+        for out in outs:
+            np.testing.assert_allclose(out, 1.5, atol=0.05)
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_tcp_sdp_weights_deployment():
+    """weight_mode='sdp' distributes fastest-mixing weights (parity:
+    master.py:262-266)."""
+
+    async def main():
+        tokens = ["1", "2", "3"]
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3")], tokens, weight_mode="sdp"
+        )
+        # Chain: optimal weights are 1/2 per edge.
+        i, j = master._index["1"], master._index["2"]
+        assert abs(master.W[i, j] - 0.5) < 1e-2
+        outs = await asyncio.gather(
+            *(a.run_once(np.eye(3, dtype=np.float32)[i]) for i, a in enumerate(agents))
+        )
+        total = np.stack(outs).sum(axis=0)
+        np.testing.assert_allclose(total, np.ones(3), atol=1e-5)  # mass preserved
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_tcp_rejects_unknown_token():
+    async def main():
+        master = ConsensusMaster([("1", "2")])
+        host, port = await master.start()
+        rogue = ConsensusAgent("zz", host, port)
+        with pytest.raises(ConnectionError, match="unknown agent token"):
+            await rogue.start(timeout=5)
+        await rogue.close()
+        await master.shutdown()
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+# ---------------------------------------------------------------------- #
+# Multi-host mesh helpers                                                #
+# ---------------------------------------------------------------------- #
+def test_hybrid_agent_mesh_orders_devices():
+    import jax
+    from distributed_learning_tpu.parallel.multihost import (
+        hybrid_agent_mesh,
+        process_local_agents,
+    )
+
+    mesh = hybrid_agent_mesh()
+    assert mesh.shape["agents"] == len(jax.devices())
+    flat = list(mesh.devices.ravel())
+    keys = [(d.process_index, d.id) for d in flat]
+    assert keys == sorted(keys)  # adjacency-preserving order
+    # Single process: every agent is local.
+    assert process_local_agents(mesh) == tuple(range(len(flat)))
+
+    small = hybrid_agent_mesh(4)
+    assert small.shape["agents"] == 4
+    with pytest.raises(ValueError, match="need"):
+        hybrid_agent_mesh(10_000)
+
+
+def test_tcp_run_once_after_run_round_stays_synchronized():
+    """Op-id tags resynchronize after a round even though agents can exit
+    run_round at different internal iteration counts."""
+
+    async def main():
+        tokens = ["1", "2", "3"]
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3"), ("3", "1")], tokens, convergence_eps=1e-6
+        )
+        W = master.W
+        vals = {t: np.full(4, float(i), np.float32) for i, t in enumerate(tokens)}
+        outs = await asyncio.gather(
+            *(a.run_round(vals[a.token], 1.0) for a in agents)
+        )
+        # Now a plain run_once on fresh values: must compute exactly W @ X.
+        fresh = [np.eye(3, dtype=np.float32)[i].copy() for i in range(3)]
+        outs2 = await asyncio.gather(
+            *(a.run_once(fresh[i]) for i, a in enumerate(agents))
+        )
+        order = [master._tokens.index(a.token) for a in agents]
+        expect = W @ np.stack(fresh)
+        for i in range(3):
+            np.testing.assert_allclose(outs2[i], expect[order[i]], atol=1e-6)
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_tcp_dead_peer_raises_instead_of_hanging():
+    """A neighbor dying mid-deployment surfaces as ConnectionError on the
+    surviving agent, not an infinite wait."""
+
+    async def main():
+        tokens = ["1", "2"]
+        master, agents = await _deploy([("1", "2")], tokens)
+        # Kill agent "2" abruptly (no protocol goodbye).  The survivor must
+        # fail loudly — either it sees the dead peer itself
+        # (ConnectionError) or the master sees the lost control stream
+        # first and broadcasts Shutdown (ShutdownError).
+        from distributed_learning_tpu.comm import ShutdownError
+
+        await agents[1].close()
+        with pytest.raises((ConnectionError, ShutdownError)):
+            await asyncio.wait_for(agents[0].run_once(np.ones(2, np.float32)), 10)
+        await master.shutdown()
+        await agents[0].close()
+
+    asyncio.run(asyncio.wait_for(main(), 60))
