@@ -16,6 +16,7 @@ from distributed_learning_tpu.models.logreg import (
     loss_fn as logreg_loss,
 )
 from distributed_learning_tpu.models.mlp import ANNModel
+from distributed_learning_tpu.models.transformer import TransformerLM
 from distributed_learning_tpu.models.vision import LeNet, ResNet, VGG, WideResNet
 
 _REGISTRY = {
@@ -26,6 +27,7 @@ _REGISTRY = {
     "wide_resnet": WideResNet,
     "ann": ANNModel,
     "mlp": ANNModel,
+    "transformer": TransformerLM,
 }
 
 
@@ -43,7 +45,12 @@ def get_model(name: str, *args: Any, **kwargs: Any):
     cls = _REGISTRY[key]
     if args:
         # Reference convention: model_args = [num_classes].
-        size_key = "output_dim" if cls is ANNModel else "num_classes"
+        if cls is ANNModel:
+            size_key = "output_dim"
+        elif cls is TransformerLM:
+            size_key = "vocab_size"
+        else:
+            size_key = "num_classes"
         if size_key in kwargs:
             raise ValueError(
                 f"{size_key} given both positionally ({args[0]}) and as a "
@@ -60,6 +67,7 @@ def get_model(name: str, *args: Any, **kwargs: Any):
 
 __all__ = [
     "ANNModel",
+    "TransformerLM",
     "LeNet",
     "VGG",
     "ResNet",

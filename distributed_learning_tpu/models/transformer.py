@@ -1,0 +1,134 @@
+"""Decoder-only transformer with pluggable sequence-parallel attention.
+
+No counterpart exists in the reference (its models are tabular/image nets,
+SURVEY.md §2 C11-C13); this model exists so the framework's long-context
+machinery (``ops/ring_attention.py``) has a first-class consumer: the same
+gossip-SGD trainer can train a language model whose attention runs
+sequence-parallel over the device ring.
+
+``attn_impl``: ``"full"`` (single-device reference), ``"ring"`` or
+``"ulysses"`` (inside ``shard_map`` with ``seq_axis`` sharded).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_learning_tpu.ops.ring_attention import (
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+)
+
+__all__ = ["TransformerLM"]
+
+
+class _Attention(nn.Module):
+    num_heads: int
+    head_dim: int
+    attn_impl: str = "full"
+    seq_axis: str = "seq"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, _ = x.shape
+        features = self.num_heads * self.head_dim
+        qkv = nn.Dense(3 * features, use_bias=False, dtype=self.dtype)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, self.num_heads, self.head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        if self.attn_impl == "full":
+            out = attention_reference(q, k, v, causal=True)
+        elif self.attn_impl == "flash":
+            from distributed_learning_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        elif self.attn_impl == "ring":
+            out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        elif self.attn_impl == "ulysses":
+            out = ulysses_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        else:
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+        out = out.reshape(B, T, features)
+        return nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype)(out)
+
+
+class _Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    attn_impl: str = "full"
+    seq_axis: str = "seq"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + _Attention(
+            self.num_heads, self.head_dim, self.attn_impl, self.seq_axis,
+            self.dtype,
+        )(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        d = x.shape[-1]
+        h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(d, dtype=self.dtype)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Small causal LM: token embedding + learned positions + N blocks.
+
+    ``__call__(tokens, train=False) -> logits`` matches the framework's
+    shared model interface (``models/__init__.py``), so it drops straight
+    into :class:`~distributed_learning_tpu.training.trainer.GossipTrainer`.
+    """
+
+    vocab_size: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    head_dim: int = 16
+    max_len: int = 1024
+    mlp_ratio: int = 4
+    attn_impl: str = "full"
+    seq_axis: str = "seq"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        d_model = self.num_heads * self.head_dim
+        T = tokens.shape[1]
+        x = nn.Embed(self.vocab_size, d_model, dtype=self.dtype)(tokens)
+        # Positions must be GLOBAL: under shard_map (ring/ulysses) each
+        # shard sees only its local T, so offset by the shard index.
+        # "full" and "flash" are single-device paths (no mesh axis bound).
+        if self.attn_impl in ("full", "flash"):
+            if T > self.max_len:
+                raise ValueError(
+                    f"sequence length {T} exceeds max_len {self.max_len}; "
+                    "out-of-range positions would silently clamp"
+                )
+            positions = jnp.arange(T)
+        else:
+            # Local T * axis size must fit max_len; checked per-shard
+            # statically (axis size is known at trace time).
+            n_shards = jax.lax.axis_size(self.seq_axis)
+            if T * n_shards > self.max_len:
+                raise ValueError(
+                    f"global sequence length {T * n_shards} (local {T} x "
+                    f"{n_shards} shards) exceeds max_len {self.max_len}"
+                )
+            positions = jax.lax.axis_index(self.seq_axis) * T + jnp.arange(T)
+        pos = nn.Embed(self.max_len, d_model, dtype=self.dtype)(positions)
+        x = x + pos[None]
+        for _ in range(self.num_layers):
+            x = _Block(
+                self.num_heads, self.head_dim, self.mlp_ratio,
+                self.attn_impl, self.seq_axis, self.dtype,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,194 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no sequence models (SURVEY.md §5: "long-context/sequence
+parallelism: entirely absent"), but its gossip ring is exactly the
+communication structure ring attention uses — each device passes its block
+to the next neighbor every step.  This module makes that structure a
+first-class capability so the framework handles long sequences at
+multi-chip scale:
+
+* :func:`ring_attention` — blockwise attention with online-softmax
+  accumulation; K/V blocks rotate around the device ring via
+  ``jax.lax.ppermute`` while every device keeps its resident Q shard.
+  Peak memory per device is O(T_local^2) instead of O(T^2), enabling
+  sequences n_devices times longer at the same memory.
+* :func:`ulysses_attention` — all-to-all sequence parallelism: resharding
+  from sequence-sharded to head-sharded via ``jax.lax.all_to_all``, local
+  full attention, and the inverse resharding.  Cheaper than the ring when
+  heads >= devices and the all-to-all fits ICI.
+
+Both are pure functions designed for use inside ``shard_map`` over a mesh
+axis (the same ``agents``/sequence axis the consensus engine uses) and are
+exact: outputs match full single-device attention to float tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["attention_reference", "ring_attention", "ulysses_attention", "make_ring_attention"]
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain full attention (B, T, H, D) — the correctness oracle."""
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T, S = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((T, S), bool), k=S - T)
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _block_accumulate(carry, q, k, v, q_pos, kv_pos, scale, causal):
+    """One online-softmax accumulation step against a single K/V block.
+
+    carry = (acc, l, m): running weighted values (B, Tq, H, D), softmax
+    denominator (B, H, Tq), and row max (B, H, Tq) — the standard
+    flash/blockwise-attention recurrence, computed in f32.
+    """
+    acc, l, m = carry
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = kv_pos[None, :] <= q_pos[:, None]  # (Tq, Tk)
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # Rows with nothing unmasked so far keep m=-inf; guard the exps.
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+    return acc_new, l_new, m_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact blockwise ring attention on sequence-sharded (B, T/n, H, D)
+    inputs; call inside ``shard_map`` with the sequence axis sharded over
+    ``axis_name``.
+
+    Every step each device attends its resident Q against the K/V block it
+    currently holds, then passes that block one hop around the ring
+    (``ppermute`` — an ICI-neighbor transfer on a TPU torus, the same
+    collective the consensus engine gossips with).  After ``n`` steps every
+    Q row has seen every key exactly once.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    B, _, H, D = q.shape
+
+    q_pos = idx * t_local + jnp.arange(t_local)
+    # The loop body makes every carry component device-varying (it mixes in
+    # ppermuted data), so the initial accumulators must be marked varying
+    # too (shard_map's vma check rejects unvarying->varying carries).
+    pvary = lambda x: lax.pcast(x, axis_name, to="varying")
+    acc0 = pvary(jnp.zeros((B, t_local, H, D), jnp.float32))
+    l0 = pvary(jnp.zeros((B, H, t_local), jnp.float32))
+    m0 = pvary(jnp.full((B, H, t_local), -jnp.inf, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(s, carry_kv):
+        (acc, l, m), (k_blk, v_blk, src) = carry_kv
+        kv_pos = src * t_local + jnp.arange(t_local)
+        acc, l, m = _block_accumulate(
+            (acc, l, m), q, k_blk, v_blk, q_pos, kv_pos, scale, causal
+        )
+        # Rotate the K/V block (and its origin index) one hop.
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (acc, l, m), (k_blk, v_blk, src)
+
+    carry = ((acc0, l0, m0), (k, v, idx))
+    carry = lax.fori_loop(0, n, lambda i, c: step(i, c), carry)
+    (acc, l, _m), _ = carry
+    l = jnp.maximum(l, 1e-30)  # causal first row always attends to itself
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """All-to-all sequence parallelism (Ulysses): inputs arrive
+    sequence-sharded (B, T/n, H, D); one ``all_to_all`` makes them
+    head-sharded with the full sequence (B, T, H/n, D); local full
+    attention; inverse ``all_to_all`` back.  Requires H % n == 0."""
+    n = lax.axis_size(axis_name)
+    H = q.shape[2]
+    if H % n != 0:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by axis size ({n})")
+
+    def seq_to_heads(x):
+        # (B, T/n, H, D) -> concat over seq of (B, T/n, H/n, D) -> (B, T, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_reference(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return heads_to_seq(out)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    strategy: str = "ring",
+    causal: bool = True,
+):
+    """Jitted sequence-parallel attention over globally-shaped arrays.
+
+    Returns ``fn(q, k, v) -> out`` taking full (B, T, H, D) arrays with T
+    sharded over ``axis_name``; internally a ``shard_map`` of
+    :func:`ring_attention` (or :func:`ulysses_attention`).
+    """
+    impl = {"ring": ring_attention, "ulysses": ulysses_attention}[strategy]
+    spec = P(None, axis_name, None, None)
+
+    @jax.jit
+    def fn(q, k, v):
+        local = functools.partial(impl, axis_name=axis_name, causal=causal)
+        sharded = jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
+        sharding = NamedSharding(mesh, spec)
+        q_, k_, v_ = (jax.lax.with_sharding_constraint(x, sharding) for x in (q, k, v))
+        return sharded(q_, k_, v_)
+
+    return fn

@@ -538,7 +538,11 @@ class GossipTrainer:
         Xb = gather(self._Xs, idx_j).reshape(
             (n, steps, self.batch_size) + self._Xs.shape[2:]
         )
-        yb = gather(self._ys, idx_j).reshape((n, steps, self.batch_size))
+        # Labels keep any trailing dims (sequence models label every
+        # position: y is (m, T) per node, not (m,)).
+        yb = gather(self._ys, idx_j).reshape(
+            (n, steps, self.batch_size) + self._ys.shape[2:]
+        )
         return jnp.swapaxes(Xb, 0, 1), jnp.swapaxes(yb, 0, 1)
 
     def train_epoch(self) -> Dict[str, Any]:
