@@ -1,0 +1,97 @@
+"""Gossip-SGD on CIFAR through the MasterNode surface — the workflow of
+``Man_Colab.ipynb`` cells 12-24, whose driver module is missing from the
+reference snapshot (SURVEY.md C16); this framework provides it.
+
+Named nodes hold disjoint CIFAR shards, train locally each epoch, and mix
+parameters over the topology from ``epoch_cons_num`` on; per-node curves
+are recorded every ``stat_step`` batches and saved by ``show_graphs``.
+
+Run (full CIFAR needs a data dir via DLT_CIFAR_DIR; otherwise a synthetic
+stand-in loads): ``python examples/cifar_gossip_masternode.py --model lenet``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_tpu.data import load_cifar, normalize, shard_dataset
+from distributed_learning_tpu.training import MasterNode
+from distributed_learning_tpu.utils import RecordingTelemetry
+
+TOPOLOGY = {
+    "Alice": {"Alice": 0.4, "Bob": 0.3, "Charlie": 0.3},
+    "Bob": {"Alice": 0.3, "Bob": 0.4, "Charlie": 0.3},
+    "Charlie": {"Alice": 0.3, "Bob": 0.3, "Charlie": 0.4},
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="lenet",
+                    choices=["lenet", "vggnet", "resnet", "wide-resnet", "ann"])
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--n-train", type=int, default=3072)
+    ap.add_argument("--epoch-cons-num", type=int, default=1,
+                    help="first (1-based) epoch that mixes")
+    args = ap.parse_args()
+
+    (X, y), (Xt, yt) = load_cifar("cifar10")
+    X, y = X[: args.n_train], y[: args.n_train]
+    Xt, yt = Xt[:512], yt[:512]
+    Xn = np.asarray(normalize(jnp.asarray(X)))
+    Xtn = np.asarray(normalize(jnp.asarray(Xt)))
+    shards = shard_dataset(Xn, y, list(TOPOLOGY), batch_size=args.batch_size)
+
+    telemetry = RecordingTelemetry()
+    master = MasterNode(
+        node_names=list(TOPOLOGY),
+        model=args.model,
+        model_args=[10],
+        optimizer="sgd",
+        optimizer_kwargs={"momentum": 0.9, "weight_decay": 5e-4},
+        learning_rate=0.05,
+        error="cross_entropy",
+        weights=TOPOLOGY,
+        train_loaders=shards,
+        test_loader=(Xtn, yt),
+        stat_step=10,
+        epoch=args.epochs,
+        epoch_cons_num=args.epoch_cons_num,
+        batch_size=args.batch_size,
+        mix_times=2,
+        telemetry=telemetry,
+    )
+    master.initialize_nodes()
+    for out in master.start_consensus():
+        accs = (
+            "n/a"
+            if out["test_acc"] is None
+            else " ".join(f"{a:.3f}" for a in out["test_acc"])
+        )
+        print(
+            f"epoch {out['epoch']:2d}  mixed={out['mixed']}  "
+            f"mean train loss {float(np.mean(out['train_loss'])):.4f}  "
+            f"test acc [{accs}]  residual {out['deviation']:.2e}"
+        )
+
+    for name, node in master.network.items():
+        fig = node.show_graphs()
+        if fig is not None:
+            path = f"/tmp/gossip_{name}.png"
+            fig.savefig(path)
+            print(f"saved {path}")
+        print(node.summary())
+
+
+if __name__ == "__main__":
+    main()

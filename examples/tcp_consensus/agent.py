@@ -1,0 +1,59 @@
+"""TCP consensus agent process.
+
+Scripted version of the reference's per-agent notebooks
+(``notebooks/tcp-consensus-test/TCP Conensus test Agent N.ipynb``): each
+agent feeds a basis vector, runs weighted consensus rounds, and prints the
+agreed value — which must equal the weighted mean across agents.
+
+    python examples/tcp_consensus/agent.py 1 --master-port 9000
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "../..")))
+
+
+import argparse
+import asyncio
+
+import numpy as np
+
+from distributed_learning_tpu.comm import ConsensusAgent
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("token")
+    ap.add_argument("--master-host", default="127.0.0.1")
+    ap.add_argument("--master-port", type=int, default=9000)
+    ap.add_argument("--dim", type=int, default=3)
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--weight", type=float, default=None,
+                    help="sample weight (default: int(token))")
+    ap.add_argument("--bf16-wire", action="store_true")
+    args = ap.parse_args()
+
+    agent = ConsensusAgent(
+        args.token, args.master_host, args.master_port,
+        bf16_wire=args.bf16_wire,
+    )
+    await agent.start(timeout=300)
+    print(f"agent {agent.token}: neighbors {agent.neighbor_tokens}, "
+          f"eps {agent.convergence_eps}", flush=True)
+
+    i = (int(args.token) - 1) % args.dim
+    x = (10.0 * np.eye(args.dim, dtype=np.float32)[i]).copy()
+    weight = args.weight if args.weight is not None else float(args.token)
+    for r in range(args.rounds):
+        x = await agent.run_round(x, weight)
+        print(f"agent {agent.token} round {r}: {np.round(x, 4).tolist()}",
+              flush=True)
+        await agent.send_telemetry({"round": r, "norm": float(np.linalg.norm(x))})
+    await agent.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

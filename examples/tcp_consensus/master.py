@@ -1,0 +1,57 @@
+"""TCP consensus master process.
+
+The scripted version of ``notebooks/tcp-consensus-test/TCP Consensus
+test.ipynb`` (master on :9000, topology 1-2, 2-3): run this in one
+terminal, then one ``agent.py TOKEN`` per agent in others.
+
+    python examples/tcp_consensus/master.py --port 9000
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "../..")))
+
+
+import argparse
+import asyncio
+
+from distributed_learning_tpu.comm import ConsensusMaster
+from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
+
+
+class PrintTelemetry(TelemetryProcessor):
+    def process(self, token, payload):
+        print(f"[telemetry] {token}: {payload}", flush=True)
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--edges", default="1-2,2-3",
+                    help="comma-separated token pairs, e.g. 1-2,2-3")
+    ap.add_argument("--weights", default="sdp", choices=["sdp", "metropolis"])
+    ap.add_argument("--eps", type=float, default=1e-6)
+    args = ap.parse_args()
+
+    edges = [tuple(e.split("-")) for e in args.edges.split(",")]
+    master = ConsensusMaster(
+        edges, port=args.port, weight_mode=args.weights,
+        convergence_eps=args.eps, telemetry=PrintTelemetry(),
+    )
+    host, port = await master.start()
+    print(f"master listening on {host}:{port}; topology {edges}", flush=True)
+    await master.wait_all_registered(timeout=300)
+    print("all agents registered; serving rounds (ctrl-C to stop)", flush=True)
+    try:
+        await master._stopped.wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await master.shutdown("master exiting")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,106 @@
+"""Titanic consensus-GD — the reference's flagship experiment as a script.
+
+Mirrors ``notebooks/Titanic Consensus GD test.ipynb``: a centralized
+logistic-regression GD baseline (cell 7, recorded test acc 0.7978), the
+K4 consensus run (cell 15, 0.7978), and the 5-node grid sweep over
+convergence_eps (cells 18-21, 0.8090) — with the entire local-SGD +
+gossip-to-convergence loop compiled into one jitted program per scenario.
+
+Run: ``python examples/titanic_consensus_gd.py [--iters 4000]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_tpu.data import load_titanic, split_data
+from distributed_learning_tpu.models import logreg_loss
+from distributed_learning_tpu.models.logreg import accuracy
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+
+ALPHA, TAU = 0.1, 1e-4
+
+
+def centralized(X, y, X_te, y_te, iters):
+    @jax.jit
+    def run(w0):
+        def body(it, w):
+            lr = ALPHA * (it + 1.0) ** -0.5
+            return w - lr * jax.grad(logreg_loss)(w, X, y, TAU)
+
+        return jax.lax.fori_loop(0, iters, body, w0)
+
+    w = run(jnp.zeros(X.shape[1]))
+    return float(accuracy(w, X_te, y_te))
+
+
+def consensus(topology, X, y, X_te, y_te, iters, eps):
+    n = topology.n_agents
+    shards = split_data(np.asarray(X), np.asarray(y), n)
+    m = min(len(s[0]) for s in shards.values())
+    Xs = jnp.stack([jnp.asarray(shards[i][0][:m]) for i in range(n)])
+    ys = jnp.stack([jnp.asarray(shards[i][1][:m], jnp.float32) for i in range(n)])
+    engine = ConsensusEngine(topology.metropolis_weights())
+
+    vstep = jax.vmap(
+        lambda w, X, y, lr: w - lr * jax.grad(logreg_loss)(w, X, y, TAU),
+        in_axes=(0, 0, 0, None),
+    )
+
+    @jax.jit
+    def run(w0):
+        def body(it, w):
+            w = vstep(w, Xs, ys, ALPHA * (it + 1.0) ** -0.5)
+            w, _, _ = engine.mix_until(w, eps=eps, max_rounds=300)
+            return w
+
+        return jax.lax.fori_loop(0, iters, body, w0)
+
+    w = run(jnp.zeros((n, Xs.shape[-1])))
+    accs = [float(accuracy(w[a], X_te, y_te)) for a in range(n)]
+    spread = float(jnp.max(jnp.abs(w - w.mean(0))))
+    return accs, spread
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=4000)
+    args = ap.parse_args()
+
+    X_tr, y_tr, X_te, y_te = load_titanic()
+    X_te, y_te = jnp.asarray(X_te), jnp.asarray(y_te, jnp.float32)
+
+    acc = centralized(jnp.asarray(X_tr), jnp.asarray(y_tr, jnp.float32),
+                      X_te, y_te, args.iters)
+    print(f"centralized GD ({args.iters} iters): test acc {acc:.4f} "
+          "(reference recorded 0.7978)")
+
+    accs, spread = consensus(
+        Topology.complete(4), X_tr, y_tr, X_te, y_te, args.iters, eps=1e-10
+    )
+    print(f"K4 consensus-GD: per-agent acc {[f'{a:.4f}' for a in accs]}, "
+          f"spread {spread:.2e} (reference recorded 0.7978)")
+
+    grid5 = Topology.from_edges([(0, 1), (1, 2), (2, 3), (3, 4), (4, 0)])
+    for eps in (1e-10, 1e-2, 1e-1, 10.0):
+        accs, spread = consensus(
+            grid5, X_tr, y_tr, X_te, y_te, args.iters, eps=eps
+        )
+        print(f"grid-5, eps={eps:g}: per-agent acc "
+              f"{[f'{a:.4f}' for a in accs]}, spread {spread:.2e} "
+              "(reference recorded 0.8090 at 10k iters)")
+
+
+if __name__ == "__main__":
+    main()
