@@ -1,0 +1,5 @@
+"""``python -m distributed_learning_tpu`` — the training CLI."""
+
+from distributed_learning_tpu.cli import main
+
+raise SystemExit(main())

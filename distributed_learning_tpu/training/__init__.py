@@ -8,12 +8,20 @@ from distributed_learning_tpu.training.trainer import (
     get_metric,
     make_optimizer,
 )
+from distributed_learning_tpu.training.config import (
+    DATASET_DEFAULTS,
+    ExperimentConfig,
+    wrn_lr_schedule,
+)
 from distributed_learning_tpu.training.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
 
 __all__ = [
+    "ExperimentConfig",
+    "DATASET_DEFAULTS",
+    "wrn_lr_schedule",
     "ConsensusNode",
     "GossipTrainer",
     "MasterNode",

@@ -1,9 +1,17 @@
 """Utilities: telemetry hooks, logging."""
 
+from distributed_learning_tpu.utils.profiling import DebugLogger, annotate, trace
 from distributed_learning_tpu.utils.telemetry import (
     CallbackTelemetry,
     RecordingTelemetry,
     TelemetryProcessor,
 )
 
-__all__ = ["CallbackTelemetry", "RecordingTelemetry", "TelemetryProcessor"]
+__all__ = [
+    "CallbackTelemetry",
+    "RecordingTelemetry",
+    "TelemetryProcessor",
+    "DebugLogger",
+    "annotate",
+    "trace",
+]

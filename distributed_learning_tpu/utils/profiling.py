@@ -1,0 +1,77 @@
+"""Tracing & debug instrumentation.
+
+The reference's tracing is ad-hoc ``_debug(...)`` printers gated by a
+``debug`` flag (``consensus_asyncio.py:52-57``, ``master.py:63-68``,
+``agent.py:46-51``) plus notebook ``%time`` cells.  TPU-native
+equivalents:
+
+* :func:`trace` — a context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable trace of device execution;
+* :func:`annotate` — named ``TraceAnnotation`` spans that show up inside
+  the profile;
+* :class:`DebugLogger` — the reference's debug-flag pattern as a small
+  structured logger with per-round residual reporting
+  (``log_residual(round, residual)``), usable anywhere the reference
+  passed its ``logger``/``debug`` args.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Iterator, Optional
+
+__all__ = ["trace", "annotate", "DebugLogger"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, host_profile: bool = True) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace of the enclosed block.
+
+    View with TensorBoard (``tensorboard --logdir <log_dir>``) or
+    ``xprof``.  Host-side Python activity is included unless
+    ``host_profile=False``.
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span inside an active profiler trace."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class DebugLogger:
+    """Structured replacement for the reference's injected logger +
+    ``debug`` flag; quacks like ``logging.Logger`` for ``Mixer(logger=)``.
+    """
+
+    def __init__(self, name: str = "dlt", *, enabled: bool = True,
+                 logger: Optional[logging.Logger] = None):
+        self.enabled = enabled
+        self._log = logger or logging.getLogger(name)
+        self._t0 = time.perf_counter()
+        self.residuals: list = []
+
+    def debug(self, msg, *args):
+        if self.enabled:
+            self._log.debug("[%7.3fs] %s", time.perf_counter() - self._t0,
+                            msg % args if args else msg)
+
+    info = debug
+
+    def log_residual(self, round_idx: int, residual: float) -> None:
+        """Record + report a per-round consensus residual (the metric the
+        reference's Mixer debug lines printed, ``mixer.py:37,54``)."""
+        self.residuals.append((round_idx, float(residual)))
+        self.debug(f"round {round_idx}: residual {residual:.3e}")

@@ -98,8 +98,12 @@ def test_get_model_registry():
     assert isinstance(get_model("wide-resnet", 100), WideResNet)
     assert get_model("wide-resnet", 100).num_classes == 100
     assert get_model("ann", 10).output_dim == 10
+    from distributed_learning_tpu.models import TransformerLM
+
+    assert isinstance(get_model("transformer", 32), TransformerLM)
+    assert get_model("transformer", 32).vocab_size == 32
     with pytest.raises(ValueError, match="unknown model"):
-        get_model("transformer")
+        get_model("densenet")
 
 
 def test_logreg_class_parity_surface():
