@@ -59,6 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mix-eps", type=float, default=None)
     p.add_argument("--chebyshev", action="store_true")
     p.add_argument("--time-varying-p", type=float, default=None)
+    p.add_argument("--global-avg-every", type=int, default=None,
+                   help="Gossip-PGA: exact all-reduce every H-th epoch")
     p.add_argument("--lr-schedule", default=None, choices=["wrn_step"])
     p.add_argument("--n-train", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
@@ -134,6 +136,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         ("mix_times", args.mix_times),
         ("mix_eps", args.mix_eps),
         ("time_varying_p", args.time_varying_p),
+        ("global_avg_every", args.global_avg_every),
         ("n_train", args.n_train),
         ("seed", args.seed),
         ("stat_step", args.stat_step),

@@ -243,6 +243,19 @@ class ConsensusEngine:
         omegas = jnp.asarray(omegas, dtype=jnp.float32)
         return self._get_jitted("mix_chebyshev_with")(stacked, W, omegas)
 
+    def global_average(self, stacked: Pytree) -> Pytree:
+        """Exact averaging — the gamma=0 degenerate case (centralized DP
+        all-reduce).  Dense mode is a mean over the agent axis; sharded
+        mode one ``pmean`` over ICI.
+
+        Used standalone as the exact-consensus reference for convergence
+        metrics, and by the trainer's Gossip-PGA schedule (periodic global
+        averaging accelerates gossip SGD: arXiv:2105.09080 — every H-th
+        round replaces neighbor gossip with one exact all-reduce, removing
+        the accumulated consensus error at bounded extra bandwidth).
+        """
+        return self._get_jitted("global_average")(stacked)
+
     def run_round(
         self,
         stacked: Pytree,
@@ -334,6 +347,17 @@ class ConsensusEngine:
                         lambda s: ops.dense_mix(s, W, precision=self.precision),
                     )
                 )
+            elif name == "global_average":
+                def dense_avg(x):
+                    return jax.tree.map(
+                        lambda v: jnp.broadcast_to(
+                            v.astype(jnp.float32).mean(axis=0, keepdims=True),
+                            v.shape,
+                        ).astype(v.dtype),
+                        x,
+                    )
+
+                fn = wrap(dense_avg)
             else:
                 raise KeyError(name)
         else:
@@ -411,6 +435,16 @@ class ConsensusEngine:
                     )
 
                 fn = sharded(local_cw, P(ax), extra_in=(P(ax), P()))
+            elif name == "global_average":
+                def local_avg(x):
+                    return jax.tree.map(
+                        lambda v: lax.pmean(
+                            v.astype(jnp.float32), ax
+                        ).astype(v.dtype),
+                        x,
+                    )
+
+                fn = sharded(local_avg, P(ax))
             else:
                 raise KeyError(name)
 

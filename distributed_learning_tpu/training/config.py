@@ -82,6 +82,7 @@ class ExperimentConfig:
     mix_eps: Optional[float] = None
     chebyshev: bool = False
     time_varying_p: Optional[float] = None  # erdos_renyi edge prob per epoch
+    global_avg_every: Optional[int] = None  # Gossip-PGA period (2105.09080)
     # misc
     seed: int = 0
     dropout: bool = True
@@ -245,6 +246,7 @@ class ExperimentConfig:
             batch_size=self.batch_size,
             mix_times=self.mix_times,
             mix_eps=self.mix_eps,
+            global_avg_every=self.global_avg_every,
             mesh=mesh,
             telemetry=telemetry,
             seed=self.seed,

@@ -289,6 +289,8 @@ class GossipTrainer:
         mix_eps: Optional[float] = None,
         topology_schedule: Optional[Callable[[int], Any]] = None,
         chebyshev: bool = False,
+        global_avg_every: Optional[int] = None,
+        mix_times_schedule: Optional[Callable[[int], int]] = None,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         seed: int = 0,
@@ -345,6 +347,10 @@ class GossipTrainer:
                 "mix_eps is not supported with topology_schedule; "
                 "time-varying mixing runs a fixed mix_times rounds per epoch"
             )
+        if global_avg_every is not None and global_avg_every < 1:
+            raise ValueError("global_avg_every must be >= 1")
+        self.global_avg_every = global_avg_every
+        self.mix_times_schedule = mix_times_schedule
         if weights is None and topology_schedule is not None:
             weights = topology_schedule(0)
         W = resolve_mixing_matrix(weights, self.node_names)
@@ -560,7 +566,27 @@ class GossipTrainer:
         mixed = False
         params, bs, opt, rng = self._state
         if epoch_idx + 1 >= self.epoch_cons_num and len(self.node_names) > 1:
-            if self.topology_schedule is not None:
+            mix_times = self.mix_times
+            if self.mix_times_schedule is not None:
+                # Adaptive averaging period (arXiv:1910.13598 — communicate
+                # less early, more as training converges, or vice versa).
+                mix_times = int(self.mix_times_schedule(epoch_idx))
+                if mix_times < 1:
+                    raise ValueError(
+                        f"mix_times_schedule({epoch_idx}) returned "
+                        f"{mix_times}; must be >= 1 (0 would silently skip "
+                        "gossip while reporting a mixed epoch)"
+                    )
+            consensus_epochs = epoch_idx + 1 - self.epoch_cons_num
+            if (
+                self.global_avg_every is not None
+                and consensus_epochs % self.global_avg_every
+                == self.global_avg_every - 1
+            ):
+                # Gossip-PGA (arXiv:2105.09080): every H-th consensus epoch
+                # is one exact all-reduce, zeroing the consensus residual.
+                params = self.engine.global_average(params)
+            elif self.topology_schedule is not None:
                 # Time-varying graph: resample, resolve, mix via the
                 # traced-W path (no recompilation per epoch).
                 W_e = resolve_mixing_matrix(
@@ -574,17 +600,17 @@ class GossipTrainer:
                             f"graph with gamma={g_e}; Chebyshev acceleration "
                             "needs a connected graph with gamma < 1"
                         )
-                    omegas = chebyshev_omegas(g_e, self.mix_times)
+                    omegas = chebyshev_omegas(g_e, mix_times)
                     params = self.engine.mix_chebyshev_with(params, W_e, omegas)
                 else:
-                    params = self.engine.mix_with(params, W_e, times=self.mix_times)
+                    params = self.engine.mix_with(params, W_e, times=mix_times)
             elif self.chebyshev:
-                params = self.engine.mix_chebyshev(params, times=self.mix_times)
+                params = self.engine.mix_chebyshev(params, times=mix_times)
             elif self.mix_eps is None:
-                params = self.engine.mix(params, times=self.mix_times)
+                params = self.engine.mix(params, times=mix_times)
             else:
                 params, _, _ = self.engine.mix_until(
-                    params, eps=self.mix_eps, min_times=self.mix_times
+                    params, eps=self.mix_eps, min_times=mix_times
                 )
             mixed = True
             self._state = (params, bs, opt, rng)

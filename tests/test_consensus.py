@@ -341,3 +341,20 @@ def test_time_varying_chebyshev_converges_faster_than_plain():
         jax.tree.leaves(_tree_mean(x0)), jax.tree.leaves(_tree_mean(x_cheby))
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_global_average_is_exact_consensus(sharded):
+    """global_average == the gamma=0 all-reduce: every agent gets the exact
+    mean, residual drops to ~0 in one call."""
+    topo = Topology.ring(8)
+    eng = _make_engine(topo, sharded)
+    x = _tree_state(8, seed=13)
+    out = eng.global_average(eng.shard(x))
+    for key in x:
+        mean = np.asarray(x[key]).mean(axis=0)
+        np.testing.assert_allclose(
+            np.asarray(out[key]), np.broadcast_to(mean, x[key].shape),
+            atol=1e-6,
+        )
+    assert float(eng.max_deviation(out)) < 1e-5

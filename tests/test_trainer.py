@@ -321,3 +321,53 @@ def test_chebyshev_config_validation():
         GossipTrainer(
             topology_schedule=lambda e: Topology.ring(3), mix_eps=1e-4, **kw
         )
+
+
+def test_gossip_pga_and_adaptive_mix_times():
+    """Gossip-PGA: every H-th consensus epoch is exact averaging (residual
+    ~0); the adaptive mix_times schedule is consulted per epoch."""
+    rng = np.random.default_rng(0)
+    names = list(range(4))
+    train = {
+        i: (
+            rng.normal(size=(64, 8)).astype(np.float32),
+            rng.integers(0, 3, size=(64,)).astype(np.int32),
+        )
+        for i in names
+    }
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    asked = []
+
+    def times_schedule(epoch):
+        asked.append(epoch)
+        return 1
+
+    tr = GossipTrainer(
+        node_names=names,
+        model="mlp",
+        model_kwargs={"hidden_dim": 16, "output_dim": 3},
+        train_data=train,
+        weights=Topology.ring(4),
+        batch_size=16,
+        epoch=3,
+        stat_step=2,
+        dropout=False,
+        global_avg_every=2,
+        mix_times_schedule=times_schedule,
+    )
+    tr.initialize_nodes()
+    out0 = tr.train_epoch()  # consensus epoch 0: gossip
+    out1 = tr.train_epoch()  # consensus epoch 1: global average (H=2)
+    assert out0["mixed"] and out1["mixed"]
+    # After exact averaging the residual is (numerically) zero.
+    assert out1["deviation"] < 1e-5
+    assert out0["deviation"] > out1["deviation"]
+    assert asked == [0, 1]
+
+    with pytest.raises(ValueError, match="global_avg_every"):
+        GossipTrainer(
+            node_names=names, model="mlp",
+            model_kwargs={"hidden_dim": 8, "output_dim": 3},
+            train_data=train, batch_size=16, global_avg_every=0,
+        )
