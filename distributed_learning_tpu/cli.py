@@ -61,6 +61,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--time-varying-p", type=float, default=None)
     p.add_argument("--global-avg-every", type=int, default=None,
                    help="Gossip-PGA: exact all-reduce every H-th epoch")
+    p.add_argument("--augment", action="store_true",
+                   help="jitted RandomCrop+Flip train augmentation")
     p.add_argument("--lr-schedule", default=None, choices=["wrn_step"])
     p.add_argument("--n-train", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
@@ -146,6 +148,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             setattr(cfg, field, value)
     if args.chebyshev:
         cfg.chebyshev = True
+    if args.augment:
+        cfg.augment = True
     if cfg.checkpoint_dir is None and not from_file:
         cfg.checkpoint_dir = "checkpoint"
     return cfg

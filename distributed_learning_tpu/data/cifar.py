@@ -132,15 +132,28 @@ def normalize(x: jax.Array, dataset: str = "cifar10") -> jax.Array:
     return (x.astype(jnp.float32) / 255.0 - mean) / std
 
 
-def augment_batch(rng: jax.Array, x: jax.Array) -> jax.Array:
+def augment_batch(
+    rng: jax.Array, x: jax.Array, pad_value: jax.Array | float = 0.0
+) -> jax.Array:
     """RandomCrop(32, padding=4) + RandomHorizontalFlip, jitted/vmapped.
 
     Operates on (B, 32, 32, 3) images of any float dtype; pure function of
     the PRNG key so it composes into the compiled train step.
+
+    ``pad_value``: what the crop borders contain — scalar or per-channel
+    (3,).  The reference pipeline crops BEFORE normalization, so its
+    borders are black pixels that normalize to (0 - mean)/std per channel;
+    callers working on normalized images should pass
+    :func:`normalized_pad_value` to match (zeros would be the dataset
+    mean, not black).
     """
     b = x.shape[0]
     k_crop, k_flip = jax.random.split(rng)
+    pv = jnp.broadcast_to(jnp.asarray(pad_value, x.dtype), (3,))
     pad = jnp.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="constant")
+    # Stamp the per-channel border value (jnp.pad only takes scalars).
+    mask = jnp.zeros((1, 40, 40, 1), x.dtype).at[:, 4:36, 4:36, :].set(1.0)
+    pad = pad * mask + pv * (1.0 - mask)
     offs = jax.random.randint(k_crop, (b, 2), 0, 9)
     flip = jax.random.bernoulli(k_flip, 0.5, (b,))
 
@@ -149,6 +162,12 @@ def augment_batch(rng: jax.Array, x: jax.Array) -> jax.Array:
         return jax.lax.cond(fl, lambda i: i[:, ::-1, :], lambda i: i, img)
 
     return jax.vmap(one)(pad, offs, flip)
+
+
+def normalized_pad_value(dataset: str = "cifar10") -> np.ndarray:
+    """Per-channel value of a black pixel after :func:`normalize` — the
+    crop-border content matching a crop-before-normalize pipeline."""
+    return (0.0 - CIFAR_MEAN[dataset]) / CIFAR_STD[dataset]
 
 
 def shard_dataset(

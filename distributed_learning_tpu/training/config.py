@@ -86,6 +86,7 @@ class ExperimentConfig:
     # misc
     seed: int = 0
     dropout: bool = True
+    augment: bool = False  # jitted RandomCrop+Flip inside the train step
     checkpoint_dir: Optional[str] = None
 
     # ------------------------------------------------------------------ #
@@ -207,6 +208,19 @@ class ExperimentConfig:
                 "weight_mode='sdp' is meaningless with time_varying_p (the "
                 "graph is resampled every epoch); use metropolis"
             )
+        aug_pad: Any = 0.0
+        if self.augment:
+            if self.dataset not in ("cifar10", "cifar100"):
+                raise ValueError(
+                    f"augment=True is only meaningful for image datasets; "
+                    f"got dataset={self.dataset!r}"
+                )
+            from distributed_learning_tpu.data.cifar import normalized_pad_value
+
+            # build_data normalizes before sharding, so crop borders must
+            # carry the normalized value of black to match the reference's
+            # crop-before-normalize pipeline.
+            aug_pad = normalized_pad_value(self.dataset)
         shards, test = self.build_data()
         lr: Any = self.learning_rate
         if self.lr_schedule == "wrn_step":
@@ -251,4 +265,6 @@ class ExperimentConfig:
             telemetry=telemetry,
             seed=self.seed,
             dropout=self.dropout,
+            augment=self.augment,
+            augment_pad_value=aug_pad,
         )

@@ -295,6 +295,8 @@ class GossipTrainer:
         telemetry: Optional[TelemetryProcessor] = None,
         seed: int = 0,
         dropout: bool = True,
+        augment: bool = False,
+        augment_pad_value: Any = 0.0,
         eval_batch_size: int = 1024,
     ):
         self.eval_batch_size = int(eval_batch_size)
@@ -328,6 +330,8 @@ class GossipTrainer:
         self.mix_eps = mix_eps
         self.seed = seed
         self.dropout = dropout
+        self.augment = bool(augment)
+        self.augment_pad_value = augment_pad_value
 
         # Mixing matrix: MasterNode's `weights` topology dict, a Topology
         # (-> Metropolis), an explicit matrix, or None (isolated nodes).
@@ -369,6 +373,11 @@ class GossipTrainer:
 
         # Static per-node data (truncated to a common batch grid).
         self._Xs, self._ys = self._stack_data(train_data, batch_size)
+        if self.augment and self._Xs.shape[2:] != (32, 32, 3):
+            raise ValueError(
+                "augment=True needs (32, 32, 3) image inputs; got per-sample "
+                f"shape {tuple(self._Xs.shape[2:])}"
+            )
         max_len = self._Xs.shape[1] // batch_size
         self.epoch_len = min(epoch_len or max_len, max_len)
         if self.epoch_len < 1:
@@ -428,7 +437,20 @@ class GossipTrainer:
             variables = model.init(rng, x0, train=False)
             return variables
 
+        augment = self.augment
+        aug_pad = self.augment_pad_value
+
         def train_step(params, batch_stats, opt_state, x, y, rng):
+            if augment:
+                # Jitted RandomCrop(32, pad 4) + flip fused into the step
+                # (the torchvision train transforms of Man_Colab cell 16;
+                # pass augment_pad_value=normalized_pad_value(dataset) for
+                # crop borders that match its crop-before-normalize order).
+                from distributed_learning_tpu.data.cifar import augment_batch
+
+                rng, k_aug = jax.random.split(rng)
+                x = augment_batch(k_aug, x, pad_value=aug_pad)
+
             def lossf(p):
                 variables = {"params": p}
                 if batch_stats is not None:

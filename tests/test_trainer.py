@@ -371,3 +371,67 @@ def test_gossip_pga_and_adaptive_mix_times():
             model_kwargs={"hidden_dim": 8, "output_dim": 3},
             train_data=train, batch_size=16, global_avg_every=0,
         )
+
+
+def test_augmentation_changes_training_but_stays_finite():
+    """augment=True applies the jitted crop+flip inside the step; training
+    remains finite and the option round-trips through ExperimentConfig."""
+    (X, y), _ = synthetic_cifar(n_train=256, n_test=32, seed=0)
+    Xn = np.asarray(normalize(jnp.asarray(X)))
+    names = [0, 1]
+    shards = shard_dataset(Xn, y, names, batch_size=16, seed=0)
+    kw = dict(
+        node_names=names, model="lenet", model_args=[10],
+        train_data=shards, batch_size=16, stat_step=2, epoch=1,
+        dropout=False,
+    )
+    plain = GossipTrainer(**kw)
+    plain.initialize_nodes()
+    out_plain = plain.train_epoch()
+    aug = GossipTrainer(augment=True, **kw)
+    aug.initialize_nodes()
+    out_aug = aug.train_epoch()
+    assert np.isfinite(out_aug["train_loss"]).all()
+    # Same data+seed, different pixels seen -> different loss trajectory.
+    assert not np.allclose(out_plain["train_loss"], out_aug["train_loss"])
+
+
+def test_augment_validation_and_pad_value():
+    """Non-image data rejects augment up front; config computes the
+    normalized-black pad value; augment_batch borders carry it."""
+    import jax
+    from distributed_learning_tpu.data.cifar import (
+        augment_batch,
+        normalized_pad_value,
+    )
+    from distributed_learning_tpu.training import ExperimentConfig
+
+    rng = np.random.default_rng(0)
+    tabular = {
+        i: (
+            rng.normal(size=(32, 8)).astype(np.float32),
+            rng.integers(0, 2, size=(32,)).astype(np.int32),
+        )
+        for i in range(2)
+    }
+    with pytest.raises(ValueError, match="image inputs"):
+        GossipTrainer(
+            node_names=[0, 1], model="mlp",
+            model_kwargs={"hidden_dim": 8, "output_dim": 2},
+            train_data=tabular, batch_size=8, augment=True,
+        )
+    with pytest.raises(ValueError, match="image datasets"):
+        ExperimentConfig(
+            node_names=[0, 1], dataset="titanic", augment=True,
+            model="ann", model_args=[2],
+        ).build()
+
+    pv = normalized_pad_value("cifar10")
+    x = jnp.ones((2, 32, 32, 3), jnp.float32) * 5.0
+    out = augment_batch(jax.random.key(0), x, pad_value=pv)
+    vals = np.asarray(out).reshape(-1, 3)
+    # Any border pixel that survived the crop equals pv, not 0.
+    border = vals[~np.isclose(vals[:, 0], 5.0)]
+    if len(border):
+        np.testing.assert_allclose(border, np.broadcast_to(pv, border.shape),
+                                   rtol=1e-5)
