@@ -37,7 +37,7 @@ def run(
     edge_p: float = 0.4,
 ):
     full = common.full_scale()
-    n_agents = n_agents or (8 if full else (4 if common.smoke() else 4))
+    n_agents = n_agents or (8 if full else (2 if common.smoke() else 4))
     depth = depth or (28 if full else 10)
     widen = widen or (10 if full else 1)
     batch_size = batch_size or (128 if full else 8)

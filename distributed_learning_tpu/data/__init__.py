@@ -11,6 +11,7 @@ from distributed_learning_tpu.data.cifar import (
     CIFAR_MEAN,
     CIFAR_STD,
     augment_batch,
+    normalized_pad_value,
     load_cifar,
     normalize,
     shard_dataset,
@@ -26,6 +27,7 @@ __all__ = [
     "CIFAR_MEAN",
     "CIFAR_STD",
     "augment_batch",
+    "normalized_pad_value",
     "load_cifar",
     "normalize",
     "shard_dataset",

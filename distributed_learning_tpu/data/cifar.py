@@ -30,6 +30,7 @@ __all__ = [
     "load_cifar",
     "synthetic_cifar",
     "normalize",
+    "normalized_pad_value",
     "augment_batch",
     "shard_dataset",
 ]
@@ -150,10 +151,8 @@ def augment_batch(
     b = x.shape[0]
     k_crop, k_flip = jax.random.split(rng)
     pv = jnp.broadcast_to(jnp.asarray(pad_value, x.dtype), (3,))
-    pad = jnp.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="constant")
-    # Stamp the per-channel border value (jnp.pad only takes scalars).
-    mask = jnp.zeros((1, 40, 40, 1), x.dtype).at[:, 4:36, 4:36, :].set(1.0)
-    pad = pad * mask + pv * (1.0 - mask)
+    pad = jnp.broadcast_to(pv, (b, 40, 40, 3)).astype(x.dtype)
+    pad = pad.at[:, 4:36, 4:36, :].set(x)
     offs = jax.random.randint(k_crop, (b, 2), 0, 9)
     flip = jax.random.bernoulli(k_flip, 0.5, (b,))
 

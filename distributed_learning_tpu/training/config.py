@@ -215,7 +215,7 @@ class ExperimentConfig:
                     f"augment=True is only meaningful for image datasets; "
                     f"got dataset={self.dataset!r}"
                 )
-            from distributed_learning_tpu.data.cifar import normalized_pad_value
+            from distributed_learning_tpu.data import normalized_pad_value
 
             # build_data normalizes before sharding, so crop borders must
             # carry the normalized value of black to match the reference's
