@@ -63,6 +63,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Gossip-PGA: exact all-reduce every H-th epoch")
     p.add_argument("--augment", action="store_true",
                    help="jitted RandomCrop+Flip train augmentation")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize activations in backward (saves HBM)")
     p.add_argument("--lr-schedule", default=None, choices=["wrn_step"])
     p.add_argument("--n-train", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
@@ -150,6 +152,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         cfg.chebyshev = True
     if args.augment:
         cfg.augment = True
+    if args.remat:
+        cfg.remat = True
     if cfg.checkpoint_dir is None and not from_file:
         cfg.checkpoint_dir = "checkpoint"
     return cfg

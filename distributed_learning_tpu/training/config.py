@@ -87,6 +87,7 @@ class ExperimentConfig:
     seed: int = 0
     dropout: bool = True
     augment: bool = False  # jitted RandomCrop+Flip inside the train step
+    remat: bool = False    # recompute activations in backward (HBM headroom)
     checkpoint_dir: Optional[str] = None
 
     # ------------------------------------------------------------------ #
@@ -267,4 +268,5 @@ class ExperimentConfig:
             dropout=self.dropout,
             augment=self.augment,
             augment_pad_value=aug_pad,
+            remat=self.remat,
         )

@@ -297,6 +297,8 @@ class GossipTrainer:
         dropout: bool = True,
         augment: bool = False,
         augment_pad_value: Any = 0.0,
+        remat: bool = False,
+        donate_state: bool = True,
         eval_batch_size: int = 1024,
     ):
         self.eval_batch_size = int(eval_batch_size)
@@ -332,6 +334,8 @@ class GossipTrainer:
         self.dropout = dropout
         self.augment = bool(augment)
         self.augment_pad_value = augment_pad_value
+        self.remat = bool(remat)
+        self.donate_state = bool(donate_state)
 
         # Mixing matrix: MasterNode's `weights` topology dict, a Topology
         # (-> Metropolis), an explicit matrix, or None (isolated nodes).
@@ -439,6 +443,7 @@ class GossipTrainer:
 
         augment = self.augment
         aug_pad = self.augment_pad_value
+        remat = self.remat
 
         def train_step(params, batch_stats, opt_state, x, y, rng):
             if augment:
@@ -468,6 +473,10 @@ class GossipTrainer:
                 acc = metric_fn(logits, y)
                 return loss, (mut.get("batch_stats", None), acc)
 
+            if remat:
+                # Rematerialize activations in the backward pass: trades
+                # FLOPs for HBM, buying batch/model headroom at WRN scale.
+                lossf = jax.checkpoint(lossf)
             (loss, (new_bs, acc)), grads = jax.value_and_grad(
                 lossf, has_aux=True
             )(params)
@@ -497,7 +506,20 @@ class GossipTrainer:
             )
             return (params, bs, opt, rng), losses, accs
 
-        self._jit_epoch = jax.jit(epoch_fn)
+        # Donating the carried state lets XLA reuse its buffers in place —
+        # at WRN scale the stacked params/opt slots dominate HBM, so the
+        # epoch step must not hold two copies.  Consequence: references to
+        # a PREVIOUS epoch's state (e.g. a saved `trainer.state`) are dead
+        # arrays after the next train_epoch on an accelerator; read state
+        # after training, or pass donate_state=False to keep old states
+        # alive.  (CPU ignores donation and warns per call, so only donate
+        # on accelerators.)
+        donate = (
+            (0,)
+            if self.donate_state and jax.default_backend() != "cpu"
+            else ()
+        )
+        self._jit_epoch = jax.jit(epoch_fn, donate_argnums=donate)
 
         def eval_fn(params, batch_stats, X, y):
             def one(p, b):
@@ -687,6 +709,12 @@ class GossipTrainer:
     # ------------------------------------------------------------------ #
     @property
     def state(self):
+        """Current (params, batch_stats, opt_state, rng) tuple.
+
+        With ``donate_state=True`` (default) the arrays are donated to the
+        next ``train_epoch`` on accelerators — read state AFTER training,
+        not across epochs.
+        """
         return self._state
 
     def node_parameters(self) -> Dict[Hashable, Pytree]:

@@ -435,3 +435,32 @@ def test_augment_validation_and_pad_value():
     if len(border):
         np.testing.assert_allclose(border, np.broadcast_to(pv, border.shape),
                                    rtol=1e-5)
+
+
+def test_remat_matches_plain_training():
+    """remat=True recomputes activations in backward but must produce the
+    same numerics as plain training."""
+    rng = np.random.default_rng(0)
+    names = [0, 1]
+    train = {
+        i: (
+            rng.normal(size=(32, 8)).astype(np.float32),
+            rng.integers(0, 3, size=(32,)).astype(np.int32),
+        )
+        for i in names
+    }
+    kw = dict(
+        node_names=names, model="mlp",
+        model_kwargs={"hidden_dim": 16, "output_dim": 3},
+        train_data=train, batch_size=8, stat_step=2, epoch=1, dropout=False,
+    )
+    a = GossipTrainer(**kw)
+    a.initialize_nodes()
+    out_a = a.train_epoch()
+    b = GossipTrainer(remat=True, **kw)
+    b.initialize_nodes()
+    out_b = b.train_epoch()
+    np.testing.assert_allclose(
+        np.asarray(out_a["train_loss"]), np.asarray(out_b["train_loss"]),
+        rtol=1e-5,
+    )
