@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jitted RandomCrop+Flip train augmentation")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize activations in backward (saves HBM)")
+    p.add_argument("--no-donate", action="store_true",
+                   help="keep epoch state buffers alive instead of donating "
+                        "them (needed to hold trainer.state across epochs)")
     p.add_argument("--lr-schedule", default=None, choices=["wrn_step"])
     p.add_argument("--n-train", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
@@ -154,6 +157,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         cfg.augment = True
     if args.remat:
         cfg.remat = True
+    if args.no_donate:
+        cfg.donate_state = False
     if cfg.checkpoint_dir is None and not from_file:
         cfg.checkpoint_dir = "checkpoint"
     return cfg

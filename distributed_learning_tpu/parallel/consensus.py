@@ -119,6 +119,80 @@ class ConsensusEngine:
             )
         return acc
 
+    def _ring_offset_weights(
+        self, W: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+        """Decompose ``W``'s off-diagonal onto signed ring offsets.
+
+        Returns ``(self_w, w_fwd, w_bwd, k_hops)``: ``w_fwd[i, k-1]`` weights
+        agent ``(i-k) % n`` (reached by ``k`` forward relay hops on the
+        device ring) and ``w_bwd[i, k-1]`` weights ``(i+k) % n``; ``k_hops``
+        is the largest offset carrying any weight — the number of relay
+        rounds a routed gossip round needs.  For ``n`` even the antipodal
+        offset ``n/2`` is reachable both ways and is counted once (forward).
+        """
+        n = self.n
+        k_cap = n // 2
+        w_fwd = np.zeros((n, max(k_cap, 1)), np.float32)
+        w_bwd = np.zeros((n, max(k_cap, 1)), np.float32)
+        i = np.arange(n)
+        for k in range(1, k_cap + 1):
+            w_fwd[:, k - 1] = W[i, (i - k) % n]
+            if not (n % 2 == 0 and k == n // 2):
+                w_bwd[:, k - 1] = W[i, (i + k) % n]
+        k_hops = 0
+        for k in range(k_cap, 0, -1):
+            if w_fwd[:, k - 1].any() or w_bwd[:, k - 1].any():
+                k_hops = k
+                break
+        return np.diag(W).astype(np.float32), w_fwd, w_bwd, k_hops
+
+    def _local_ring_mix(
+        self,
+        x: Pytree,
+        self_w: jax.Array,
+        w_fwd: jax.Array,
+        w_bwd: jax.Array,
+        k_hops: jax.Array,
+    ) -> Pytree:
+        """One gossip round under traced per-offset weights, routed over the
+        device ring with <=k-hop relays (SURVEY §7 hard part 1: multi-hop
+        routing for graphs whose edges are not physical ring neighbors).
+
+        Each relay hop rotates the value one step in both ring directions
+        (two ``ppermute``s) and accumulates that offset's weighted
+        contribution, so one round moves ``2*k_hops`` shard-sized messages
+        per device — scaling with the resampled graph's maximal ring span
+        instead of the agent count like the all_gather fallback.  Both the
+        weights and ``k_hops`` are traced: resampling the topology each
+        epoch reuses the compiled program.
+        """
+        ax = self.axis_name
+        n = self.n
+        fwd_pairs = [(j, (j + 1) % n) for j in range(n)]
+        bwd_pairs = [(j, (j - 1) % n) for j in range(n)]
+
+        # Accumulate in float32 regardless of the state dtype (same contract
+        # as the allgather path: ~1e-4 consensus residuals would be floored
+        # by bf16 accumulation); cast back once at the end.
+        def scale(v: jax.Array, s: jax.Array) -> jax.Array:
+            return v.astype(jnp.float32) * s
+
+        def body(k, carry):
+            fwd, bwd, acc = carry
+            fwd = jax.tree.map(lambda v: lax.ppermute(v, ax, fwd_pairs), fwd)
+            bwd = jax.tree.map(lambda v: lax.ppermute(v, ax, bwd_pairs), bwd)
+            wf = lax.dynamic_index_in_dim(w_fwd[0], k, keepdims=False)
+            wb = lax.dynamic_index_in_dim(w_bwd[0], k, keepdims=False)
+            acc = jax.tree.map(
+                lambda a, f, b: a + scale(f, wf) + scale(b, wb), acc, fwd, bwd
+            )
+            return fwd, bwd, acc
+
+        acc0 = jax.tree.map(lambda v: scale(v, self_w[0]), x)
+        _, _, acc = lax.fori_loop(0, k_hops, body, (x, x, acc0))
+        return jax.tree.map(lambda a, v: a.astype(v.dtype), acc, x)
+
     def _local_allgather_mix(self, x: Pytree, W_row: jax.Array) -> Pytree:
         """One gossip round against a *traced* mixing row: all_gather the
         agent axis and contract with this device's row of W (masked
@@ -209,39 +283,100 @@ class ConsensusEngine:
             )
         return self._jit_cache[key](stacked)
 
-    def mix_with(self, stacked: Pytree, W, times: int = 1) -> Pytree:
+    def _route_for(self, W: np.ndarray, route: str) -> Tuple[str, tuple]:
+        """Pick the sharded execution strategy for a traced mixing matrix.
+
+        ``"ring"`` routes neighbor values over the device ring with k-hop
+        relays (bandwidth ``2k`` shard-messages/round, ``k`` = max ring span
+        of present edges); ``"allgather"`` is the masked all-to-all
+        (``n-1`` shard-messages/round with a ring all-gather, plus an
+        ``(n, P)`` buffer).  ``"auto"`` picks ring exactly when it moves
+        less data.  Returns the choice plus the ring decomposition.
+        """
+        if route not in ("auto", "ring", "allgather"):
+            raise ValueError(f"unknown route {route!r}")
+        self_w, w_fwd, w_bwd, k_hops = self._ring_offset_weights(W)
+        if route == "auto":
+            route = "ring" if 2 * k_hops < self.n - 1 else "allgather"
+        return route, (self_w, w_fwd, w_bwd, k_hops)
+
+    def mix_with(
+        self, stacked: Pytree, W, times: int = 1, *, route: str = "auto"
+    ) -> Pytree:
         """Run ``times`` gossip rounds under a *traced* mixing matrix ``W``.
 
         This is the time-varying-graph path (BASELINE config 5: "time-varying
-        random graph"): the compiled program takes ``W`` as a runtime
-        argument, so resampling the topology every epoch costs a host->device
-        transfer of an (n, n) matrix instead of a recompilation.
+        random graph"): the compiled program takes the mixing weights as
+        runtime arguments, so resampling the topology every epoch costs a
+        host->device transfer of an (n, n) matrix instead of a recompilation.
 
-        Dense mode contracts with ``W`` directly.  Sharded mode cannot bake a
-        ppermute schedule (the edge set is dynamic), so it emulates the
-        general graph with a masked all-to-all: each device ``all_gather``-s
-        the agent axis and contracts with its own row of ``W`` (the
-        "emulating general graphs with masked all-to-all" strategy for
-        arbitrary topologies on a physical ring/torus).
+        Dense mode contracts with ``W`` directly.  Sharded mode has two
+        strategies (SURVEY §7 hard part 1 — arbitrary graphs on a physical
+        ring): sparse graphs route neighbor values over the device ring with
+        <=k-hop relays (:meth:`_local_ring_mix` — bandwidth scales with the
+        graph's maximal ring span, not the agent count), dense graphs
+        emulate the general graph with a masked all-to-all (``all_gather``
+        the agent axis, contract with this device's row of ``W``).
+        ``route="auto"`` picks whichever moves less data per round.
         """
-        W = jnp.asarray(W, dtype=jnp.float32)
+        W = np.asarray(W, dtype=np.float32)
         if W.shape != (self.n, self.n):
             raise ValueError(f"W must have shape ({self.n}, {self.n}), got {W.shape}")
-        return self._get_jitted("mix_with")(stacked, W, jnp.int32(times))
+        if route not in ("auto", "ring", "allgather"):
+            raise ValueError(f"unknown route {route!r}")
+        if self.mesh is None:
+            return self._get_jitted("mix_with")(
+                stacked, jnp.asarray(W), jnp.int32(times)
+            )
+        route, (self_w, w_fwd, w_bwd, k_hops) = self._route_for(W, route)
+        if route == "allgather":
+            return self._get_jitted("mix_with")(
+                stacked, jnp.asarray(W), jnp.int32(times)
+            )
+        return self._get_jitted("mix_with_ring")(
+            stacked,
+            jnp.asarray(self_w),
+            jnp.asarray(w_fwd),
+            jnp.asarray(w_bwd),
+            jnp.int32(k_hops),
+            jnp.int32(times),
+        )
 
-    def mix_chebyshev_with(self, stacked: Pytree, W, omegas) -> Pytree:
+    def mix_chebyshev_with(
+        self, stacked: Pytree, W, omegas, *, route: str = "auto"
+    ) -> Pytree:
         """Chebyshev-accelerated gossip under a traced ``W`` and traced
         ``omegas`` schedule (host-computed from that round's graph via
         :func:`~distributed_learning_tpu.parallel.schedule.chebyshev_omegas`).
 
         Only the *number* of rounds is static; changing the graph or its
-        gamma between epochs reuses the compiled program.
+        gamma between epochs reuses the compiled program.  Sharded mode
+        routes each round like :meth:`mix_with` (ring relays for sparse
+        graphs, masked all-to-all for dense ones).
         """
-        W = jnp.asarray(W, dtype=jnp.float32)
+        W = np.asarray(W, dtype=np.float32)
         if W.shape != (self.n, self.n):
             raise ValueError(f"W must have shape ({self.n}, {self.n}), got {W.shape}")
+        if route not in ("auto", "ring", "allgather"):
+            raise ValueError(f"unknown route {route!r}")
         omegas = jnp.asarray(omegas, dtype=jnp.float32)
-        return self._get_jitted("mix_chebyshev_with")(stacked, W, omegas)
+        if self.mesh is None:
+            return self._get_jitted("mix_chebyshev_with")(
+                stacked, jnp.asarray(W), omegas
+            )
+        route, (self_w, w_fwd, w_bwd, k_hops) = self._route_for(W, route)
+        if route == "allgather":
+            return self._get_jitted("mix_chebyshev_with")(
+                stacked, jnp.asarray(W), omegas
+            )
+        return self._get_jitted("mix_chebyshev_with_ring")(
+            stacked,
+            jnp.asarray(self_w),
+            jnp.asarray(w_fwd),
+            jnp.asarray(w_bwd),
+            jnp.int32(k_hops),
+            omegas,
+        )
 
     def global_average(self, stacked: Pytree) -> Pytree:
         """Exact averaging — the gamma=0 degenerate case (centralized DP
@@ -428,6 +563,15 @@ class ConsensusEngine:
                     )
 
                 fn = sharded(local_mw, P(ax), extra_in=(P(ax), P()))
+            elif name == "mix_with_ring":
+                def local_mr(x, sw, wf, wb, k, t):
+                    return self._run_times(
+                        x, t, lambda s: self._local_ring_mix(s, sw, wf, wb, k)
+                    )
+
+                fn = sharded(
+                    local_mr, P(ax), extra_in=(P(ax), P(ax), P(ax), P(), P())
+                )
             elif name == "mix_chebyshev_with":
                 def local_cw(x, W_rows, om):
                     return self._cheby_traced(
@@ -435,6 +579,15 @@ class ConsensusEngine:
                     )
 
                 fn = sharded(local_cw, P(ax), extra_in=(P(ax), P()))
+            elif name == "mix_chebyshev_with_ring":
+                def local_cr(x, sw, wf, wb, k, om):
+                    return self._cheby_traced(
+                        x, om, lambda s: self._local_ring_mix(s, sw, wf, wb, k)
+                    )
+
+                fn = sharded(
+                    local_cr, P(ax), extra_in=(P(ax), P(ax), P(ax), P(), P())
+                )
             elif name == "global_average":
                 def local_avg(x):
                     return jax.tree.map(

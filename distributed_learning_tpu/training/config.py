@@ -88,6 +88,8 @@ class ExperimentConfig:
     dropout: bool = True
     augment: bool = False  # jitted RandomCrop+Flip inside the train step
     remat: bool = False    # recompute activations in backward (HBM headroom)
+    donate_state: bool = True  # donate epoch state buffers (False keeps a
+                               # saved `trainer.state` alive across epochs)
     checkpoint_dir: Optional[str] = None
 
     # ------------------------------------------------------------------ #
@@ -269,4 +271,5 @@ class ExperimentConfig:
             augment=self.augment,
             augment_pad_value=aug_pad,
             remat=self.remat,
+            donate_state=self.donate_state,
         )

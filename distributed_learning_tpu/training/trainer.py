@@ -486,23 +486,29 @@ class GossipTrainer:
 
         vstep = jax.vmap(train_step)
 
-        def epoch_fn(state, Xb, yb):
+        def epoch_fn(state, Xs, ys, idx):
             """scan over epoch_len steps of the vmapped train step.
 
-            ``Xb``: (steps, n, B, ...); ``yb``: (steps, n, B).
+            ``Xs``: (n, m, ...) resident per-node shards; ``ys``: (n, m, ...);
+            ``idx``: (steps, n, B) int32 shuffle indices.  Each step gathers
+            its batch from the resident shards inside the scan, so the
+            permuted epoch tensor is never materialized and the only
+            per-epoch host->device transfer is the index array.
             Returns state plus (steps, n) loss/acc traces.
             """
+            take = jax.vmap(lambda X, i: jnp.take(X, i, axis=0))
 
-            def body(carry, batch):
+            def body(carry, idx_t):
                 params, bs, opt, rng = carry
-                x, y = batch
+                x = take(Xs, idx_t)
+                y = take(ys, idx_t)
                 rng, *subs = jax.random.split(rng, n + 1)
                 subkeys = jnp.stack(subs)
                 params, bs, opt, loss, acc = vstep(params, bs, opt, x, y, subkeys)
                 return (params, bs, opt, rng), (loss, acc)
 
             (params, bs, opt, rng), (losses, accs) = jax.lax.scan(
-                body, state, (Xb, yb)
+                body, state, idx
             )
             return (params, bs, opt, rng), losses, accs
 
@@ -514,20 +520,32 @@ class GossipTrainer:
         # after training, or pass donate_state=False to keep old states
         # alive.  (CPU ignores donation and warns per call, so only donate
         # on accelerators.)
-        donate = (
-            (0,)
-            if self.donate_state and jax.default_backend() != "cpu"
-            else ()
+        self._donate_active = (
+            self.donate_state and jax.default_backend() != "cpu"
         )
-        self._jit_epoch = jax.jit(epoch_fn, donate_argnums=donate)
+        self._jit_epoch = jax.jit(
+            epoch_fn, donate_argnums=(0,) if self._donate_active else ()
+        )
 
-        def eval_fn(params, batch_stats, X, y):
+        def eval_fn(params, batch_stats, X, y, mask):
+            """Per-node SUM of the metric over the masked batch.
+
+            ``X``/``y`` are padded to a fixed ``eval_batch_size`` so every
+            test batch — including the ragged tail — reuses one compiled
+            executable; ``mask`` zeroes the padding.  The metric is applied
+            per example (``metric_fn`` on a length-1 slice), which is exact
+            for any metric that is a mean of per-example scores.
+            """
+
             def one(p, b):
                 variables = {"params": p}
                 if b is not None:
                     variables["batch_stats"] = b
                 logits = model.apply(variables, X, train=False)
-                return metric_fn(logits, y)
+                per = jax.vmap(lambda l, yy: metric_fn(l[None], yy[None]))(
+                    logits, y
+                )
+                return jnp.sum(per * mask)
 
             if batch_stats is None:
                 return jax.vmap(lambda p: one(p, None))(params)
@@ -538,16 +556,26 @@ class GossipTrainer:
 
     def _eval_accuracy(self, params, bs) -> np.ndarray:
         """Per-node test accuracy, batched over the test set so activations
-        for n_nodes x eval_batch never all materialize at once."""
+        for n_nodes x eval_batch never all materialize at once.  The ragged
+        tail batch is zero-padded to ``eval_batch_size`` and masked out, so
+        the whole eval reuses a single compiled executable."""
         X, y = self.test_data
         ebs = self.eval_batch_size
         total = np.zeros(len(self.node_names))
         seen = 0
         for s in range(0, len(X), ebs):
             xb, yb = X[s : s + ebs], y[s : s + ebs]
-            accs = np.asarray(self._jit_eval(params, bs, xb, yb))
-            total += accs * len(xb)
-            seen += len(xb)
+            k = len(xb)
+            if k < ebs:
+                xb = jnp.concatenate(
+                    [xb, jnp.zeros((ebs - k,) + xb.shape[1:], xb.dtype)]
+                )
+                yb = jnp.concatenate(
+                    [yb, jnp.zeros((ebs - k,) + yb.shape[1:], yb.dtype)]
+                )
+            mask = (jnp.arange(ebs) < k).astype(jnp.float32)
+            total += np.asarray(self._jit_eval(params, bs, xb, yb, mask))
+            seen += k
         return total / max(seen, 1)
 
     # ------------------------------------------------------------------ #
@@ -577,34 +605,42 @@ class GossipTrainer:
         return self
 
     # ------------------------------------------------------------------ #
-    def _epoch_batches(self, epoch_idx: int):
-        """Shuffle each node's shard and lay out (steps, n, B, ...) batches."""
+    def _epoch_indices(self, epoch_idx: int) -> jax.Array:
+        """Per-node shuffle indices for one epoch, laid out (steps, n, B).
+
+        Only these int32 indices cross host->device; the batches themselves
+        are gathered from the resident shards inside the jitted epoch."""
         n, m = self._Xs.shape[0], self._Xs.shape[1]
         steps = self.epoch_len
         rng = np.random.default_rng(self.seed * 1000 + epoch_idx)
-        idx = np.stack([rng.permutation(m)[: steps * self.batch_size] for _ in range(n)])
-        idx_j = jnp.asarray(idx)
-        gather = jax.vmap(lambda X, i: X[i])
-        Xb = gather(self._Xs, idx_j).reshape(
-            (n, steps, self.batch_size) + self._Xs.shape[2:]
-        )
-        # Labels keep any trailing dims (sequence models label every
-        # position: y is (m, T) per node, not (m,)).
-        yb = gather(self._ys, idx_j).reshape(
-            (n, steps, self.batch_size) + self._ys.shape[2:]
-        )
-        return jnp.swapaxes(Xb, 0, 1), jnp.swapaxes(yb, 0, 1)
+        idx = np.stack(
+            [rng.permutation(m)[: steps * self.batch_size] for _ in range(n)]
+        ).astype(np.int32)
+        idx = idx.reshape(n, steps, self.batch_size).swapaxes(0, 1)
+        return jnp.asarray(idx)
 
     def train_epoch(self) -> Dict[str, Any]:
         """One epoch: local SGD on every node, then (maybe) gossip."""
         if self._state is None:
             self.initialize_nodes()
         epoch_idx = self._epochs_done
-        Xb, yb = self._epoch_batches(epoch_idx)
-        self._state, losses, accs = self._jit_epoch(self._state, Xb, yb)
-        losses = np.asarray(losses)  # (steps, n)
-        accs = np.asarray(accs)
-
+        idx = self._epoch_indices(epoch_idx)
+        try:
+            self._state, losses, accs = self._jit_epoch(
+                self._state, self._Xs, self._ys, idx
+            )
+            # Materialize inside the try: dispatch is async, so an execution
+            # failure (e.g. OOM) surfaces here, not at the call above.
+            losses = np.asarray(losses)  # (steps, n)
+            accs = np.asarray(accs)
+        except Exception:
+            if self._donate_active:
+                # The donated input buffers may already be invalidated (e.g.
+                # OOM mid-execution); drop the dangling reference so the next
+                # call re-initializes or restores instead of crashing on
+                # deleted arrays.
+                self._state = None
+            raise
         # Consensus from epoch_cons_num onward (parity: Man_Colab cell 21
         # "the first epoch from which consensus begins"; 1-based epochs).
         mixed = False

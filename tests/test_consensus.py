@@ -285,7 +285,7 @@ def test_mix_with_no_recompile_across_graphs(sharded):
     xs = eng.shard(_tree_state(8, seed=9))
     for seed in range(3):
         W = Topology.erdos_renyi(8, 0.5, seed=seed).metropolis_weights()
-        xs = eng.mix_with(xs, W, times=1)
+        xs = eng.mix_with(xs, W, times=1, route="allgather")
     fn = eng._jit_cache["mix_with"]
     # One trace serves all three graphs (W is a traced argument).  In the
     # sharded mode the cached callable is the jitted shard_map itself; in
@@ -296,6 +296,105 @@ def test_mix_with_no_recompile_across_graphs(sharded):
     after = _tree_mean(xs)
     for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def _sparse_ring_plus_chords(n=8):
+    """Ring + two span-2 chords: max ring span 2, so the routed path needs
+    2 relay hops/round vs the all_gather fallback's n-1 messages."""
+    edges = [(i, (i + 1) % n) for i in range(n)] + [(0, 2), (4, 6)]
+    return Topology.from_edges(edges).metropolis_weights()
+
+
+def test_ring_offset_decomposition_reconstructs_w():
+    eng = ConsensusEngine(Topology.ring(8).metropolis_weights())
+    for W in [
+        Topology.ring(8).metropolis_weights(),
+        _sparse_ring_plus_chords(),
+        Topology.complete(8).metropolis_weights(),
+        Topology.erdos_renyi(8, 0.4, seed=2).metropolis_weights(),
+    ]:
+        self_w, w_fwd, w_bwd, k = eng._ring_offset_weights(W)
+        n = 8
+        R = np.diag(self_w)
+        i = np.arange(n)
+        for kk in range(1, n // 2 + 1):
+            R[i, (i - kk) % n] += w_fwd[:, kk - 1]
+            R[i, (i + kk) % n] += w_bwd[:, kk - 1]
+        np.testing.assert_allclose(R, W, atol=1e-7)
+        # k is exactly the maximal ring span of any present edge.
+        spans = [
+            min((u - v) % n, (v - u) % n)
+            for u in range(n)
+            for v in range(n)
+            if u != v and W[u, v] != 0.0
+        ]
+        assert k == (max(spans) if spans else 0)
+
+
+def test_auto_route_scales_with_span_not_n():
+    """Sparse resampled graphs take the k-hop ring path (bandwidth 2k
+    messages/round); dense graphs fall back to all_gather (n-1)."""
+    eng = ConsensusEngine(Topology.ring(8).metropolis_weights())
+    route, (_, _, _, k) = eng._route_for(_sparse_ring_plus_chords(), "auto")
+    assert route == "ring" and k == 2  # 2*2 < 7 messages
+    route, (_, _, _, k) = eng._route_for(
+        Topology.complete(8).metropolis_weights(), "auto"
+    )
+    assert route == "allgather" and k == 4  # 2*4 >= 7
+
+
+@pytest.mark.parametrize("route", ["ring", "allgather"])
+def test_mix_with_routed_matches_numpy(route):
+    """Both sharded strategies compute exactly W^t @ x for a sparse W."""
+    eng = _make_engine(Topology.ring(8), sharded=True)
+    x = _tree_state(8, seed=7)
+    xs = eng.shard(x)
+    W2 = _sparse_ring_plus_chords()
+    out = eng.mix_with(xs, W2, times=2, route=route)
+    ref = np.linalg.matrix_power(W2, 2)
+    for key in x:
+        flat = np.asarray(x[key]).reshape(8, -1)
+        expect = (ref @ flat).reshape(x[key].shape)
+        np.testing.assert_allclose(np.asarray(out[key]), expect, atol=1e-5)
+
+
+def test_ring_route_no_recompile_across_spans():
+    """Graphs with different spans and weights reuse one compiled ring
+    program (weights AND hop count are traced)."""
+    eng = _make_engine(Topology.ring(8), sharded=True)
+    xs = eng.shard(_tree_state(8, seed=9))
+    for W in [
+        Topology.ring(8).metropolis_weights(),
+        _sparse_ring_plus_chords(),
+        Topology.from_edges(
+            [(i, (i + 1) % 8) for i in range(8)] + [(0, 3)]
+        ).metropolis_weights(),
+    ]:
+        xs = eng.mix_with(xs, W, times=1, route="ring")
+    fn = eng._jit_cache["mix_with_ring"]
+    if hasattr(fn, "_cache_size"):
+        assert fn._cache_size() == 1
+    before = _tree_mean(eng.shard(_tree_state(8, seed=9)))
+    after = _tree_mean(xs)
+    for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("route", ["ring", "allgather"])
+def test_chebyshev_routed_matches_dense(route):
+    from distributed_learning_tpu.parallel.schedule import chebyshev_omegas
+
+    W = _sparse_ring_plus_chords()
+    dense = ConsensusEngine(W)
+    sharded = ConsensusEngine(W, mesh=make_agent_mesh(8))
+    x = _tree_state(8, seed=13)
+    omegas = chebyshev_omegas(exact_gamma(W), 5)
+    expect = dense.mix_chebyshev_with(x, W, omegas)
+    got = sharded.mix_chebyshev_with(sharded.shard(x), W, omegas, route=route)
+    for key in x:
+        np.testing.assert_allclose(
+            np.asarray(got[key]), np.asarray(expect[key]), atol=1e-5
+        )
 
 
 @pytest.mark.parametrize("sharded", [False, True])

@@ -1,0 +1,64 @@
+"""Larger-than-the-fixture virtual meshes, exercised in subprocesses.
+
+The shared conftest pins this process to 8 virtual CPU devices, so scaling
+checks (VERDICT: routed mix_with bandwidth on a 16-device mesh) spawn a
+fresh interpreter with its own ``--xla_force_host_platform_device_count``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT_16 = r"""
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from distributed_learning_tpu.parallel.consensus import (
+    ConsensusEngine, make_agent_mesh,
+)
+from distributed_learning_tpu.parallel.topology import Topology
+
+n = 16
+assert len(jax.devices()) == n, jax.devices()
+
+# Sparse resampled graph: ring + a few short chords (max ring span 3).
+edges = [(i, (i + 1) % n) for i in range(n)] + [(0, 3), (5, 8), (10, 13)]
+W = Topology.from_edges(edges).metropolis_weights()
+
+eng = ConsensusEngine(Topology.ring(n).metropolis_weights(),
+                      mesh=make_agent_mesh(n))
+
+# Auto-routing must pick the k-hop ring path: 2*3 messages/round vs the
+# all_gather fallback's n-1 = 15 — bandwidth follows the graph's span.
+route, (_, _, _, k) = eng._route_for(W, "auto")
+assert route == "ring" and k == 3, (route, k)
+
+rng = np.random.default_rng(0)
+x = {"w": jnp.asarray(rng.normal(size=(n, 5, 3)).astype(np.float32))}
+out = eng.mix_with(eng.shard(x), W, times=2)
+expect = (np.linalg.matrix_power(W, 2) @ np.asarray(x["w"]).reshape(n, -1))
+np.testing.assert_allclose(
+    np.asarray(out["w"]).reshape(n, -1), expect, atol=1e-5)
+print("OK16")
+"""
+
+
+def test_ring_routed_mix_on_16_devices():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT_16],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK16" in proc.stdout

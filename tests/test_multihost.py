@@ -1,0 +1,78 @@
+"""Hermetic 2-process ``jax.distributed`` smoke test for the multihost
+helpers (``parallel/multihost.py``).
+
+The reference's multi-process story is its asyncio-TCP backend
+(``utils/consensus_tcp/``, exercised only by 4 manually-run notebooks);
+the TPU framework's is one SPMD program joined via
+``jax.distributed.initialize``.  This test spawns two CPU processes with 2
+virtual devices each, joins them into one 4-device runtime, and checks
+``initialize`` (idempotence included), ``hybrid_agent_mesh`` ordering, and
+``process_local_agents`` partitioning — the full control-plane path that
+cannot run under the single-process fixture.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = r"""
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from distributed_learning_tpu.parallel import multihost
+
+coordinator, pid = sys.argv[1], int(sys.argv[2])
+multihost.initialize(coordinator, num_processes=2, process_id=pid)
+multihost.initialize(coordinator, num_processes=2, process_id=pid)  # no-op
+
+assert jax.process_count() == 2, jax.process_count()
+devices = jax.devices()
+assert len(devices) == 4, devices
+
+mesh = multihost.hybrid_agent_mesh()
+flat = list(np.asarray(mesh.devices).ravel())
+# Sorted by process first: agents 0-1 on process 0, agents 2-3 on process 1.
+assert [d.process_index for d in flat] == [0, 0, 1, 1], flat
+
+local = multihost.process_local_agents(mesh)
+assert local == ((0, 1) if pid == 0 else (2, 3)), (pid, local)
+print(f"OK-MH {pid}", flush=True)
+"""
+
+
+def test_two_process_initialize_and_local_agents():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, coordinator, str(pid)],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"OK-MH {pid}" in out

@@ -464,3 +464,12 @@ def test_remat_matches_plain_training():
         np.asarray(out_a["train_loss"]), np.asarray(out_b["train_loss"]),
         rtol=1e-5,
     )
+    # Identical losses alone don't establish identical updates — the final
+    # parameters (and BN stats, when present) must agree too.
+    pa, ba = a.state[0], a.state[1]
+    pb, bb = b.state[0], b.state[1]
+    for la, lb in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5)
+    if ba is not None:
+        for la, lb in zip(jax.tree.leaves(ba), jax.tree.leaves(bb)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5)
