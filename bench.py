@@ -96,6 +96,12 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", 16 if full else 3))
     epochs = int(os.environ.get("BENCH_EPOCHS", 3 if full else 1))
     pool = int(os.environ.get("BENCH_POOL", steps * batch))
+    if pool < steps * batch:
+        raise SystemExit(
+            f"BENCH_POOL={pool} must be >= BENCH_STEPS*BENCH_BATCH "
+            f"({steps}*{batch}={steps * batch}): each epoch samples that "
+            "many distinct indices per agent"
+        )
 
     model = WideResNet(
         depth=depth, widen_factor=widen, dropout_rate=0.3, num_classes=10,

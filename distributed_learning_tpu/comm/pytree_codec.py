@@ -1,0 +1,105 @@
+"""Model-pytree <-> flat wire vector adapter for the comm backend.
+
+The reference gossips *flattened* torch parameters — ``Mixer`` round-trips
+every model through ``_get_flatten_model_params`` / ``_load_flatten_params``
+(``utils/consensus_simple/mixer.py:68-76``).  The TCP data plane here
+(:mod:`~distributed_learning_tpu.comm.agent`) likewise moves one flat f32
+vector per agent.  This module is the structured boundary: a model pytree
+crosses the wire as ``(flat f32 vector, TreeSpec)``, where the spec
+(treedef + per-leaf shapes/dtypes) is construction-time static and
+identical on every agent — only the vector ever touches the network, so
+the existing ``run_once``/``run_round`` protocol carries whole models
+unchanged (bf16 wire narrowing included, ``tensor_codec.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import numpy as np
+
+__all__ = ["TreeSpec", "tree_to_flat", "flat_to_tree"]
+
+Pytree = Any
+
+
+def _is_float_dtype(dt: np.dtype) -> bool:
+    if np.issubdtype(dt, np.floating):
+        return True
+    try:  # extension float types (bfloat16 & friends) register in ml_dtypes
+        import ml_dtypes
+
+        return np.issubdtype(dt, ml_dtypes.bfloat16) or dt in (
+            np.dtype(ml_dtypes.bfloat16),
+            np.dtype(ml_dtypes.float8_e4m3fn),
+            np.dtype(ml_dtypes.float8_e5m2),
+        )
+    except Exception:
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class TreeSpec:
+    """Static description of a flattened pytree: enough to rebuild the
+    tree from the wire vector.  Equal specs on every agent are the
+    deployment invariant (same model class + config => same spec)."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[np.dtype, ...]
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(int(np.prod(s, dtype=np.int64)) for s in self.shapes)
+
+    @property
+    def total(self) -> int:
+        return int(sum(self.sizes))
+
+
+def tree_to_flat(tree: Pytree) -> Tuple[np.ndarray, TreeSpec]:
+    """Flatten a float pytree into one f32 wire vector plus its spec.
+
+    Non-float leaves are rejected: gossip averages values, which is
+    meaningless for integer state (step counters etc.) — mix parameters,
+    keep such state local (the reference averages only
+    ``model.parameters()``, same boundary).
+    """
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    arrs = [np.asarray(leaf) for leaf in leaves]
+    for a in arrs:
+        if not _is_float_dtype(a.dtype):
+            raise TypeError(
+                f"cannot gossip non-float leaf of dtype {a.dtype}; flatten "
+                "only the model parameters"
+            )
+    spec = TreeSpec(
+        treedef=treedef,
+        shapes=tuple(a.shape for a in arrs),
+        dtypes=tuple(np.dtype(a.dtype) for a in arrs),
+    )
+    if not arrs:
+        return np.zeros(0, np.float32), spec
+    flat = np.concatenate([a.astype(np.float32).ravel() for a in arrs])
+    return flat, spec
+
+
+def flat_to_tree(flat: np.ndarray, spec: TreeSpec) -> Pytree:
+    """Rebuild the pytree from a wire vector (leaves restored to their
+    original shapes and dtypes)."""
+    import jax
+
+    flat = np.asarray(flat, dtype=np.float32).ravel()
+    if flat.size != spec.total:
+        raise ValueError(
+            f"wire vector has {flat.size} elements, spec expects {spec.total}"
+        )
+    leaves = []
+    off = 0
+    for shape, dtype, size in zip(spec.shapes, spec.dtypes, spec.sizes):
+        leaves.append(flat[off : off + size].reshape(shape).astype(dtype))
+        off += size
+    return jax.tree.unflatten(spec.treedef, leaves)

@@ -319,15 +319,25 @@ class ConsensusEngine:
         the agent axis, contract with this device's row of ``W``).
         ``route="auto"`` picks whichever moves less data per round.
         """
-        W = np.asarray(W, dtype=np.float32)
-        if W.shape != (self.n, self.n):
-            raise ValueError(f"W must have shape ({self.n}, {self.n}), got {W.shape}")
         if route not in ("auto", "ring", "allgather"):
             raise ValueError(f"unknown route {route!r}")
-        if self.mesh is None:
-            return self._get_jitted("mix_with")(
-                stacked, jnp.asarray(W), jnp.int32(times)
+        if jnp.shape(W) != (self.n, self.n):
+            raise ValueError(
+                f"W must have shape ({self.n}, {self.n}), got {jnp.shape(W)}"
             )
+        if self.mesh is None or isinstance(W, jax.core.Tracer):
+            # Dense mode contracts with W directly; a traced W (caller is
+            # inside jit) cannot be decomposed on the host, so the sharded
+            # path keeps the all-to-all for it.
+            if route == "ring" and self.mesh is not None:
+                raise ValueError(
+                    "route='ring' needs a concrete W (the k-hop decomposition "
+                    "runs on the host); call outside jit or use 'allgather'"
+                )
+            return self._get_jitted("mix_with")(
+                stacked, jnp.asarray(W, dtype=jnp.float32), jnp.int32(times)
+            )
+        W = np.asarray(W, dtype=np.float32)
         route, (self_w, w_fwd, w_bwd, k_hops) = self._route_for(W, route)
         if route == "allgather":
             return self._get_jitted("mix_with")(
@@ -354,16 +364,23 @@ class ConsensusEngine:
         routes each round like :meth:`mix_with` (ring relays for sparse
         graphs, masked all-to-all for dense ones).
         """
-        W = np.asarray(W, dtype=np.float32)
-        if W.shape != (self.n, self.n):
-            raise ValueError(f"W must have shape ({self.n}, {self.n}), got {W.shape}")
         if route not in ("auto", "ring", "allgather"):
             raise ValueError(f"unknown route {route!r}")
-        omegas = jnp.asarray(omegas, dtype=jnp.float32)
-        if self.mesh is None:
-            return self._get_jitted("mix_chebyshev_with")(
-                stacked, jnp.asarray(W), omegas
+        if jnp.shape(W) != (self.n, self.n):
+            raise ValueError(
+                f"W must have shape ({self.n}, {self.n}), got {jnp.shape(W)}"
             )
+        omegas = jnp.asarray(omegas, dtype=jnp.float32)
+        if self.mesh is None or isinstance(W, jax.core.Tracer):
+            if route == "ring" and self.mesh is not None:
+                raise ValueError(
+                    "route='ring' needs a concrete W (the k-hop decomposition "
+                    "runs on the host); call outside jit or use 'allgather'"
+                )
+            return self._get_jitted("mix_chebyshev_with")(
+                stacked, jnp.asarray(W, dtype=jnp.float32), omegas
+            )
+        W = np.asarray(W, dtype=np.float32)
         route, (self_w, w_fwd, w_bwd, k_hops) = self._route_for(W, route)
         if route == "allgather":
             return self._get_jitted("mix_chebyshev_with")(

@@ -633,7 +633,9 @@ class GossipTrainer:
             # failure (e.g. OOM) surfaces here, not at the call above.
             losses = np.asarray(losses)  # (steps, n)
             accs = np.asarray(accs)
-        except Exception:
+        except BaseException:
+            # BaseException: KeyboardInterrupt mid-epoch must also drop the
+            # state, or the next call crashes on deleted arrays.
             if self._donate_active:
                 # The donated input buffers may already be invalidated (e.g.
                 # OOM mid-execution); drop the dangling reference so the next
