@@ -1,0 +1,115 @@
+"""Flash-attention kernel throughput: TFLOP/s at 8k/32k/131k tokens.
+
+Substantiates the Pallas kernel's performance on the real chip
+(``ops/flash_attention.py``): for each context length, sweeps
+(block_q, block_k) and reports the best configuration's sustained TFLOP/s.
+Causal FLOPs are counted as 4*B*H*T^2*D/2 (two matmuls, two FLOPs per MAC,
+half the score matrix live).
+
+The reference has no attention anywhere (SURVEY.md §5: "long-context /
+sequence parallelism entirely absent"), so ``vs_baseline`` is null; the
+yardstick is fraction of the chip's bf16 peak (~197 TFLOP/s on v5e).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import emit, full_scale, platform, smoke
+
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+def _measure(
+    T: int, block_q: int, block_k: int, *, B=1, H=8, D=128, iters=8,
+    interpret=False,
+):
+    from distributed_learning_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(B, T, H, D)).astype(np.float32), dtype=jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+    fn = lambda: flash_attention(
+        q, k, v, causal=True, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    out = fn()
+    jax.block_until_ready(out)  # compile
+    out = fn()
+    jax.block_until_ready(out)  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    flops = 4 * B * H * T * T * D / 2  # causal
+    return flops / dt / 1e12, dt
+
+
+def run() -> None:
+    on_tpu = platform() == "tpu"
+    if not on_tpu and not smoke():
+        emit({
+            "metric": "flash_attention_tflops",
+            "value": None,
+            "unit": "TFLOP/s",
+            "vs_baseline": None,
+            "config": "skipped: needs a TPU (kernel falls back off-chip)",
+        })
+        return
+    # Off-TPU smoke runs the real kernel under interpret=True (tiny sizes;
+    # without it flash_attention would silently time the einsum fallback).
+    interpret = not on_tpu
+    if on_tpu and full_scale():
+        lengths = [8192, 32768, 131072]
+        blocks = [(128, 128), (128, 256), (256, 256), (256, 512), (512, 512)]
+        iters = 8
+    else:
+        lengths = [256]
+        blocks = [(128, 128), (128, 256)]
+        iters = 1
+    for T in lengths:
+        best = None
+        for bq, bk in blocks:
+            if T % bq or T % bk:
+                continue
+            try:
+                tflops, dt = _measure(T, bq, bk, iters=iters,
+                                      interpret=interpret)
+            except Exception as e:  # OOM/VMEM overflow at big blocks
+                emit({
+                    "metric": f"flash_attention_{T}_bq{bq}_bk{bk}",
+                    "value": None,
+                    "unit": "TFLOP/s",
+                    "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {str(e)[:120]}",
+                })
+                continue
+            emit({
+                "metric": f"flash_attention_{T}_bq{bq}_bk{bk}",
+                "value": round(tflops, 2),
+                "unit": "TFLOP/s",
+                "vs_baseline": None,
+                "seconds_per_call": round(dt, 4),
+            })
+            if best is None or tflops > best[0]:
+                best = (tflops, bq, bk)
+        if best is not None:
+            emit({
+                "metric": f"flash_attention_causal_T{T}_best",
+                "value": round(best[0], 2),
+                "unit": "TFLOP/s",
+                "vs_baseline": None,
+                "config": f"B1 H8 D128 bf16, block_q={best[1]} block_k={best[2]}",
+                "fraction_of_v5e_peak": round(best[0] / V5E_BF16_PEAK_TFLOPS, 3),
+            })
+
+
+if __name__ == "__main__":
+    run()

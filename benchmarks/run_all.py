@@ -15,6 +15,7 @@ from benchmarks import (
     bench_cifar_mlp,
     bench_cifar_wrn,
     bench_timevarying,
+    bench_attention,
 )
 
 CONFIGS = [
@@ -23,6 +24,7 @@ CONFIGS = [
     ("3: CIFAR-10 ann_model gossip-SGD (8 workers, torus)", bench_cifar_mlp.run),
     ("4: CIFAR-10 WRN gossip-SGD (ring)", bench_cifar_wrn.run),
     ("5: CIFAR-100 WRN time-varying + Chebyshev", bench_timevarying.run),
+    ("+: flash-attention kernel TFLOP/s (beyond-parity)", bench_attention.run),
 ]
 
 

@@ -28,6 +28,7 @@ __all__ = [
     "CIFAR_MEAN",
     "CIFAR_STD",
     "load_cifar",
+    "real_cifar_present",
     "synthetic_cifar",
     "normalize",
     "normalized_pad_value",
@@ -53,16 +54,30 @@ _DEFAULT_DIRS = (
 )
 
 
+def _batch_files(d: str, dataset: str):
+    if dataset == "cifar10":
+        return (
+            [os.path.join(d, f"data_batch_{i}") for i in range(1, 6)],
+            [os.path.join(d, "test_batch")],
+            b"labels",
+        )
+    return [os.path.join(d, "train")], [os.path.join(d, "test")], b"fine_labels"
+
+
+def real_cifar_present(dataset: str = "cifar10", data_dir: str | None = None) -> bool:
+    """True when real CIFAR pickle batches exist (file check only — no
+    loading), in ``data_dir`` or any default location."""
+    dirs = [data_dir] if data_dir else [d for d in _DEFAULT_DIRS if d]
+    for d in dirs:
+        train_files, test_files, _ = _batch_files(d, dataset)
+        if all(os.path.exists(p) for p in train_files + test_files):
+            return True
+    return False
+
+
 def _load_pickle_batches(d: str, dataset: str):
     """Read the standard CIFAR python pickle format if present."""
-    if dataset == "cifar10":
-        train_files = [os.path.join(d, f"data_batch_{i}") for i in range(1, 6)]
-        test_files = [os.path.join(d, "test_batch")]
-        label_key = b"labels"
-    else:
-        train_files = [os.path.join(d, "train")]
-        test_files = [os.path.join(d, "test")]
-        label_key = b"fine_labels"
+    train_files, test_files, label_key = _batch_files(d, dataset)
     if not all(os.path.exists(p) for p in train_files + test_files):
         return None
 

@@ -11,10 +11,11 @@ stream HBM->VMEM via the grid's implicit double-buffered DMA, matmuls hit
 the MXU with f32 accumulation, and the causal path skips the compute for
 fully-masked blocks.
 
-Context length is bounded by HBM, not VMEM (validated at 32k and 131k
-tokens on a single v5e chip; 131k causal runs at ~4.7 TFLOP/s there).
-On CPU the same kernel runs under ``interpret=True`` for the tests;
-correctness bar: match
+Context length is bounded by HBM, not VMEM.  Measured throughput comes
+from ``benchmarks/bench_attention.py`` (TFLOP/s at 8k/32k/131k with a
+block-size sweep); numbers live in ``BASELINE.json:"published"``, not
+here.  On CPU the same kernel runs under ``interpret=True`` for the
+tests; correctness bar: match
 :func:`~distributed_learning_tpu.ops.ring_attention.attention_reference`.
 """
 

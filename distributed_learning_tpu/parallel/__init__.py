@@ -11,6 +11,10 @@ from distributed_learning_tpu.parallel.fast_averaging import (
     solve_fastest_mixing,
     FastAveragingResult,
 )
+from distributed_learning_tpu.parallel.pushsum import (
+    PushSumEngine,
+    push_sum_matrix,
+)
 
 __all__ = [
     "Topology",
@@ -20,4 +24,6 @@ __all__ = [
     "find_optimal_weights",
     "solve_fastest_mixing",
     "FastAveragingResult",
+    "PushSumEngine",
+    "push_sum_matrix",
 ]

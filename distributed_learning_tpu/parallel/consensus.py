@@ -45,7 +45,13 @@ from .topology import Topology, gamma as exact_gamma
 
 Pytree = Any
 
-__all__ = ["ConsensusEngine", "Mixer", "make_agent_mesh"]
+__all__ = [
+    "ConsensusEngine",
+    "Mixer",
+    "make_agent_mesh",
+    "ring_offset_weights",
+    "local_ring_mix",
+]
 
 
 def make_agent_mesh(n: int, *, axis_name: str = "agents") -> Mesh:
@@ -54,6 +60,101 @@ def make_agent_mesh(n: int, *, axis_name: str = "agents") -> Mesh:
     if len(devices) < n:
         raise ValueError(f"need {n} devices for {n} agents, have {len(devices)}")
     return Mesh(np.array(devices[:n]), (axis_name,))
+
+
+def ring_offset_weights(
+    W: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Decompose a mixing matrix's off-diagonal onto signed ring offsets.
+
+    Returns ``(self_w, w_fwd, w_bwd, k_hops)``: ``w_fwd[i, k-1]`` weights
+    agent ``(i-k) % n`` (reached by ``k`` forward relay hops on the device
+    ring) and ``w_bwd[i, k-1]`` weights ``(i+k) % n``; ``k_hops`` is the
+    largest offset carrying any weight — the number of relay rounds a
+    routed gossip round needs.  For ``n`` even the antipodal offset
+    ``n/2`` is reachable both ways and is counted once (forward).  Works
+    for any square matrix — symmetry is not assumed, so directed
+    (push-sum) matrices decompose too.
+    """
+    W = np.asarray(W)
+    n = W.shape[0]
+    k_cap = n // 2
+    w_fwd = np.zeros((n, max(k_cap, 1)), np.float32)
+    w_bwd = np.zeros((n, max(k_cap, 1)), np.float32)
+    i = np.arange(n)
+    for k in range(1, k_cap + 1):
+        w_fwd[:, k - 1] = W[i, (i - k) % n]
+        if not (n % 2 == 0 and k == n // 2):
+            w_bwd[:, k - 1] = W[i, (i + k) % n]
+    k_hops = 0
+    for k in range(k_cap, 0, -1):
+        if w_fwd[:, k - 1].any() or w_bwd[:, k - 1].any():
+            k_hops = k
+            break
+    return np.diag(W).astype(np.float32), w_fwd, w_bwd, k_hops
+
+
+def local_ring_mix(
+    x: Pytree,
+    self_w: jax.Array,
+    w_fwd: jax.Array,
+    w_bwd: jax.Array,
+    k_hops: jax.Array,
+    *,
+    axis_name: str,
+    n: int,
+) -> Pytree:
+    """One gossip round under traced per-offset weights, routed over the
+    device ring with <=k-hop relays (SURVEY §7 hard part 1: multi-hop
+    routing for graphs whose edges are not physical ring neighbors).
+
+    Runs inside ``shard_map``; per-device inputs are ``self_w`` (1,) and
+    ``w_fwd``/``w_bwd`` (1, k_cap) rows of :func:`ring_offset_weights`.
+    Each relay hop rotates the value one step in both ring directions (two
+    ``ppermute``s) and accumulates that offset's weighted contribution, so
+    one round moves ``2*k_hops`` shard-sized messages per device — scaling
+    with the graph's maximal ring span instead of the agent count like an
+    all_gather.  Both the weights and ``k_hops`` are traced: resampling
+    the topology each epoch reuses the compiled program.  Accumulation is
+    float32 regardless of the state dtype (~1e-4 consensus residuals would
+    be floored by bf16), cast back once at the end.
+    """
+    fwd_pairs = [(j, (j + 1) % n) for j in range(n)]
+    bwd_pairs = [(j, (j - 1) % n) for j in range(n)]
+
+    def scale(v: jax.Array, s: jax.Array) -> jax.Array:
+        return v.astype(jnp.float32) * s
+
+    def body(k, carry):
+        fwd, bwd, acc = carry
+        fwd = jax.tree.map(
+            lambda v: lax.ppermute(v, axis_name, fwd_pairs), fwd
+        )
+        bwd = jax.tree.map(
+            lambda v: lax.ppermute(v, axis_name, bwd_pairs), bwd
+        )
+        wf = lax.dynamic_index_in_dim(w_fwd[0], k, keepdims=False)
+        wb = lax.dynamic_index_in_dim(w_bwd[0], k, keepdims=False)
+        acc = jax.tree.map(
+            lambda a, f, b: a + scale(f, wf) + scale(b, wb), acc, fwd, bwd
+        )
+        return fwd, bwd, acc
+
+    acc0 = jax.tree.map(lambda v: scale(v, self_w[0]), x)
+    _, _, acc = lax.fori_loop(0, k_hops, body, (x, x, acc0))
+    return jax.tree.map(lambda a, v: a.astype(v.dtype), acc, x)
+
+
+def local_sq_deviation(x: Pytree, axis_name: str) -> jax.Array:
+    """This shard's squared L2 distance from the global mean vector (runs
+    inside ``shard_map``; the sharded analogue of
+    ``ops.agent_deviations``**2)."""
+    total = jnp.float32(0.0)
+    for leaf in jax.tree.leaves(x):
+        mean = lax.pmean(leaf.astype(jnp.float32), axis_name)
+        d = leaf.astype(jnp.float32) - mean
+        total = total + jnp.sum(d * d)
+    return total
 
 
 class ConsensusEngine:
@@ -122,30 +223,7 @@ class ConsensusEngine:
     def _ring_offset_weights(
         self, W: np.ndarray
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
-        """Decompose ``W``'s off-diagonal onto signed ring offsets.
-
-        Returns ``(self_w, w_fwd, w_bwd, k_hops)``: ``w_fwd[i, k-1]`` weights
-        agent ``(i-k) % n`` (reached by ``k`` forward relay hops on the
-        device ring) and ``w_bwd[i, k-1]`` weights ``(i+k) % n``; ``k_hops``
-        is the largest offset carrying any weight — the number of relay
-        rounds a routed gossip round needs.  For ``n`` even the antipodal
-        offset ``n/2`` is reachable both ways and is counted once (forward).
-        """
-        n = self.n
-        k_cap = n // 2
-        w_fwd = np.zeros((n, max(k_cap, 1)), np.float32)
-        w_bwd = np.zeros((n, max(k_cap, 1)), np.float32)
-        i = np.arange(n)
-        for k in range(1, k_cap + 1):
-            w_fwd[:, k - 1] = W[i, (i - k) % n]
-            if not (n % 2 == 0 and k == n // 2):
-                w_bwd[:, k - 1] = W[i, (i + k) % n]
-        k_hops = 0
-        for k in range(k_cap, 0, -1):
-            if w_fwd[:, k - 1].any() or w_bwd[:, k - 1].any():
-                k_hops = k
-                break
-        return np.diag(W).astype(np.float32), w_fwd, w_bwd, k_hops
+        return ring_offset_weights(W)
 
     def _local_ring_mix(
         self,
@@ -155,43 +233,10 @@ class ConsensusEngine:
         w_bwd: jax.Array,
         k_hops: jax.Array,
     ) -> Pytree:
-        """One gossip round under traced per-offset weights, routed over the
-        device ring with <=k-hop relays (SURVEY §7 hard part 1: multi-hop
-        routing for graphs whose edges are not physical ring neighbors).
-
-        Each relay hop rotates the value one step in both ring directions
-        (two ``ppermute``s) and accumulates that offset's weighted
-        contribution, so one round moves ``2*k_hops`` shard-sized messages
-        per device — scaling with the resampled graph's maximal ring span
-        instead of the agent count like the all_gather fallback.  Both the
-        weights and ``k_hops`` are traced: resampling the topology each
-        epoch reuses the compiled program.
-        """
-        ax = self.axis_name
-        n = self.n
-        fwd_pairs = [(j, (j + 1) % n) for j in range(n)]
-        bwd_pairs = [(j, (j - 1) % n) for j in range(n)]
-
-        # Accumulate in float32 regardless of the state dtype (same contract
-        # as the allgather path: ~1e-4 consensus residuals would be floored
-        # by bf16 accumulation); cast back once at the end.
-        def scale(v: jax.Array, s: jax.Array) -> jax.Array:
-            return v.astype(jnp.float32) * s
-
-        def body(k, carry):
-            fwd, bwd, acc = carry
-            fwd = jax.tree.map(lambda v: lax.ppermute(v, ax, fwd_pairs), fwd)
-            bwd = jax.tree.map(lambda v: lax.ppermute(v, ax, bwd_pairs), bwd)
-            wf = lax.dynamic_index_in_dim(w_fwd[0], k, keepdims=False)
-            wb = lax.dynamic_index_in_dim(w_bwd[0], k, keepdims=False)
-            acc = jax.tree.map(
-                lambda a, f, b: a + scale(f, wf) + scale(b, wb), acc, fwd, bwd
-            )
-            return fwd, bwd, acc
-
-        acc0 = jax.tree.map(lambda v: scale(v, self_w[0]), x)
-        _, _, acc = lax.fori_loop(0, k_hops, body, (x, x, acc0))
-        return jax.tree.map(lambda a, v: a.astype(v.dtype), acc, x)
+        return local_ring_mix(
+            x, self_w, w_fwd, w_bwd, k_hops,
+            axis_name=self.axis_name, n=self.n,
+        )
 
     def _local_allgather_mix(self, x: Pytree, W_row: jax.Array) -> Pytree:
         """One gossip round against a *traced* mixing row: all_gather the
@@ -210,13 +255,7 @@ class ConsensusEngine:
         return jax.tree.map(leaf, x)
 
     def _local_sq_deviation(self, x: Pytree) -> jax.Array:
-        """This agent's squared L2 distance from the global mean vector."""
-        total = jnp.float32(0.0)
-        for leaf in jax.tree.leaves(x):
-            mean = lax.pmean(leaf.astype(jnp.float32), self.axis_name)
-            d = leaf.astype(jnp.float32) - mean
-            total = total + jnp.sum(d * d)
-        return total
+        return local_sq_deviation(x, self.axis_name)
 
     # ------------------------------------------------------------------ #
     # Global (dense) building blocks                                     #
@@ -283,6 +322,36 @@ class ConsensusEngine:
             )
         return self._jit_cache[key](stacked)
 
+    def _traced_w_dispatch(self, W, route: str):
+        """Shared guard for the traced-W entry points.
+
+        Returns ``(W_traced, decomposition)``: exactly one is non-None.
+        ``W_traced`` (a jnp array) means "feed the traced all-to-all /
+        dense program"; ``decomposition`` means "use the k-hop ring
+        program with these host-decomposed weights".
+        """
+        if route not in ("auto", "ring", "allgather"):
+            raise ValueError(f"unknown route {route!r}")
+        if jnp.shape(W) != (self.n, self.n):
+            raise ValueError(
+                f"W must have shape ({self.n}, {self.n}), got {jnp.shape(W)}"
+            )
+        if self.mesh is None or isinstance(W, jax.core.Tracer):
+            # Dense mode contracts with W directly; a traced W (caller is
+            # inside jit) cannot be decomposed on the host, so the sharded
+            # path keeps the all-to-all for it.
+            if route == "ring" and self.mesh is not None:
+                raise ValueError(
+                    "route='ring' needs a concrete W (the k-hop "
+                    "decomposition runs on the host); call outside jit or "
+                    "use 'allgather'"
+                )
+            return jnp.asarray(W, dtype=jnp.float32), None
+        route, decomp = self._route_for(np.asarray(W, dtype=np.float32), route)
+        if route == "allgather":
+            return jnp.asarray(W, dtype=jnp.float32), None
+        return None, decomp
+
     def _route_for(self, W: np.ndarray, route: str) -> Tuple[str, tuple]:
         """Pick the sharded execution strategy for a traced mixing matrix.
 
@@ -319,30 +388,12 @@ class ConsensusEngine:
         the agent axis, contract with this device's row of ``W``).
         ``route="auto"`` picks whichever moves less data per round.
         """
-        if route not in ("auto", "ring", "allgather"):
-            raise ValueError(f"unknown route {route!r}")
-        if jnp.shape(W) != (self.n, self.n):
-            raise ValueError(
-                f"W must have shape ({self.n}, {self.n}), got {jnp.shape(W)}"
-            )
-        if self.mesh is None or isinstance(W, jax.core.Tracer):
-            # Dense mode contracts with W directly; a traced W (caller is
-            # inside jit) cannot be decomposed on the host, so the sharded
-            # path keeps the all-to-all for it.
-            if route == "ring" and self.mesh is not None:
-                raise ValueError(
-                    "route='ring' needs a concrete W (the k-hop decomposition "
-                    "runs on the host); call outside jit or use 'allgather'"
-                )
+        W_traced, decomp = self._traced_w_dispatch(W, route)
+        if W_traced is not None:
             return self._get_jitted("mix_with")(
-                stacked, jnp.asarray(W, dtype=jnp.float32), jnp.int32(times)
+                stacked, W_traced, jnp.int32(times)
             )
-        W = np.asarray(W, dtype=np.float32)
-        route, (self_w, w_fwd, w_bwd, k_hops) = self._route_for(W, route)
-        if route == "allgather":
-            return self._get_jitted("mix_with")(
-                stacked, jnp.asarray(W), jnp.int32(times)
-            )
+        self_w, w_fwd, w_bwd, k_hops = decomp
         return self._get_jitted("mix_with_ring")(
             stacked,
             jnp.asarray(self_w),
@@ -364,28 +415,13 @@ class ConsensusEngine:
         routes each round like :meth:`mix_with` (ring relays for sparse
         graphs, masked all-to-all for dense ones).
         """
-        if route not in ("auto", "ring", "allgather"):
-            raise ValueError(f"unknown route {route!r}")
-        if jnp.shape(W) != (self.n, self.n):
-            raise ValueError(
-                f"W must have shape ({self.n}, {self.n}), got {jnp.shape(W)}"
-            )
         omegas = jnp.asarray(omegas, dtype=jnp.float32)
-        if self.mesh is None or isinstance(W, jax.core.Tracer):
-            if route == "ring" and self.mesh is not None:
-                raise ValueError(
-                    "route='ring' needs a concrete W (the k-hop decomposition "
-                    "runs on the host); call outside jit or use 'allgather'"
-                )
+        W_traced, decomp = self._traced_w_dispatch(W, route)
+        if W_traced is not None:
             return self._get_jitted("mix_chebyshev_with")(
-                stacked, jnp.asarray(W, dtype=jnp.float32), omegas
+                stacked, W_traced, omegas
             )
-        W = np.asarray(W, dtype=np.float32)
-        route, (self_w, w_fwd, w_bwd, k_hops) = self._route_for(W, route)
-        if route == "allgather":
-            return self._get_jitted("mix_chebyshev_with")(
-                stacked, jnp.asarray(W), omegas
-            )
+        self_w, w_fwd, w_bwd, k_hops = decomp
         return self._get_jitted("mix_chebyshev_with_ring")(
             stacked,
             jnp.asarray(self_w),

@@ -54,3 +54,22 @@ def test_bench_timevarying_smoke(capsys):
     assert out["samples_per_sec"] > 0
     # Chebyshev can't be worse than plain over the same graph sequence.
     assert out["rounds_chebyshev"] <= out["rounds_plain"]
+
+
+def test_bench_attention_smoke(capsys):
+    from benchmarks import bench_attention
+
+    bench_attention.run()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    # At least one real measurement of the kernel (interpret mode off-TPU)
+    # must succeed with a numeric TFLOP/s — error/skip records don't count.
+    ok = [
+        r for r in lines
+        if r["metric"].startswith("flash_attention")
+        and isinstance(r["value"], (int, float))
+        and "error" not in r
+    ]
+    assert ok, lines
+    assert any(r["metric"].endswith("_best") for r in ok)
+    for r in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
