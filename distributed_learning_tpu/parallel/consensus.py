@@ -103,6 +103,8 @@ def local_ring_mix(
     *,
     axis_name: str,
     n: int,
+    use_fwd: bool = True,
+    use_bwd: bool = True,
 ) -> Pytree:
     """One gossip round under traced per-offset weights, routed over the
     device ring with <=k-hop relays (SURVEY §7 hard part 1: multi-hop
@@ -118,6 +120,11 @@ def local_ring_mix(
     the topology each epoch reuses the compiled program.  Accumulation is
     float32 regardless of the state dtype (~1e-4 consensus residuals would
     be floored by bf16), cast back once at the end.
+
+    ``use_fwd``/``use_bwd`` are compile-time flags: a direction whose
+    weights the (concrete) decomposition shows identically zero is skipped
+    statically — a unidirectional push-sum ring then moves ``k_hops``
+    messages per round, not ``2*k_hops``.
     """
     fwd_pairs = [(j, (j + 1) % n) for j in range(n)]
     bwd_pairs = [(j, (j - 1) % n) for j in range(n)]
@@ -127,17 +134,21 @@ def local_ring_mix(
 
     def body(k, carry):
         fwd, bwd, acc = carry
-        fwd = jax.tree.map(
-            lambda v: lax.ppermute(v, axis_name, fwd_pairs), fwd
-        )
-        bwd = jax.tree.map(
-            lambda v: lax.ppermute(v, axis_name, bwd_pairs), bwd
-        )
-        wf = lax.dynamic_index_in_dim(w_fwd[0], k, keepdims=False)
-        wb = lax.dynamic_index_in_dim(w_bwd[0], k, keepdims=False)
-        acc = jax.tree.map(
-            lambda a, f, b: a + scale(f, wf) + scale(b, wb), acc, fwd, bwd
-        )
+        terms = []
+        if use_fwd:
+            fwd = jax.tree.map(
+                lambda v: lax.ppermute(v, axis_name, fwd_pairs), fwd
+            )
+            wf = lax.dynamic_index_in_dim(w_fwd[0], k, keepdims=False)
+            terms.append((fwd, wf))
+        if use_bwd:
+            bwd = jax.tree.map(
+                lambda v: lax.ppermute(v, axis_name, bwd_pairs), bwd
+            )
+            wb = lax.dynamic_index_in_dim(w_bwd[0], k, keepdims=False)
+            terms.append((bwd, wb))
+        for nb, w in terms:
+            acc = jax.tree.map(lambda a, v: a + scale(v, w), acc, nb)
         return fwd, bwd, acc
 
     acc0 = jax.tree.map(lambda v: scale(v, self_w[0]), x)
@@ -394,7 +405,10 @@ class ConsensusEngine:
                 stacked, W_traced, jnp.int32(times)
             )
         self_w, w_fwd, w_bwd, k_hops = decomp
-        return self._get_jitted("mix_with_ring")(
+        fn = self._get_ring_jitted(
+            "mix_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
+        )
+        return fn(
             stacked,
             jnp.asarray(self_w),
             jnp.asarray(w_fwd),
@@ -422,7 +436,10 @@ class ConsensusEngine:
                 stacked, W_traced, omegas
             )
         self_w, w_fwd, w_bwd, k_hops = decomp
-        return self._get_jitted("mix_chebyshev_with_ring")(
+        fn = self._get_ring_jitted(
+            "mix_chebyshev_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
+        )
+        return fn(
             stacked,
             jnp.asarray(self_w),
             jnp.asarray(w_fwd),
@@ -616,15 +633,6 @@ class ConsensusEngine:
                     )
 
                 fn = sharded(local_mw, P(ax), extra_in=(P(ax), P()))
-            elif name == "mix_with_ring":
-                def local_mr(x, sw, wf, wb, k, t):
-                    return self._run_times(
-                        x, t, lambda s: self._local_ring_mix(s, sw, wf, wb, k)
-                    )
-
-                fn = sharded(
-                    local_mr, P(ax), extra_in=(P(ax), P(ax), P(ax), P(), P())
-                )
             elif name == "mix_chebyshev_with":
                 def local_cw(x, W_rows, om):
                     return self._cheby_traced(
@@ -632,15 +640,6 @@ class ConsensusEngine:
                     )
 
                 fn = sharded(local_cw, P(ax), extra_in=(P(ax), P()))
-            elif name == "mix_chebyshev_with_ring":
-                def local_cr(x, sw, wf, wb, k, om):
-                    return self._cheby_traced(
-                        x, om, lambda s: self._local_ring_mix(s, sw, wf, wb, k)
-                    )
-
-                fn = sharded(
-                    local_cr, P(ax), extra_in=(P(ax), P(ax), P(ax), P(), P())
-                )
             elif name == "global_average":
                 def local_avg(x):
                     return jax.tree.map(
@@ -655,6 +654,48 @@ class ConsensusEngine:
                 raise KeyError(name)
 
         self._jit_cache[name] = fn
+        return fn
+
+    def _get_ring_jitted(self, name: str, use_fwd: bool, use_bwd: bool):
+        """Jitted k-hop ring programs, keyed by which ring directions are
+        statically live (a direction with all-zero weights is skipped at
+        compile time — see :func:`local_ring_mix`)."""
+        key = (name, use_fwd, use_bwd)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        mesh, ax = self.mesh, self.axis_name
+
+        def ring_once(s, sw, wf, wb, k):
+            return local_ring_mix(
+                s, sw, wf, wb, k, axis_name=ax, n=self.n,
+                use_fwd=use_fwd, use_bwd=use_bwd,
+            )
+
+        if name == "mix_with_ring":
+            def local_mr(x, sw, wf, wb, k, t):
+                return self._run_times(
+                    x, t, lambda s: ring_once(s, sw, wf, wb, k)
+                )
+
+            body = local_mr
+        elif name == "mix_chebyshev_with_ring":
+            def local_cr(x, sw, wf, wb, k, om):
+                return self._cheby_traced(
+                    x, om, lambda s: ring_once(s, sw, wf, wb, k)
+                )
+
+            body = local_cr
+        else:
+            raise KeyError(name)
+        fn = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(ax), P(ax), P(ax), P(ax), P(), P()),
+                out_specs=P(ax),
+            )
+        )
+        self._jit_cache[key] = fn
         return fn
 
     # ------------------------------------------------------------------ #

@@ -142,6 +142,10 @@ class PushSumEngine:
                 )
         self._P_dev = jnp.asarray(P_, dtype=jnp.float32)
         self._ring = ring_offset_weights(P_.astype(np.float32))
+        # Static per-direction activity: a unidirectional graph skips the
+        # dead ring direction at compile time (half the ICI traffic).
+        self._use_fwd = bool(self._ring[1].any())
+        self._use_bwd = bool(self._ring[2].any())
         self._jit = {}
 
     # ------------------------------------------------------------------ #
@@ -182,10 +186,17 @@ class PushSumEngine:
     def _weights_vec(self, weights) -> jax.Array:
         if weights is None:
             return jnp.ones((self.n,), jnp.float32)
-        w = jnp.asarray(weights, jnp.float32)
+        w = np.asarray(weights, np.float32)
         if w.shape != (self.n,):
             raise ValueError(f"weights must have shape ({self.n},), got {w.shape}")
-        return w
+        if not (np.isfinite(w).all() and (w > 0.0).all()):
+            # A zero weight makes that agent's round-0 estimate x/0 and
+            # poisons the residual (NaN never satisfies `res >= eps`);
+            # sample counts must be strictly positive.
+            raise ValueError(
+                f"agent weights must be finite and > 0, got {w.tolist()}"
+            )
+        return jnp.asarray(w)
 
     # ------------------------------------------------------------------ #
     # Round bodies                                                       #
@@ -242,11 +253,14 @@ class PushSumEngine:
             mesh, ax, n = self.mesh, self.axis_name, self.n
             self_w, w_fwd, w_bwd, k_hops = self._ring
 
+            use_fwd, use_bwd = self._use_fwd, self._use_bwd
+
             def ring_step(num, den, sw, wf, wb, kh):
                 # (num, den) mix jointly: push-sum's totals-preserving
                 # update is the same routed linear map on both channels.
                 return local_ring_mix(
-                    (num, den), sw, wf, wb, kh, axis_name=ax, n=n
+                    (num, den), sw, wf, wb, kh, axis_name=ax, n=n,
+                    use_fwd=use_fwd, use_bwd=use_bwd,
                 )
 
             def local_dev(est):

@@ -371,7 +371,7 @@ def test_ring_route_no_recompile_across_spans():
         ).metropolis_weights(),
     ]:
         xs = eng.mix_with(xs, W, times=1, route="ring")
-    fn = eng._jit_cache["mix_with_ring"]
+    fn = eng._jit_cache[("mix_with_ring", True, True)]
     if hasattr(fn, "_cache_size"):
         assert fn._cache_size() == 1
     before = _tree_mean(eng.shard(_tree_state(8, seed=9)))

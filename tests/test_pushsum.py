@@ -91,18 +91,57 @@ def test_sharded_matches_dense_fixed_rounds():
 
 
 def test_push_sum_totals_preserved_each_round():
-    # Column-stochasticity preserves sum(x * w) exactly in the numerator.
+    # Column-stochasticity preserves the numerator total sum(x * w) and the
+    # denominator total sum(w) exactly, round by round.
+    from distributed_learning_tpu.parallel.pushsum import _lift
+
     n = 6
     P = _directed_cycle(n)
     eng = PushSumEngine(P)
     x = _tree_state(n, seed=4)
-    est1 = eng.mix(x, times=1)
-    # Second eigenvalue of the 6-cycle's P=(I+S)/2 has modulus ~0.866, so
-    # 120 rounds contract the initial spread well below the tolerance.
+    w = jnp.asarray(np.arange(1.0, n + 1.0, dtype=np.float32))
+    num, den = _lift(x, w), w
+    num_tot0 = {k: np.asarray(num[k]).sum(axis=0) for k in x}
+    den_tot0 = float(np.sum(np.asarray(den)))
+    for _ in range(5):
+        num, den = jax.jit(eng._dense_step)(num, den)
+        for k in x:
+            np.testing.assert_allclose(
+                np.asarray(num[k]).sum(axis=0), num_tot0[k], atol=1e-5
+            )
+        np.testing.assert_allclose(
+            float(np.sum(np.asarray(den))), den_tot0, atol=1e-5
+        )
+    # And the converged estimates hit the average (gamma ~0.866 for the
+    # 6-cycle's P=(I+S)/2, so 120 rounds contract well below tolerance).
     est120 = eng.mix(x, times=120)
     for key in x:
         mean = np.asarray(x[key]).mean(axis=0)
         np.testing.assert_allclose(
             np.asarray(est120[key])[0], mean, atol=1e-4
         )
-        assert np.isfinite(np.asarray(est1[key])).all()
+
+
+def test_push_sum_rejects_nonpositive_weights():
+    n = 6
+    eng = PushSumEngine(_directed_cycle(n))
+    x = _tree_state(n, seed=5)
+    with pytest.raises(ValueError, match="finite and > 0"):
+        eng.mix(x, times=1, weights=[0.0, 1, 1, 1, 1, 1])
+    with pytest.raises(ValueError, match="finite and > 0"):
+        eng.mix_until(x, eps=1e-6, weights=[1, 1, -2, 1, 1, 1])
+
+
+def test_unidirectional_ring_skips_dead_direction():
+    n = 8
+    eng = PushSumEngine(_directed_cycle(n), mesh=make_agent_mesh(n))
+    # A directed cycle only ever carries weight on the forward offset.
+    assert eng._use_fwd and not eng._use_bwd
+    x = _tree_state(n, seed=6)
+    est, _, res = eng.mix_until(eng.shard(x), eps=1e-6, max_rounds=2000)
+    assert float(res) < 1e-6
+    for key in x:
+        mean = np.asarray(x[key]).mean(axis=0)
+        np.testing.assert_allclose(
+            np.asarray(est[key])[0], mean, atol=1e-4
+        )
