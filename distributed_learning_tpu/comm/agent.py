@@ -34,6 +34,16 @@ from distributed_learning_tpu.comm import protocol as P
 
 __all__ = ["ConsensusAgent", "AgentStatus", "ShutdownError"]
 
+# Collective-op tag space: op_id = round_id * _OPS_PER_ROUND + seq, where
+# round_id is the master's (global, strictly increasing) round counter and
+# seq counts collective ops since that round (the round itself is seq 0,
+# interleaved run_once calls advance seq).  Entering a master round
+# therefore re-derives the SAME op id on every agent from the broadcast
+# round id alone — including an agent that just rejoined with fresh local
+# state — while tags stay strictly increasing and collision-free for up to
+# _OPS_PER_ROUND-1 run_once calls between consecutive rounds.
+_OPS_PER_ROUND = 1 << 20
+
 
 class ShutdownError(RuntimeError):
     """Master broadcast Shutdown while an operation was in flight."""
@@ -59,12 +69,19 @@ class ConsensusAgent:
         host: str = "127.0.0.1",
         port: int = 0,
         bf16_wire: bool = False,
+        rejoin: bool = False,
         debug: bool = False,
     ):
         self.token = str(token)
         self.master_addr = (master_host, master_port)
         self.host, self.port = host, port
         self.bf16_wire = bf16_wire
+        # Rejoin mode (elastic master required): this process replaces a
+        # dead agent with the same token.  It initiates connections to ALL
+        # its neighbors (the usual smaller-token-accepts rule assumes
+        # everyone handshakes at once); its first collective op must be a
+        # master round (round tags re-align it with the survivors).
+        self.rejoin = bool(rejoin)
         self.debug = debug
         self.status = AgentStatus.NEW
 
@@ -119,14 +136,31 @@ class ConsensusAgent:
         )
         self.port = self._server.sockets[0].getsockname()[1]
 
-        self._master = await open_framed_connection(*self.master_addr)
-        await self._master.send(
-            P.Register(token=self.token, host=self.host, port=self.port)
-        )
-        msg = await asyncio.wait_for(self._master.recv(), timeout)
-        if isinstance(msg, P.ErrorException):
-            raise ConnectionError(f"master rejected registration: {msg.message}")
-        if not isinstance(msg, P.Ok):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            self._master = await open_framed_connection(*self.master_addr)
+            await self._master.send(
+                P.Register(token=self.token, host=self.host, port=self.port)
+            )
+            msg = await asyncio.wait_for(self._master.recv(), timeout)
+            if isinstance(msg, P.Ok):
+                break
+            if (
+                self.rejoin
+                and isinstance(msg, P.ErrorException)
+                and "already registered" in msg.message
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                # Rejoin raced the master's death detection: our
+                # predecessor's control stream still looks registered.
+                # Back off until the master observes the death.
+                self._master.close()
+                await asyncio.sleep(0.05)
+                continue
+            if isinstance(msg, P.ErrorException):
+                raise ConnectionError(
+                    f"master rejected registration: {msg.message}"
+                )
             raise ConnectionError(f"unexpected registration reply {msg}")
         self.status = AgentStatus.REGISTERED
 
@@ -138,16 +172,23 @@ class ConsensusAgent:
         self.self_weight = msg.self_weight
         self.convergence_eps = msg.convergence_eps
         self._weights = {nb.token: nb.weight for nb in msg.neighbors}
-        self._expected_peers = {
-            nb.token for nb in msg.neighbors if nb.token < self.token
-        }
+        self._expected_peers = (
+            set()
+            if self.rejoin
+            else {nb.token for nb in msg.neighbors if nb.token < self.token}
+        )
         self._nbhd_ready.set()
 
         # Deterministic peer handshake: the lexicographically smaller token
         # accepts, the larger connects (the reference uses registration
-        # order for the same purpose, agent.py:137-150).
+        # order for the same purpose, agent.py:137-150).  A rejoiner dials
+        # everyone — its peers' listeners replace their dead streams.
         for nb in msg.neighbors:
-            if nb.token > self.token:
+            if nb.port == 0:
+                # Neighbor is itself down (elastic master marks its stale
+                # address with port 0); its replacement dials us on rejoin.
+                continue
+            if self.rejoin or nb.token > self.token:
                 stream = await open_framed_connection(nb.host, nb.port)
                 await stream.send(
                     P.Register(token=self.token, host=self.host, port=self.port)
@@ -188,6 +229,13 @@ class ConsensusAgent:
             self._peers_ready.set()
 
     def _add_neighbor(self, token: str, stream: FramedStream) -> None:
+        old = self._neighbors.get(token)
+        if old is not None:
+            # A rejoined peer replaces its dead stream: cancel the pending
+            # read on the corpse first or the multiplexer would keep
+            # watching it under the same token.
+            self._mux.remove(token)
+            old.close()
         self._neighbors[token] = stream
         self._mux.add(token, stream)
 
@@ -250,13 +298,24 @@ class ConsensusAgent:
         values: Dict[str, np.ndarray] = {}
         done_seen = False
         while len(values) < len(self._neighbors):
-            got = await self._recv_any()
-            token, msg = got
+            token, msg, src = await self._recv_any()
             if msg is None:
-                # Multiplexer sentinel: a neighbor connection died.  There
-                # is no recovery protocol (parity: the reference has none,
-                # SURVEY.md §5 failure detection: "none") — fail loudly
-                # rather than wait forever for its response.
+                # Multiplexer sentinel: a neighbor connection died.  It can
+                # be STALE: produced (inside the persistent _recv_any read)
+                # before a rejoined replacement dialed back in.  Stream
+                # identity decides: if the current stream for that token is
+                # not the one that died, the death is old news — resend this
+                # iteration's request on the fresh stream and keep going.
+                cur = self._neighbors.get(token)
+                if cur is not None and cur is not src:
+                    if token not in values:
+                        await cur.send(req)
+                    continue
+                # Genuine death: drop the corpse (a rejoined replacement
+                # re-registers through _handle_peer; see wait_neighbors)
+                # and fail the current op loudly rather than wait forever —
+                # recovery happens between rounds, not inside one.
+                self._neighbors.pop(token, None)
                 raise ConnectionError(f"neighbor {token} disconnected mid-gossip")
             if isinstance(msg, P.ValueRequest):
                 await self._answer(token, msg)
@@ -306,10 +365,10 @@ class ConsensusAgent:
         if self._master_task in done:
             msg = self._master_task.result()
             self._master_task = None
-            return "<master>", msg
-        token, msg, _stream = self._mux_task.result()
+            return "<master>", msg, self._master
+        token, msg, stream = self._mux_task.result()
         self._mux_task = None
-        return token, msg
+        return token, msg, stream
 
     async def _master_recv(self):
         """Master-stream read through the same persistent-task discipline."""
@@ -363,7 +422,11 @@ class ConsensusAgent:
                     raise RuntimeError(f"master: {msg.message}")
                 # Anything else (e.g. a stale Done) is dropped.
             self._round_id = msg.round_id
-            self._op_id += 1
+            # Master rounds re-derive the op tag from the broadcast round
+            # id (see _OPS_PER_ROUND): every agent — including one that
+            # just rejoined with fresh local state — lands on the same tag
+            # regardless of how many run_once calls it has or hasn't seen.
+            self._op_id = msg.round_id * _OPS_PER_ROUND
             self._iteration = -1
             # Weighted lift: y = x * w / mean(w) (consensus_asyncio.py:231).
             y = np.asarray(value, dtype=np.float32).ravel() * (
@@ -392,6 +455,19 @@ class ConsensusAgent:
     async def send_telemetry(self, payload: Dict[str, Any]) -> None:
         """Parity: ``send_telemetry``, agent.py:214-218."""
         await self._master.send(P.Telemetry(token=self.token, payload=payload))
+
+    async def wait_neighbors(self, timeout: float = 30.0) -> None:
+        """Block until every neighbor in the weight table has a live
+        stream — the heal step after a peer death under an elastic master:
+        catch the ConnectionError from the failed op, ``await
+        agent.wait_neighbors()`` (the rejoined replacement dials back in),
+        then retry the round."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while set(self._neighbors) != set(self._weights):
+            if asyncio.get_event_loop().time() > deadline:
+                missing = sorted(set(self._weights) - set(self._neighbors))
+                raise TimeoutError(f"neighbors never rejoined: {missing}")
+            await asyncio.sleep(0.02)
 
     # ------------------------------------------------------------------ #
     async def close(self) -> None:

@@ -54,6 +54,7 @@ class ConsensusMaster:
         weight_mode: str = "metropolis",
         convergence_eps: float = 1e-4,
         telemetry: Optional[TelemetryProcessor] = None,
+        elastic: bool = False,
         debug: bool = False,
     ):
         self.topology = (
@@ -90,6 +91,15 @@ class ConsensusMaster:
         self._round_id = 0
         self._round_weights: Dict[str, float] = {}
         self._converged: Dict[str, bool] = {}
+
+        # Elastic recovery (beyond parity: the reference's only failure
+        # handling is the shutdown broadcast, SURVEY.md §5).  With
+        # elastic=True a dead agent does not tear the deployment down:
+        # its token is marked down, any running round is aborted (Done
+        # broadcast — agents keep their current values), and a fresh
+        # process may re-register the same token to rejoin.
+        self.elastic = bool(elastic)
+        self._down: set = set()
 
     # ------------------------------------------------------------------ #
     def _debug(self, *args):
@@ -133,37 +143,55 @@ class ConsensusMaster:
             )
             stream.close()
             return
+        rejoining = self.elastic and token in self._down
         self._control[token] = stream
         self._listen_addr[token] = (msg.host, msg.port)
         self._debug(f"registered {token} @ {msg.host}:{msg.port}")
-        await stream.send(P.Ok(info="registered"))
+        await stream.send(P.Ok(info="rejoined" if rejoining else "registered"))
+        if rejoining:
+            # Resend this agent's neighborhood; the rejoiner initiates all
+            # its peer connections itself, so nobody else needs its new
+            # address.
+            self._down.discard(token)
+            await self._send_neighborhood(token)
+            self._mux.add(token, stream)
+            self._debug(f"{token} rejoined")
+            return
         if len(self._control) == len(self._tokens):
             await self._initialize_agents()
             self._all_registered.set()
+
+    async def _send_neighborhood(self, token: str) -> None:
+        i = self._index[token]
+        nbs: List[P.Neighbor] = []
+        for j in self.topology.neighbors(i):
+            nb_token = self._tokens[j]
+            host, port = self._listen_addr[nb_token]
+            if nb_token in self._down:
+                # Currently-down neighbor: its recorded address is stale.
+                # port 0 tells a rejoiner not to dial — the neighbor's own
+                # replacement will dial in when it re-registers.
+                host, port = "", 0
+            nbs.append(
+                P.Neighbor(
+                    token=nb_token, host=host, port=port,
+                    weight=float(self.W[i, j]),
+                )
+            )
+        await self._control[token].send(
+            P.NeighborhoodData(
+                self_weight=float(self.W[i, i]),
+                convergence_eps=self.convergence_eps,
+                neighbors=nbs,
+            )
+        )
 
     async def _initialize_agents(self) -> None:
         """Send every agent its neighborhood + mixing weights (parity:
         ``_initialize_agents`` + ``get_neighborhood_info_for_agent``,
         master.py:99-126, 227-243)."""
         for token in self._tokens:
-            i = self._index[token]
-            nbs: List[P.Neighbor] = []
-            for j in self.topology.neighbors(i):
-                nb_token = self._tokens[j]
-                host, port = self._listen_addr[nb_token]
-                nbs.append(
-                    P.Neighbor(
-                        token=nb_token, host=host, port=port,
-                        weight=float(self.W[i, j]),
-                    )
-                )
-            await self._control[token].send(
-                P.NeighborhoodData(
-                    self_weight=float(self.W[i, i]),
-                    convergence_eps=self.convergence_eps,
-                    neighbors=nbs,
-                )
-            )
+            await self._send_neighborhood(token)
             self._mux.add(token, self._control[token])
         self._debug("all agents initialized")
 
@@ -174,9 +202,30 @@ class ConsensusMaster:
             await self._all_registered.wait()
             async for token, msg, _stream in self._mux:
                 if msg is None:
+                    if self.elastic:
+                        # Agent died: mark it down, abort any running round
+                        # (Done: agents keep their current values and may
+                        # retry), keep serving so the token can rejoin.
+                        dead = self._control.pop(token, None)
+                        if dead is not None:
+                            # Close our half of the accepted connection, or
+                            # Server.wait_closed() (3.12+: waits for accepted
+                            # conns) would hang at shutdown.
+                            dead.close()
+                        self._down.add(token)
+                        self._round_weights.pop(token, None)
+                        if self._round_running:
+                            self._round_running = False
+                            await self._broadcast(P.Done(round_id=self._round_id))
+                            self._debug(
+                                f"round {self._round_id} aborted: {token} died"
+                            )
+                        self._debug(f"agent {token} down; awaiting rejoin")
+                        continue
                     # Control connection lost.  No recovery protocol exists
-                    # (parity: reference master's only failure handling is
-                    # the shutdown broadcast): tear the deployment down.
+                    # in non-elastic mode (parity: reference master's only
+                    # failure handling is the shutdown broadcast): tear the
+                    # deployment down.
                     raise RuntimeError(f"agent {token} disconnected")
                 if isinstance(msg, P.NewRoundRequest):
                     await self._on_round_request(token, msg)

@@ -23,7 +23,10 @@ class StreamMultiplexer:
 
     def __init__(self, streams: Optional[Dict[Hashable, FramedStream]] = None):
         self._streams: Dict[Hashable, FramedStream] = dict(streams or {})
-        self._pending: Dict[Hashable, asyncio.Task] = {}
+        # token -> (read task, the stream that task reads).  Tracking the
+        # stream alongside the task keeps replacement safe: an error from a
+        # read on a since-replaced stream must not evict the replacement.
+        self._pending: Dict[Hashable, tuple] = {}
         self._closed = False
 
     def add(self, token: Hashable, stream: FramedStream) -> None:
@@ -31,16 +34,16 @@ class StreamMultiplexer:
 
     def remove(self, token: Hashable) -> None:
         self._streams.pop(token, None)
-        task = self._pending.pop(token, None)
-        if task is not None:
-            task.cancel()
+        entry = self._pending.pop(token, None)
+        if entry is not None:
+            entry[0].cancel()
 
     def tokens(self):
         return tuple(self._streams)
 
     def close(self) -> None:
         self._closed = True
-        for task in self._pending.values():
+        for task, _ in self._pending.values():
             task.cancel()
         self._pending.clear()
 
@@ -49,33 +52,46 @@ class StreamMultiplexer:
 
     async def __anext__(self):
         """Yields ``(token, msg, stream)``; a dead peer yields
-        ``(token, None, None)`` exactly once so the caller can decide how to
-        handle the loss (silently shrinking the set would leave callers
-        waiting on a response count that can never be reached)."""
+        ``(token, None, dead_stream)`` exactly once so the caller can decide
+        how to handle the loss (silently shrinking the set would leave
+        callers waiting on a response count that can never be reached; the
+        dead stream's identity lets the caller tell a stale death notice
+        from the current stream's — e.g. after an elastic rejoin replaced
+        it)."""
         if self._closed:
             raise StopAsyncIteration
         while True:
             for token, stream in self._streams.items():
-                if token not in self._pending:
+                if (
+                    token not in self._pending
+                    or self._pending[token][1] is not stream
+                ):
+                    stale = self._pending.pop(token, None)
+                    if stale is not None:
+                        stale[0].cancel()
                     task = asyncio.ensure_future(stream.recv())
                     # Retrieve exceptions even if this task outlives every
                     # __anext__ call (e.g. connection dies after close()).
                     task.add_done_callback(
                         lambda t: t.exception() if not t.cancelled() else None
                     )
-                    self._pending[token] = task
+                    self._pending[token] = (task, stream)
             if not self._pending:
                 raise StopAsyncIteration
             done, _ = await asyncio.wait(
-                self._pending.values(), return_when=asyncio.FIRST_COMPLETED
+                [t for t, _ in self._pending.values()],
+                return_when=asyncio.FIRST_COMPLETED,
             )
             for token in list(self._pending):
-                task = self._pending[token]
+                task, src = self._pending[token]
                 if task in done:
                     del self._pending[token]
                     try:
                         msg = task.result()
                     except (asyncio.IncompleteReadError, ConnectionError, OSError):
-                        self._streams.pop(token, None)
-                        return token, None, None
-                    return token, msg, self._streams[token]
+                        # Evict only if the erroring stream is still the
+                        # registered one (not an already-replaced corpse).
+                        if self._streams.get(token) is src:
+                            self._streams.pop(token, None)
+                        return token, None, src
+                    return token, msg, self._streams.get(token, src)

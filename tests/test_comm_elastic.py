@@ -1,0 +1,235 @@
+"""Elastic recovery in the TCP backend: an agent dies, a replacement with
+the same token rejoins, and consensus rounds continue.
+
+Beyond parity: the reference's only failure handling is the shutdown
+broadcast (SURVEY.md §5 "failure detection / elastic recovery: none");
+here the master survives agent death (``elastic=True``), aborts the
+in-flight round, and lets a fresh process re-register the token
+(``ConsensusAgent(rejoin=True)``), which re-dials its neighbors and
+re-aligns gossip tags through the master's global round ids.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.comm.agent import ConsensusAgent
+from distributed_learning_tpu.comm.master import ConsensusMaster
+
+TRIANGLE = [("A", "B"), ("B", "C"), ("C", "A")]
+
+
+async def _deploy_elastic(eps=1e-7):
+    master = ConsensusMaster(TRIANGLE, convergence_eps=eps, elastic=True)
+    host, port = await master.start()
+    agents = {
+        t: ConsensusAgent(t, host, port) for t in ("A", "B", "C")
+    }
+    await asyncio.gather(*(a.start() for a in agents.values()))
+    return master, agents
+
+
+def test_agent_rejoin_between_rounds():
+    async def main():
+        master, agents = await _deploy_elastic()
+        host, port = master.address
+        vals = {
+            "A": np.array([3.0, 0.0], np.float32),
+            "B": np.array([0.0, 6.0], np.float32),
+            "C": np.array([9.0, 9.0], np.float32),
+        }
+        outs = await asyncio.gather(
+            *(a.run_round(vals[t], 1.0) for t, a in agents.items())
+        )
+        for out in outs:
+            np.testing.assert_allclose(out, [4.0, 5.0], atol=1e-3)
+
+        # B dies; a replacement process rejoins with B's token.
+        await agents["B"].close()
+        await asyncio.sleep(0.05)  # let the master observe the death
+        b2 = ConsensusAgent("B", host, port, rejoin=True)
+        await b2.start()
+        agents["B"] = b2
+
+        async def round2(token, agent):
+            # Survivors may first hit the dead stream from the old B;
+            # heal (wait for the rejoiner to dial back in) and retry.
+            for _ in range(3):
+                try:
+                    return await agent.run_round(outs[0] * 0 + vals[token], 1.0)
+                except ConnectionError:
+                    await agent.wait_neighbors(timeout=20.0)
+            raise AssertionError(f"{token} could not complete round 2")
+
+        outs2 = await asyncio.gather(
+            *(round2(t, a) for t, a in agents.items())
+        )
+        for out in outs2:
+            np.testing.assert_allclose(out, [4.0, 5.0], atol=1e-3)
+
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 90))
+
+
+def test_mid_round_death_aborts_round_and_recovers():
+    async def main():
+        master, agents = await _deploy_elastic(eps=1e-12)
+        host, port = master.address
+        vals = {
+            "A": np.full(4, 1.0, np.float32),
+            "B": np.full(4, 2.0, np.float32),
+            "C": np.full(4, 3.0, np.float32),
+        }
+
+        async def doomed():
+            # B dies mid-round: run a couple of iterations then vanish.
+            try:
+                await asyncio.wait_for(
+                    agents["B"].run_round(vals["B"], 1.0), 0.15
+                )
+            except (asyncio.TimeoutError, ConnectionError):
+                pass
+            await agents["B"].close()
+
+        async def survivor(token):
+            try:
+                return await agents[token].run_round(vals[token], 1.0)
+            except ConnectionError:
+                return None  # neighbor died mid-gossip; value kept by caller
+
+        _, ra, rc = await asyncio.gather(
+            doomed(), survivor("A"), survivor("C")
+        )
+        # Round was aborted (master broadcast Done) or failed on the dead
+        # stream; either way both survivors returned (no deadlock).
+
+        b2 = ConsensusAgent("B", host, port, rejoin=True)
+        await b2.start()
+        agents["B"] = b2
+
+        async def retry(token, agent):
+            for _ in range(3):
+                try:
+                    return await agent.run_round(vals[token], 1.0)
+                except ConnectionError:
+                    await agent.wait_neighbors(timeout=20.0)
+            raise AssertionError(f"{token} could not complete recovery round")
+
+        outs = await asyncio.gather(
+            *(retry(t, a) for t, a in agents.items())
+        )
+        for out in outs:
+            np.testing.assert_allclose(out, 2.0, atol=1e-3)
+
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 90))
+
+
+def test_double_death_and_rejoin_in_any_order():
+    """Two agents die; replacements rejoin sequentially.  The first
+    rejoiner must NOT dial the other dead agent's stale address (the
+    master marks down neighbors with port 0)."""
+
+    async def main():
+        master, agents = await _deploy_elastic()
+        host, port = master.address
+        vals = {
+            "A": np.full(3, 1.0, np.float32),
+            "B": np.full(3, 2.0, np.float32),
+            "C": np.full(3, 6.0, np.float32),
+        }
+        await asyncio.gather(
+            *(a.run_round(vals[t], 1.0) for t, a in agents.items())
+        )
+        await agents["B"].close()
+        await agents["C"].close()
+        await asyncio.sleep(0.05)
+
+        b2 = ConsensusAgent("B", host, port, rejoin=True)
+        await b2.start()  # C is down: must skip dialing its stale address
+        agents["B"] = b2
+        c2 = ConsensusAgent("C", host, port, rejoin=True)
+        await c2.start()  # dials both A and the rejoined B
+        agents["C"] = c2
+        await asyncio.gather(
+            agents["A"].wait_neighbors(20.0), b2.wait_neighbors(20.0)
+        )
+
+        async def retry(token, agent):
+            for _ in range(3):
+                try:
+                    return await agent.run_round(vals[token], 1.0)
+                except ConnectionError:
+                    await agent.wait_neighbors(timeout=20.0)
+            raise AssertionError(token)
+
+        outs = await asyncio.gather(*(retry(t, a) for t, a in agents.items()))
+        for out in outs:
+            np.testing.assert_allclose(out, 3.0, atol=1e-3)
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 90))
+
+
+def test_rejoin_races_death_detection():
+    """A replacement that registers before the master noticed the death
+    retries until the token frees up (no sleep between close and rejoin)."""
+
+    async def main():
+        master, agents = await _deploy_elastic()
+        host, port = master.address
+        vals = {
+            "A": np.full(2, 0.0, np.float32),
+            "B": np.full(2, 3.0, np.float32),
+            "C": np.full(2, 6.0, np.float32),
+        }
+        await asyncio.gather(
+            *(a.run_round(vals[t], 1.0) for t, a in agents.items())
+        )
+        await agents["B"].close()
+        b2 = ConsensusAgent("B", host, port, rejoin=True)
+        await b2.start()  # no sleep: may hit "already registered" and retry
+        agents["B"] = b2
+
+        async def retry(token, agent):
+            for _ in range(3):
+                try:
+                    return await agent.run_round(vals[token], 1.0)
+                except ConnectionError:
+                    await agent.wait_neighbors(timeout=20.0)
+            raise AssertionError(token)
+
+        outs = await asyncio.gather(*(retry(t, a) for t, a in agents.items()))
+        for out in outs:
+            np.testing.assert_allclose(out, 3.0, atol=1e-3)
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 90))
+
+
+def test_non_elastic_master_still_fails_loudly():
+    async def main():
+        master = ConsensusMaster(TRIANGLE, elastic=False)
+        host, port = await master.start()
+        agents = {t: ConsensusAgent(t, host, port) for t in ("A", "B", "C")}
+        await asyncio.gather(*(a.start() for a in agents.values()))
+        await agents["B"].close()
+        # The non-elastic master tears the deployment down on agent death
+        # (reference-parity behavior): its serve loop stops.
+        await asyncio.wait_for(master._stopped.wait(), 10)
+        for t in ("A", "C"):
+            await agents[t].close()
+        await master.shutdown()
+
+    asyncio.run(asyncio.wait_for(main(), 60))

@@ -100,6 +100,13 @@ def test_config_file_not_clobbered_by_cli_defaults(tmp_path):
     assert resolved.model_args == [100]  # cifar100 classes
 
 
+def test_no_donate_flag_disables_state_donation():
+    args = build_parser().parse_args(["--no-donate"])
+    assert config_from_args(args).donate_state is False
+    args = build_parser().parse_args([])
+    assert config_from_args(args).donate_state is True
+
+
 def test_wrn_schedule_short_runs_compound_collisions():
     from distributed_learning_tpu.training import wrn_lr_schedule
 
