@@ -31,6 +31,10 @@ from distributed_learning_tpu.parallel.fast_averaging import (
     find_optimal_weights,
     solve_fastest_mixing,
 )
+from distributed_learning_tpu.parallel.pushsum import (
+    PushSumEngine,
+    push_sum_matrix,
+)
 
 __version__ = "0.1.0"
 
@@ -40,5 +44,7 @@ __all__ = [
     "spectral_gap",
     "find_optimal_weights",
     "solve_fastest_mixing",
+    "PushSumEngine",
+    "push_sum_matrix",
     "__version__",
 ]

@@ -32,7 +32,12 @@ from distributed_learning_tpu.comm.framing import FramedStream, open_framed_conn
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
 from distributed_learning_tpu.comm import protocol as P
 
-__all__ = ["ConsensusAgent", "AgentStatus", "ShutdownError"]
+__all__ = [
+    "ConsensusAgent",
+    "AgentStatus",
+    "ShutdownError",
+    "RoundAbortedError",
+]
 
 # Collective-op tag space: op_id = round_id * _OPS_PER_ROUND + seq, where
 # round_id is the master's (global, strictly increasing) round counter and
@@ -47,6 +52,13 @@ _OPS_PER_ROUND = 1 << 20
 
 class ShutdownError(RuntimeError):
     """Master broadcast Shutdown while an operation was in flight."""
+
+
+class RoundAbortedError(ConnectionError):
+    """The elastic master aborted the round (an agent died mid-round); the
+    caller's value was NOT mixed to consensus.  Subclasses ConnectionError
+    so the standard heal-and-retry pattern (catch, ``wait_neighbors()``,
+    retry the round) covers aborts too."""
 
 
 class AgentStatus(enum.Enum):
@@ -327,6 +339,13 @@ class ConsensusAgent:
                     values[token] = msg.value
                 # else stale response from an aborted iteration: drop.
             elif isinstance(msg, P.Done) and msg.round_id == self._round_id:
+                if msg.aborted:
+                    # Elastic abort: the value is mid-mix (and still weight
+                    # lifted in run_round) — it must NOT be returned as a
+                    # consensus result.
+                    raise RoundAbortedError(
+                        f"round {self._round_id} aborted by the master"
+                    )
                 done_seen = True
                 break
             elif isinstance(msg, P.Shutdown):
@@ -385,6 +404,7 @@ class ConsensusAgent:
         agent.py:158-212).  All agents must call it concurrently."""
         if self.status not in (AgentStatus.READY, AgentStatus.IN_ROUND):
             raise RuntimeError(f"agent not ready (status={self.status})")
+        self._require_neighbors()
         y = np.asarray(value, dtype=np.float32).ravel()
         # New collective op: op ids advance identically on every agent
         # (collective calls happen in the same order everywhere), which
@@ -409,6 +429,7 @@ class ConsensusAgent:
         """
         if self.status is not AgentStatus.READY:
             raise RuntimeError(f"agent not ready (status={self.status})")
+        self._require_neighbors()
         self.status = AgentStatus.IN_ROUND
         try:
             await self._master.send(P.NewRoundRequest(weight=float(weight)))
@@ -455,6 +476,17 @@ class ConsensusAgent:
     async def send_telemetry(self, payload: Dict[str, Any]) -> None:
         """Parity: ``send_telemetry``, agent.py:214-218."""
         await self._master.send(P.Telemetry(token=self.token, payload=payload))
+
+    def _require_neighbors(self) -> None:
+        """A collective op with missing neighbor streams would silently
+        mix with the dead peer's mass dropped (the weight row no longer
+        sums to 1): refuse instead, pointing at the heal path."""
+        missing = set(self._weights) - set(self._neighbors)
+        if missing:
+            raise ConnectionError(
+                f"neighbors not connected: {sorted(missing)}; await "
+                "wait_neighbors() for their replacements to dial in"
+            )
 
     async def wait_neighbors(self, timeout: float = 30.0) -> None:
         """Block until every neighbor in the weight table has a live

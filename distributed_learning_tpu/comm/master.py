@@ -143,18 +143,30 @@ class ConsensusMaster:
             )
             stream.close()
             return
-        rejoining = self.elastic and token in self._down
+        # A token that died BEFORE the deployment initialized re-registers
+        # as a plain registration (its neighbors have no stale streams yet);
+        # after initialization it is a rejoin.
+        rejoining = (
+            self.elastic
+            and token in self._down
+            and self._all_registered.is_set()
+        )
+        self._down.discard(token)
         self._control[token] = stream
         self._listen_addr[token] = (msg.host, msg.port)
         self._debug(f"registered {token} @ {msg.host}:{msg.port}")
         await stream.send(P.Ok(info="rejoined" if rejoining else "registered"))
+        # Into the mux immediately: deaths are then observable in every
+        # phase, including the registration window, and the serve loop's
+        # parked wait is woken for the new stream (elastic rejoin would
+        # otherwise leave its round request unread until unrelated traffic
+        # arrived).
+        self._mux.add(token, stream)
         if rejoining:
             # Resend this agent's neighborhood; the rejoiner initiates all
             # its peer connections itself, so nobody else needs its new
             # address.
-            self._down.discard(token)
             await self._send_neighborhood(token)
-            self._mux.add(token, stream)
             self._debug(f"{token} rejoined")
             return
         if len(self._control) == len(self._tokens):
@@ -192,14 +204,17 @@ class ConsensusMaster:
         master.py:99-126, 227-243)."""
         for token in self._tokens:
             await self._send_neighborhood(token)
-            self._mux.add(token, self._control[token])
         self._debug("all agents initialized")
 
     # ------------------------------------------------------------------ #
     async def _serve(self) -> None:
-        """Round lifecycle loop (parity: ``_serve``, master.py:128-203)."""
+        """Round lifecycle loop (parity: ``_serve``, master.py:128-203).
+
+        Runs from startup (not from all-registered): control streams join
+        the multiplexer at registration, so agent deaths are detected in
+        every phase — the mux parks while the stream set is empty.
+        """
         try:
-            await self._all_registered.wait()
             async for token, msg, _stream in self._mux:
                 if msg is None:
                     if self.elastic:
@@ -216,7 +231,9 @@ class ConsensusMaster:
                         self._round_weights.pop(token, None)
                         if self._round_running:
                             self._round_running = False
-                            await self._broadcast(P.Done(round_id=self._round_id))
+                            await self._broadcast(
+                                P.Done(round_id=self._round_id, aborted=True)
+                            )
                             self._debug(
                                 f"round {self._round_id} aborted: {token} died"
                             )

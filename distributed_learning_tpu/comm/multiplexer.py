@@ -28,9 +28,14 @@ class StreamMultiplexer:
         # read on a since-replaced stream must not evict the replacement.
         self._pending: Dict[Hashable, tuple] = {}
         self._closed = False
+        # Set by add(): wakes a parked __anext__ so a stream registered
+        # mid-wait (e.g. an elastic rejoin) gets its read armed immediately
+        # instead of after the next unrelated frame.
+        self._wake: asyncio.Event = asyncio.Event()
 
     def add(self, token: Hashable, stream: FramedStream) -> None:
         self._streams[token] = stream
+        self._wake.set()
 
     def remove(self, token: Hashable) -> None:
         self._streams.pop(token, None)
@@ -46,6 +51,7 @@ class StreamMultiplexer:
         for task, _ in self._pending.values():
             task.cancel()
         self._pending.clear()
+        self._wake.set()  # unpark a waiter blocked on an empty stream set
 
     def __aiter__(self) -> AsyncIterator[Tuple[Hashable, Optional[Message], Optional[FramedStream]]]:
         return self
@@ -58,9 +64,9 @@ class StreamMultiplexer:
         dead stream's identity lets the caller tell a stale death notice
         from the current stream's — e.g. after an elastic rejoin replaced
         it)."""
-        if self._closed:
-            raise StopAsyncIteration
         while True:
+            if self._closed:
+                raise StopAsyncIteration
             for token, stream in self._streams.items():
                 if (
                     token not in self._pending
@@ -76,12 +82,19 @@ class StreamMultiplexer:
                         lambda t: t.exception() if not t.cancelled() else None
                     )
                     self._pending[token] = (task, stream)
-            if not self._pending:
-                raise StopAsyncIteration
-            done, _ = await asyncio.wait(
-                [t for t, _ in self._pending.values()],
-                return_when=asyncio.FIRST_COMPLETED,
-            )
+            self._wake.clear()
+            wake = asyncio.ensure_future(self._wake.wait())
+            try:
+                # Waiting on wake alongside the reads means an empty set
+                # parks (streams may be added later — e.g. before agents
+                # register, or awaiting an elastic rejoin) instead of
+                # stopping, and a mid-wait add() re-arms immediately.
+                done, _ = await asyncio.wait(
+                    [t for t, _ in self._pending.values()] + [wake],
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+            finally:
+                wake.cancel()
             for token in list(self._pending):
                 task, src = self._pending[token]
                 if task in done:

@@ -293,19 +293,22 @@ class NotConverged(Message):
 
 @dataclasses.dataclass
 class Done(Message):
-    """Master -> agents: round converged globally (parity: ``ProtoDone``,
-    protocol.py:72-74)."""
+    """Master -> agents: round ended (parity: ``ProtoDone``,
+    protocol.py:72-74).  ``aborted`` (this framework's addition) marks an
+    elastic-mode abort — an agent died mid-round, values are NOT a
+    consensus — as opposed to global convergence."""
 
     TYPE_CODE: ClassVar[int] = 11
     round_id: int = 0
+    aborted: bool = False
 
     def _pack(self) -> bytes:
-        return struct.pack("<q", self.round_id)
+        return struct.pack("<qB", self.round_id, int(self.aborted))
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "Done":
-        (r,) = struct.unpack_from("<q", buf, 0)
-        return cls(round_id=r)
+        r, a = struct.unpack_from("<qB", buf, 0)
+        return cls(round_id=r, aborted=bool(a))
 
 
 @dataclasses.dataclass

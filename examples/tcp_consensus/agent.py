@@ -34,11 +34,14 @@ async def main():
     ap.add_argument("--weight", type=float, default=None,
                     help="sample weight (default: int(token))")
     ap.add_argument("--bf16-wire", action="store_true")
+    ap.add_argument("--rejoin", action="store_true",
+                    help="replace a dead agent with this token "
+                         "(master must run with --elastic)")
     args = ap.parse_args()
 
     agent = ConsensusAgent(
         args.token, args.master_host, args.master_port,
-        bf16_wire=args.bf16_wire,
+        bf16_wire=args.bf16_wire, rejoin=args.rejoin,
     )
     await agent.start(timeout=300)
     print(f"agent {agent.token}: neighbors {agent.neighbor_tokens}, "

@@ -34,12 +34,15 @@ async def main():
                     help="comma-separated token pairs, e.g. 1-2,2-3")
     ap.add_argument("--weights", default="sdp", choices=["sdp", "metropolis"])
     ap.add_argument("--eps", type=float, default=1e-6)
+    ap.add_argument("--elastic", action="store_true",
+                    help="survive agent death; allow token rejoin")
     args = ap.parse_args()
 
     edges = [tuple(e.split("-")) for e in args.edges.split(",")]
     master = ConsensusMaster(
         edges, port=args.port, weight_mode=args.weights,
         convergence_eps=args.eps, telemetry=PrintTelemetry(),
+        elastic=args.elastic,
     )
     host, port = await master.start()
     print(f"master listening on {host}:{port}; topology {edges}", flush=True)

@@ -106,6 +106,7 @@ def test_protocol_message_roundtrips():
         P.Converged(round_id=7, iteration=3),
         P.NotConverged(round_id=7, iteration=3),
         P.Done(round_id=7),
+        P.Done(round_id=8, aborted=True),
         P.Shutdown(reason="bye"),
         P.Telemetry(token="a", payload={"loss": 0.5, "n": 3}),
     ]

@@ -218,6 +218,47 @@ def test_rejoin_races_death_detection():
     asyncio.run(asyncio.wait_for(main(), 90))
 
 
+def test_death_during_registration_window():
+    """An agent that registers and dies BEFORE the deployment initializes
+    is replaced by a plain re-registration; the deployment then proceeds."""
+
+    async def main():
+        master = ConsensusMaster(TRIANGLE, convergence_eps=1e-7, elastic=True)
+        host, port = await master.start()
+        a = ConsensusAgent("A", host, port)
+        b = ConsensusAgent("B", host, port)
+
+        # Registration exchanges happen, then B dies (no C yet, so these
+        # start() calls block awaiting NeighborhoodData).
+        ta = asyncio.ensure_future(a.start())
+        tb = asyncio.ensure_future(b.start())
+        await asyncio.sleep(0.2)
+        await b.close()  # dies pre-initialization
+        tb.cancel()
+        await asyncio.sleep(0.1)  # master observes the death
+
+        b2 = ConsensusAgent("B", host, port)  # plain registration suffices
+        tb2 = asyncio.ensure_future(b2.start())
+        c = ConsensusAgent("C", host, port)
+        await asyncio.gather(ta, tb2, c.start())
+
+        vals = {"A": 0.0, "B": 3.0, "C": 6.0}
+        agents = {"A": a, "B": b2, "C": c}
+        outs = await asyncio.gather(
+            *(
+                ag.run_round(np.full(2, vals[t], np.float32), 1.0)
+                for t, ag in agents.items()
+            )
+        )
+        for out in outs:
+            np.testing.assert_allclose(out, 3.0, atol=1e-3)
+        await master.shutdown()
+        for ag in agents.values():
+            await ag.close()
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
 def test_non_elastic_master_still_fails_loudly():
     async def main():
         master = ConsensusMaster(TRIANGLE, elastic=False)
