@@ -17,7 +17,12 @@ ICI/DCN); this backend is the interoperability / heterogeneous-cluster
 path.
 """
 
-from distributed_learning_tpu.comm.agent import AgentStatus, ConsensusAgent, ShutdownError
+from distributed_learning_tpu.comm.agent import (
+    AgentStatus,
+    ConsensusAgent,
+    RoundAbortedError,
+    ShutdownError,
+)
 from distributed_learning_tpu.comm.framing import FramedStream, FrameError, open_framed_connection
 from distributed_learning_tpu.comm.master import ConsensusMaster
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
@@ -29,6 +34,7 @@ __all__ = [
     "ConsensusMaster",
     "FramedStream",
     "FrameError",
+    "RoundAbortedError",
     "ShutdownError",
     "StreamMultiplexer",
     "open_framed_connection",

@@ -174,6 +174,15 @@ class ConsensusMaster:
             self._all_registered.set()
 
     async def _send_neighborhood(self, token: str) -> None:
+        stream = self._control.get(token)
+        if stream is None:
+            # Agent died while initialization was in flight (the serve loop
+            # pops dead tokens concurrently — it runs from startup, not from
+            # all-registered).  Its rejoin re-requests the neighborhood, so
+            # skipping here is safe; raising would kill the registration
+            # handler and wedge the deployment.
+            self._debug(f"skip neighborhood for {token}: not connected")
+            return
         i = self._index[token]
         nbs: List[P.Neighbor] = []
         for j in self.topology.neighbors(i):
@@ -190,13 +199,18 @@ class ConsensusMaster:
                     weight=float(self.W[i, j]),
                 )
             )
-        await self._control[token].send(
-            P.NeighborhoodData(
-                self_weight=float(self.W[i, i]),
-                convergence_eps=self.convergence_eps,
-                neighbors=nbs,
+        try:
+            await stream.send(
+                P.NeighborhoodData(
+                    self_weight=float(self.W[i, i]),
+                    convergence_eps=self.convergence_eps,
+                    neighbors=nbs,
+                )
             )
-        )
+        except (ConnectionError, OSError) as exc:
+            # The death itself surfaces through the mux sentinel; here we
+            # only keep the caller (registration handler or init loop) alive.
+            self._debug(f"neighborhood send to {token} failed: {exc}")
 
     async def _initialize_agents(self) -> None:
         """Send every agent its neighborhood + mixing weights (parity:

@@ -15,8 +15,14 @@ from distributed_learning_tpu.parallel.pushsum import (
     PushSumEngine,
     push_sum_matrix,
 )
+from distributed_learning_tpu.parallel.gradient_tracking import (
+    GradientTrackingEngine,
+    TrackingState,
+)
 
 __all__ = [
+    "GradientTrackingEngine",
+    "TrackingState",
     "Topology",
     "gamma",
     "spectral_gap",

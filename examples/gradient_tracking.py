@@ -1,0 +1,67 @@
+"""Gradient tracking vs gossip SGD under heterogeneous data.
+
+Beyond-parity demo: the reference's training recipe is local (sub)gradient
+steps + neighbor averaging (``Titanic Consensus GD test.ipynb`` cell 14).
+With a constant step size and *heterogeneous* shards that recipe stalls at
+a biased consensus point; DSGT (``parallel/gradient_tracking.py``) gossips
+a gradient tracker alongside the parameters and lands on the exact global
+optimum over the same ring, with the same per-round bandwidth ×2.
+
+Run:  python -m examples.gradient_tracking
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_tpu.parallel import (
+    GradientTrackingEngine,
+    Topology,
+)
+
+N, DIM, ALPHA, STEPS = 8, 12, 4e-3, 6000
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    As, bs = [], []
+    for i in range(N):
+        M = rng.normal(size=(DIM, DIM))
+        As.append(M @ M.T + (0.5 + i) * np.eye(DIM))
+        bs.append(10.0 * rng.normal(size=(DIM,)))
+    A = jnp.asarray(np.stack(As), jnp.float32)
+    b = jnp.asarray(np.stack(bs), jnp.float32)
+    x_star = np.linalg.solve(np.sum(As, 0), np.sum(bs, 0))
+
+    def grad_fn(x_i, agent_idx, step):
+        return A[agent_idx] @ x_i - b[agent_idx]
+
+    W = Topology.ring(N).metropolis_weights()
+    Wj = jnp.asarray(W, jnp.float32)
+
+    # --- the reference recipe: grad step then gossip ------------------- #
+    def gossip_body(x, _):
+        g = jax.vmap(lambda xi, i: grad_fn(xi, i, 0))(x, jnp.arange(N))
+        return Wj @ (x - ALPHA * g), None
+
+    x_gossip, _ = jax.lax.scan(
+        gossip_body, jnp.zeros((N, DIM)), None, length=STEPS
+    )
+    gossip_err = float(jnp.abs(x_gossip - x_star[None]).max())
+
+    # --- gradient tracking over the same ring -------------------------- #
+    eng = GradientTrackingEngine(W, grad_fn, learning_rate=ALPHA)
+    state = eng.init(jnp.zeros((N, DIM), jnp.float32))
+    state, residuals = eng.run(state, STEPS)
+    gt_err = float(jnp.abs(jnp.asarray(state.x) - x_star[None]).max())
+
+    print(f"ring of {N} agents, heterogeneous quadratics, alpha={ALPHA}")
+    print(f"gossip SGD optimality gap after {STEPS} steps: {gossip_err:.2e}  (bias floor)")
+    print(f"DSGT       optimality gap after {STEPS} steps: {gt_err:.2e}")
+    print(f"DSGT consensus residual: {float(residuals[-1]):.2e}")
+
+
+if __name__ == "__main__":
+    main()

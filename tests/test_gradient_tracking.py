@@ -1,0 +1,158 @@
+"""Gradient tracking (DSGT) — beyond-parity decentralized optimizer.
+
+The defining property, straight from the DIGing/DSGT analysis: with
+heterogeneous local objectives and a constant step size, plain gossip SGD
+(the reference's only optimizer — local grad step then neighbor averaging,
+``Titanic Consensus GD test.ipynb`` cell 14) converges to a *biased* point,
+while gradient tracking converges to the exact global optimum.  Quadratic
+objectives make both fixed points computable, so the tests assert the gap
+numerically rather than statistically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.parallel import (
+    GradientTrackingEngine,
+    Topology,
+)
+from distributed_learning_tpu.parallel.consensus import make_agent_mesh
+
+N, DIM = 8, 6
+
+
+def _quadratics(seed: int = 0):
+    """Per-agent f_i(x) = 0.5 x'A_i x - b_i'x with strongly heterogeneous
+    (A_i, b_i); global optimum solves (sum A_i) x = sum b_i."""
+    rng = np.random.default_rng(seed)
+    As, bs = [], []
+    for i in range(N):
+        M = rng.normal(size=(DIM, DIM))
+        As.append(M @ M.T + (0.5 + i) * np.eye(DIM))  # SPD, spread spectra
+        bs.append(10.0 * rng.normal(size=(DIM,)))
+    A = jnp.asarray(np.stack(As), jnp.float32)
+    b = jnp.asarray(np.stack(bs), jnp.float32)
+    x_star = np.linalg.solve(np.sum(As, axis=0), np.sum(bs, axis=0))
+
+    def grad_fn(x_i, agent_idx, step):
+        return A[agent_idx] @ x_i - b[agent_idx]
+
+    return grad_fn, np.asarray(x_star, np.float64)
+
+
+def _gossip_sgd(grad_fn, W, x0, alpha, steps):
+    """The reference recipe: per-agent grad step, then one gossip round."""
+    Wj = jnp.asarray(W, jnp.float32)
+    idx = jnp.arange(N)
+
+    def body(x, _):
+        g = jax.vmap(lambda xi, i: grad_fn(xi, i, 0))(x, idx)
+        return Wj @ (x - alpha * g), None
+
+    x, _ = jax.lax.scan(body, jnp.asarray(x0), None, length=steps)
+    return np.asarray(x, np.float64)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_dsgt_reaches_global_optimum(sharded):
+    grad_fn, x_star = _quadratics()
+    topo = Topology.ring(N)
+    mesh = make_agent_mesh(N) if sharded else None
+    eng = GradientTrackingEngine(
+        topo.metropolis_weights(), grad_fn, learning_rate=5e-3, mesh=mesh
+    )
+    state = eng.init(jnp.zeros((N, DIM), jnp.float32))
+    state, residuals = eng.run(state, 4000)
+    x = np.asarray(state.x, np.float64)
+    # Every agent sits at the *global* optimum despite only ever seeing
+    # its own (A_i, b_i).
+    err = np.abs(x - x_star[None, :]).max()
+    assert err < 1e-3, f"DSGT optimality gap {err}"
+    assert float(residuals[-1]) < 1e-4  # and in consensus
+
+
+def test_dsgt_beats_biased_gossip_sgd():
+    grad_fn, x_star = _quadratics()
+    W = Topology.ring(N).metropolis_weights()
+    alpha = 5e-3
+    x_gossip = _gossip_sgd(grad_fn, W, np.zeros((N, DIM)), alpha, 4000)
+    gossip_err = np.abs(x_gossip - x_star[None, :]).max()
+
+    eng = GradientTrackingEngine(W, grad_fn, learning_rate=alpha)
+    state = eng.init(jnp.zeros((N, DIM), jnp.float32))
+    state, _ = eng.run(state, 4000)
+    gt_err = np.abs(np.asarray(state.x) - x_star[None, :]).max()
+
+    # Constant-step gossip SGD stalls at its heterogeneity bias; tracking
+    # does not.  The margin is orders of magnitude, not noise.
+    assert gossip_err > 1e-2, f"expected visible gossip bias, got {gossip_err}"
+    assert gt_err < gossip_err / 50
+
+
+def test_tracking_invariant_sum_y_equals_sum_g():
+    grad_fn, _ = _quadratics()
+    eng = GradientTrackingEngine(
+        Topology.erdos_renyi(N, 0.5, seed=2).metropolis_weights(),
+        grad_fn,
+        learning_rate=3e-3,
+    )
+    state = eng.init(jnp.zeros((N, DIM), jnp.float32))
+    for _ in range(3):
+        state, _ = eng.run(state, 7)
+        assert eng.tracker_sum_gap(state) < 1e-3
+
+
+@pytest.mark.parametrize("graph", ["ring", "path"])
+def test_dense_and_sharded_agree(graph):
+    grad_fn, _ = _quadratics(seed=5)
+    if graph == "ring":
+        W = Topology.ring(N).metropolis_weights()
+    else:
+        # Path graph: NON-uniform Metropolis weights and agent 0 is
+        # unmatched in one color class — regression guard for the sharded
+        # path reading agent 0's schedule weights on every device (weights
+        # must flow through shard_map in_specs, not closure capture).
+        W = Topology.from_edges(
+            [(i, i + 1) for i in range(N - 1)]
+        ).metropolis_weights()
+    x0 = jnp.asarray(
+        np.random.default_rng(3).normal(size=(N, DIM)).astype(np.float32)
+    )
+    dense = GradientTrackingEngine(W, grad_fn, learning_rate=4e-3)
+    sd = dense.init(x0)
+    sd, rd = dense.run(sd, 50)
+    shard = GradientTrackingEngine(
+        W, grad_fn, learning_rate=4e-3, mesh=make_agent_mesh(N)
+    )
+    ss = shard.init(x0)
+    ss, rs = shard.run(ss, 50)
+    np.testing.assert_allclose(
+        np.asarray(sd.x), np.asarray(ss.x), rtol=2e-4, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(rd), np.asarray(rs), rtol=2e-3, atol=1e-5
+    )
+
+
+def test_learning_rate_schedule_and_pytree_state():
+    """Pytree (dict) parameters + callable lr schedule both trace."""
+    rng = np.random.default_rng(7)
+    A = jnp.asarray(rng.normal(size=(N, DIM, DIM)).astype(np.float32))
+    A = jnp.einsum("nij,nkj->nik", A, A) + jnp.eye(DIM)[None]
+    b = jnp.asarray(rng.normal(size=(N, DIM)).astype(np.float32))
+
+    def grad_fn(p, i, step):
+        return {"w": A[i] @ p["w"] - b[i], "c": p["c"]}
+
+    eng = GradientTrackingEngine(
+        Topology.complete(N).metropolis_weights(),
+        grad_fn,
+        learning_rate=lambda step: 1e-2 / jnp.sqrt(1.0 + step),
+    )
+    x0 = {"w": jnp.zeros((N, DIM)), "c": jnp.ones((N, 1))}
+    state = eng.init(x0)
+    state, res = eng.run(state, 100)
+    assert np.isfinite(np.asarray(res)).all()
+    assert float(res[-1]) < float(res[0])
