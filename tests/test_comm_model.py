@@ -141,13 +141,13 @@ def test_three_processes_gossip_mlp_params_to_weighted_mean():
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
 
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     # Hermetic children: drop any site hooks (e.g. an accelerator-tunnel
     # sitecustomize) that could stall these CPU-only subprocesses.
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
     weights = {"A": 1.0, "B": 2.0, "C": 3.0}
 
     with tempfile.TemporaryDirectory() as outdir:

@@ -54,8 +54,8 @@ def test_two_process_initialize_and_local_agents():
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     # Hermetic children: drop any site hooks (e.g. an accelerator-tunnel
     # sitecustomize) that could stall these CPU-only subprocesses.
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, coordinator, str(pid)],
