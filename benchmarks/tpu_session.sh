@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# One serialized TPU measurement session (the tunneled chip is single-
+# process: never run two of these stages concurrently).
+#
+#   bash benchmarks/tpu_session.sh [outdir]
+#
+# Stages:
+#   1. headline bench.py at the shipped configuration
+#   2. the five BASELINE configs + flash-attention TFLOP/s (run_all)
+#   3. WRN-28-10 training-to-accuracy (synthetic stand-in when no real
+#      CIFAR at $DLT_CIFAR_DIR) — the long stage, ~30-60 min
+#   4. fold stages 1-3 into BASELINE.json:"published"
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-benchmarks/results}"
+mkdir -p "$OUT"
+STAMP=$(date +%Y%m%d_%H%M%S)
+CAPTURE="$OUT/session_$STAMP.jsonl"
+
+echo "== stage 1: headline bench" >&2
+if python bench.py 2>"$OUT/bench_$STAMP.err" | tee "$OUT/bench_$STAMP.json"; then
+  cat "$OUT/bench_$STAMP.json" >>"$CAPTURE"   # one JSON metric line
+else
+  echo "stage 1 (bench.py) FAILED rc=$? — see $OUT/bench_$STAMP.err" >&2
+fi
+
+echo "== stage 2: five configs + attention" >&2
+BENCH_OUT="$CAPTURE" python -m benchmarks.run_all \
+  2>"$OUT/run_all_$STAMP.err" || echo "stage 2 (run_all) rc=$?" >&2
+
+echo "== stage 3: WRN accuracy" >&2
+ACC_JSON="$OUT/wrn_accuracy_$STAMP.json"
+if python -m benchmarks.train_wrn_accuracy --out "$ACC_JSON" \
+  2>"$OUT/wrn_accuracy_$STAMP.err"; then
+  # Lift the summary record into the capture so it publishes too.
+  python - "$ACC_JSON" >>"$CAPTURE" <<'EOF'
+import json, sys
+print(json.dumps(json.load(open(sys.argv[1]))["summary"]))
+EOF
+else
+  echo "stage 3 (accuracy) rc=$?" >&2
+fi
+
+echo "== stage 4: publish" >&2
+python -m benchmarks.publish "$CAPTURE"
+echo "session artifacts in $OUT (stamp $STAMP)" >&2

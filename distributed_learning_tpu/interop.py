@@ -1,0 +1,148 @@
+"""Torch interop: gossip-average existing ``torch.nn.Module`` replicas.
+
+Migration path for users of the reference, whose models are all torch
+(``utils/consensus_simple/mixer.py`` flattens torch parameters to numpy and
+mixes with an O(N^2 * P) dense loop on the host, ``mixer.py:43-49,68-76``).
+:class:`TorchModelMixer` keeps their models and training loops untouched:
+it lifts ``named_parameters()`` into a numpy pytree, runs the mixing rounds
+on the JAX device (MXU matmuls / ppermute — the same
+:class:`~distributed_learning_tpu.parallel.consensus.Mixer` engine as the
+native path), and copies the result back **in place**, so torch optimizer
+state (momentum buffers keyed by parameter identity) survives mixing.
+
+Matching the reference's semantics (SURVEY §7: "only params mix"): exactly
+the *parameters* are averaged; buffers — BN running stats,
+``num_batches_tracked`` — stay per-agent.
+
+Torch is an optional dependency of this module only; nothing else in the
+package imports it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from distributed_learning_tpu.parallel.consensus import Mixer
+
+__all__ = ["TorchModelMixer"]
+
+
+def _require_torch():
+    try:
+        import torch  # noqa: F401
+    except ImportError as exc:  # pragma: no cover - torch is in this image
+        raise ImportError(
+            "TorchModelMixer needs torch; install it or use the native "
+            "Mixer on JAX pytrees"
+        ) from exc
+    return torch
+
+
+class TorchModelMixer:
+    """Gossip-average the parameters of N torch model replicas.
+
+    Parameters
+    ----------
+    models:
+        ``{token: torch.nn.Module}`` — replicas of one architecture.
+    topology:
+        The reference's ``{agent: {neighbor: weight}}`` dict
+        (``Man_Colab.ipynb`` cell 14) or an (n, n) mixing matrix.
+    mesh / tokens / logger / max_rounds:
+        Forwarded to the native :class:`Mixer`.
+
+    ``mix(times, eps)`` has the reference ``Mixer.mix`` contract
+    (``mixer.py:18-41``): run ``times`` rounds, or with ``eps`` keep going
+    until the max across-agent deviation drops below it.  Parameters are
+    updated in place under ``torch.no_grad()``.
+    """
+
+    def __init__(
+        self,
+        models: Mapping[Hashable, "object"],
+        topology,
+        *,
+        tokens: Sequence[Hashable] | None = None,
+        mesh=None,
+        logger=None,
+        max_rounds: int = 10_000,
+    ):
+        self._torch = _require_torch()
+        self.models = dict(models)
+        first = next(iter(self.models.values()))
+        sig = [(n, tuple(p.shape)) for n, p in first.named_parameters()]
+        for tok, m in self.models.items():
+            have = [(n, tuple(p.shape)) for n, p in m.named_parameters()]
+            if have != sig:
+                diff = [
+                    f"{a[0]}{a[1]} vs {b[0]}{b[1]}"
+                    for a, b in zip(sig, have) if a != b
+                ] or [f"{len(sig)} vs {len(have)} parameters"]
+                raise ValueError(
+                    f"model {tok!r} parameters differ from the first "
+                    f"replica ({'; '.join(diff[:3])}) — are these the same "
+                    "architecture?"
+                )
+        self._names = [n for n, _ in sig]
+        self._mixer = Mixer(
+            {tok: self._pull(m) for tok, m in self.models.items()},
+            topology,
+            tokens=tokens,
+            mesh=mesh,
+            logger=logger,
+            max_rounds=max_rounds,
+        )
+
+    # ------------------------------------------------------------------ #
+    def _pull(self, model) -> Dict[str, np.ndarray]:
+        return {
+            name: p.detach().cpu().numpy().copy()
+            for name, p in model.named_parameters()
+        }
+
+    def _push(self, model, tree: Mapping[str, np.ndarray]) -> None:
+        torch = self._torch
+        with torch.no_grad():
+            for name, p in model.named_parameters():
+                # .copy() both drops the read-only flag of JAX-backed
+                # arrays (from_numpy warns on those) and detaches from the
+                # device buffer.
+                p.copy_(
+                    torch.from_numpy(np.asarray(tree[name]).copy()).to(p.dtype)
+                )
+
+    def _resync(self) -> None:
+        """Re-pull the torch parameters onto the device; the user trains
+        between mixes, so every operation starts from the live models."""
+        self._mixer._stacked = self._mixer.engine.shard(
+            _stack([self._pull(self.models[t]) for t in self._mixer.tokens])
+        )
+
+    # ------------------------------------------------------------------ #
+    def mix(self, times: int = 1, eps: Optional[float] = None) -> int:
+        """Pull current torch parameters, gossip on-device, write back."""
+        self._resync()
+        done = self._mixer.mix(times, eps)
+        mixed = self._mixer.parameters()
+        for tok in self._mixer.tokens:
+            self._push(self.models[tok], mixed[tok])
+        return done
+
+    def get_parameters_deviation(self) -> Dict[Hashable, float]:
+        """Across-agent deviation of the *current* torch parameters
+        (parity: ``mixer.py:78-80``)."""
+        self._resync()
+        return self._mixer.get_parameters_deviation()
+
+    def get_max_parameters_std(self) -> float:
+        """Parity: ``mixer.py:82-84``."""
+        self._resync()
+        return self._mixer.get_max_parameters_std()
+
+
+def _stack(trees):
+    from distributed_learning_tpu.ops import mixing as ops
+
+    return ops.stack_trees(trees)

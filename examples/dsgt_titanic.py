@@ -47,12 +47,14 @@ def main() -> None:
     # global objective the decentralized runs are solving).
     Xall = Xstk.reshape(-1, dim)
     yall = ystk.reshape(-1)
-    w_cent = jnp.zeros((dim,))
-    cent_step = jax.jit(
-        lambda w: w - ALPHA * jax.grad(logreg.loss_fn)(w, Xall, yall, TAU)
-    )
-    for _ in range(STEPS):
-        w_cent = cent_step(w_cent)
+    w_cent = jax.jit(
+        lambda w0: jax.lax.fori_loop(
+            0,
+            STEPS,
+            lambda _, w: w - ALPHA * jax.grad(logreg.loss_fn)(w, Xall, yall, TAU),
+            w0,
+        )
+    )(jnp.zeros((dim,)))
 
     def grad_fn(w, i, step):
         return jax.grad(logreg.loss_fn)(w, Xstk[i], ystk[i], TAU)

@@ -73,3 +73,27 @@ def test_bench_attention_smoke(capsys):
     assert any(r["metric"].endswith("_best") for r in ok)
     for r in lines:
         assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
+
+
+def test_publish_merges_jsonl_into_baseline(tmp_path):
+    import json
+
+    from benchmarks import publish
+
+    cap = tmp_path / "bench.jsonl"
+    cap.write_text(
+        '{"metric": "m1", "value": 3.5, "unit": "x", "vs_baseline": 2.0}\n'
+        '{"metric": "skip_me", "value": null, "unit": "x"}\n'
+        '{"metric": "m2", "publish_key": "m2__tpu", "value": 1, "unit": "y",'
+        ' "platform": "tpu"}\n'
+    )
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"published": {"m1": {"value": 1.0}}}))
+    rc = publish.main([str(cap), "--baseline", str(baseline)])
+    assert rc == 0
+    out = json.loads(baseline.read_text())["published"]
+    assert out["m1"]["value"] == 3.5  # overwritten, latest wins
+    assert out["m1"]["source"] == "bench.jsonl"
+    assert "skip_me" not in out  # null values dropped
+    assert out["m2__tpu"]["value"] == 1
+    assert out["m2__tpu"]["platform"] == "tpu"  # provenance passes through

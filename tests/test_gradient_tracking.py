@@ -179,12 +179,14 @@ def test_dsgt_titanic_nonidd_reaches_centralized_optimum():
     dim = Xstk.shape[-1]
 
     Xall, yall = Xstk.reshape(-1, dim), ystk.reshape(-1)
-    step = jax.jit(
-        lambda w: w - alpha * jax.grad(logreg.loss_fn)(w, Xall, yall, tau)
-    )
-    w_cent = jnp.zeros((dim,))
-    for _ in range(steps):
-        w_cent = step(w_cent)
+    w_cent = jax.jit(
+        lambda w0: jax.lax.fori_loop(
+            0,
+            steps,
+            lambda _, w: w - alpha * jax.grad(logreg.loss_fn)(w, Xall, yall, tau),
+            w0,
+        )
+    )(jnp.zeros((dim,)))
 
     def grad_fn(w, i, s):
         return jax.grad(logreg.loss_fn)(w, Xstk[i], ystk[i], tau)
