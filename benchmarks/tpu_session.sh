@@ -11,7 +11,7 @@
 #      CIFAR at $DLT_CIFAR_DIR) — the long stage, ~30-60 min
 #   4. fold stages 1-3 into BASELINE.json:"published"
 set -uo pipefail
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/.." || exit 1
 OUT="${1:-benchmarks/results}"
 mkdir -p "$OUT"
 STAMP=$(date +%Y%m%d_%H%M%S)

@@ -71,6 +71,8 @@ class TorchModelMixer:
     ):
         self._torch = _require_torch()
         self.models = dict(models)
+        if not self.models:
+            raise ValueError("models must be a non-empty mapping")
         first = next(iter(self.models.values()))
         sig = [(n, tuple(p.shape)) for n, p in first.named_parameters()]
         for tok, m in self.models.items():
@@ -116,8 +118,8 @@ class TorchModelMixer:
     def _resync(self) -> None:
         """Re-pull the torch parameters onto the device; the user trains
         between mixes, so every operation starts from the live models."""
-        self._mixer._stacked = self._mixer.engine.shard(
-            _stack([self._pull(self.models[t]) for t in self._mixer.tokens])
+        self._mixer.set_parameters(
+            {t: self._pull(self.models[t]) for t in self._mixer.tokens}
         )
 
     # ------------------------------------------------------------------ #
@@ -140,9 +142,3 @@ class TorchModelMixer:
         """Parity: ``mixer.py:82-84``."""
         self._resync()
         return self._mixer.get_max_parameters_std()
-
-
-def _stack(trees):
-    from distributed_learning_tpu.ops import mixing as ops
-
-    return ops.stack_trees(trees)

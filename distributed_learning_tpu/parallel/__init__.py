@@ -19,8 +19,18 @@ from distributed_learning_tpu.parallel.gradient_tracking import (
     GradientTrackingEngine,
     TrackingState,
 )
+from distributed_learning_tpu.parallel.compression import (
+    ChocoGossipEngine,
+    top_k,
+    random_k,
+    scaled_sign,
+)
 
 __all__ = [
+    "ChocoGossipEngine",
+    "top_k",
+    "random_k",
+    "scaled_sign",
     "GradientTrackingEngine",
     "TrackingState",
     "Topology",

@@ -1,0 +1,275 @@
+"""Compressed gossip with error feedback (CHOCO-GOSSIP).
+
+Beyond-parity extension.  Every byte the reference moves between agents is
+a full-precision parameter vector (flat numpy over queues,
+``consensus_asyncio.py:279-281``, or pickled tensors over TCP,
+``pickled_socket.py``).  Bandwidth-constrained links want *compressed*
+messages — but naively gossiping compressed values destroys convergence:
+the compression error accumulates and the network stalls at a noise floor
+set by the compressor.
+
+CHOCO-GOSSIP (Koloskova-Stich-Jaggi) fixes this with error feedback.  Each
+agent keeps a *public* estimate ``xhat_i`` that its neighbors also track;
+only the compressed correction ``q_i = C(x_i - xhat_i)`` crosses the wire:
+
+    q_i     = C(x_i - xhat_i)                (the ONLY transmitted bytes)
+    xhat_j <- xhat_j + q_j                   (every holder of the estimate)
+    x_i    <- x_i + gamma * sum_j W_ij (xhat_j - xhat_i)
+
+With any delta-contractive compressor (``||C(v) - v||^2 <= (1-delta)
+||v||^2``: top-k, random-k, scaled sign) the iterates converge **linearly
+to exact consensus** — the estimates chase the iterates, so the
+compression error is driven to zero instead of accumulating.
+
+TPU mapping: the recurrence is two stacked elementwise updates plus one
+mixing product on the estimate stack, so it rides the same fabric as every
+other engine here (dense batched MXU matmuls, or the ppermute matching
+schedule under ``shard_map``).  On-chip the full estimates move through
+the mixing product — the compression *math* is exact, and the wire saving
+is realized where the wire is real: the TCP backend's tensor codec keeps
+only ``k`` values + indices of each correction (the dense estimate never
+crosses a socket), and a future sparse collective-permute would do the
+same over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_learning_tpu.ops import mixing as ops
+from .consensus import ConsensusEngine
+
+Pytree = Any
+# Compressor: (value, key) -> compressed value of the SAME shape (the wire
+# format is the codec's concern; the engine works with densified values).
+Compressor = Callable[[jax.Array, jax.Array], jax.Array]
+
+__all__ = [
+    "top_k",
+    "random_k",
+    "scaled_sign",
+    "identity",
+    "compressor_delta",
+    "ChocoState",
+    "ChocoGossipEngine",
+]
+
+
+# --------------------------------------------------------------------- #
+# delta-contractive compressors                                         #
+# --------------------------------------------------------------------- #
+def top_k(fraction: float) -> Compressor:
+    """Keep the top ``fraction`` of entries by magnitude (delta =
+    fraction for the worst case; much better on real spectra)."""
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+
+    def compress(v: jax.Array, key: jax.Array) -> jax.Array:
+        flat = v.ravel()
+        k = max(1, int(round(fraction * flat.size)))
+        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        out = jnp.zeros_like(flat).at[idx].set(flat[idx])
+        return out.reshape(v.shape)
+
+    return compress
+
+
+def random_k(fraction: float) -> Compressor:
+    """Keep a uniformly random ``fraction`` of entries (delta = fraction
+    in expectation; unbiased up to the 1/fraction scale, used plain here —
+    CHOCO only needs contraction, not unbiasedness)."""
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+
+    def compress(v: jax.Array, key: jax.Array) -> jax.Array:
+        flat = v.ravel()
+        k = max(1, int(round(fraction * flat.size)))
+        idx = jax.random.choice(key, flat.size, (k,), replace=False)
+        out = jnp.zeros_like(flat).at[idx].set(flat[idx])
+        return out.reshape(v.shape)
+
+    return compress
+
+
+def scaled_sign() -> Compressor:
+    """``(||v||_1 / d) * sign(v)`` — 1 bit/entry + one scale; contractive
+    with delta = ||v||_1^2 / (d ||v||_2^2) >= 1/d."""
+
+    def compress(v: jax.Array, key: jax.Array) -> jax.Array:
+        flat = v.ravel()
+        scale = jnp.sum(jnp.abs(flat)) / flat.size
+        return (scale * jnp.sign(flat)).reshape(v.shape)
+
+    return compress
+
+
+def identity() -> Compressor:
+    """No compression (delta = 1): CHOCO then reduces to plain gossip on
+    the estimates — useful as a correctness reference."""
+    return lambda v, key: v
+
+
+def compressor_delta(
+    compress: Compressor, dim: int = 256, trials: int = 50, seed: int = 0
+) -> float:
+    """Empirical contraction factor ``min_v 1 - ||C(v)-v||^2 / ||v||^2``
+    over random gaussian vectors — a measurement aid for picking gamma."""
+    rng = jax.random.key(seed)
+    worst = 1.0
+    for t in range(trials):
+        rng, k1, k2 = jax.random.split(rng, 3)
+        v = jax.random.normal(k1, (dim,))
+        err = v - compress(v, k2)
+        ratio = float(jnp.sum(err * err) / jnp.sum(v * v))
+        worst = min(worst, 1.0 - ratio)
+    return worst
+
+
+# --------------------------------------------------------------------- #
+class ChocoState(NamedTuple):
+    """Stacked CHOCO state: iterates, public estimates, PRNG key."""
+
+    x: Pytree
+    xhat: Pytree
+    key: jax.Array
+
+
+class ChocoGossipEngine:
+    """CHOCO-GOSSIP over a mixing matrix, dense or mesh-sharded.
+
+    Parameters
+    ----------
+    W:
+        (n, n) symmetric row-stochastic mixing matrix.
+    compressor:
+        A delta-contractive compressor (:func:`top_k`, :func:`random_k`,
+        :func:`scaled_sign`, :func:`identity`).
+    gamma:
+        Consensus step size; stability needs roughly
+        ``gamma <= delta / (8 * (1 - lambda_2(W)) + delta)``-ish — in
+        practice ``0.1-0.5`` for top-k fractions >= 0.05.  See
+        :func:`compressor_delta` to measure delta.
+    """
+
+    def __init__(
+        self,
+        W: np.ndarray,
+        compressor: Compressor,
+        *,
+        gamma: float = 0.3,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = "agents",
+    ):
+        self.engine = ConsensusEngine(W, mesh=mesh, axis_name=axis_name)
+        self.n = self.engine.n
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.compressor = compressor
+        self.gamma = float(gamma)
+        self._jit_run: dict = {}
+
+    # ------------------------------------------------------------------ #
+    def _compress_tree(self, delta_tree: Pytree, key: jax.Array) -> Pytree:
+        """Per-agent, per-leaf compression of the correction."""
+        leaves, treedef = jax.tree.flatten(delta_tree)
+        keys = jax.random.split(key, len(leaves))
+        if self.mesh is None:
+            comp = [
+                # Independent key per (leaf, agent): random-k masks must
+                # differ across agents.
+                jax.vmap(self.compressor)(leaf, jax.random.split(k, self.n))
+                for leaf, k in zip(leaves, keys)
+            ]
+        else:
+            # Inside shard_map the leading axis is this device's single
+            # agent; fold its mesh position into the key so agents draw
+            # independent random-k masks.
+            i = jax.lax.axis_index(self.axis_name)
+            comp = [
+                self.compressor(leaf[0], jax.random.fold_in(k, i))[None]
+                for leaf, k in zip(leaves, keys)
+            ]
+        return jax.tree.unflatten(treedef, comp)
+
+    def _mix(self, t: Pytree, self_w, match_w) -> Pytree:
+        if self.mesh is None:
+            return self.engine._dense_mix_once(t)
+        return self.engine._local_mix_once(t, self_w, match_w)
+
+    def _step(self, s: ChocoState, self_w, match_w) -> ChocoState:
+        key, sub = jax.random.split(s.key)
+        q = self._compress_tree(
+            jax.tree.map(lambda a, b: a - b, s.x, s.xhat), sub
+        )
+        xhat = jax.tree.map(lambda h, qv: h + qv, s.xhat, q)
+        mixed_hat = self._mix(xhat, self_w, match_w)
+        x = jax.tree.map(
+            lambda xv, mh, h: xv + self.gamma * (mh - h),
+            s.x, mixed_hat, xhat,
+        )
+        return ChocoState(x=x, xhat=xhat, key=key)
+
+    # ------------------------------------------------------------------ #
+    def init(self, x0: Pytree, *, seed: int = 0) -> ChocoState:
+        """Estimates start at zero — the standard CHOCO initialization."""
+        x = self.engine.shard(x0)
+        xhat = jax.tree.map(jnp.zeros_like, x)
+        return ChocoState(x=x, xhat=xhat, key=jax.random.key(seed))
+
+    def run(self, state: ChocoState, rounds: int) -> Tuple[ChocoState, jax.Array]:
+        """``rounds`` CHOCO iterations in one jitted ``lax.scan``; returns
+        the final state and the per-round consensus-residual trace."""
+        rounds = int(rounds)
+        if rounds not in self._jit_run:
+            def make_body(self_w, match_w):
+                def body(s, _):
+                    s = self._step(s, self_w, match_w)
+                    if self.mesh is None:
+                        res = jnp.max(ops.agent_deviations(s.x))
+                    else:
+                        res = jnp.sqrt(
+                            jax.lax.pmax(
+                                self.engine._local_sq_deviation(s.x),
+                                self.axis_name,
+                            )
+                        )
+                    return s, res
+                return body
+
+            if self.mesh is None:
+                self._jit_run[rounds] = jax.jit(
+                    lambda s: jax.lax.scan(
+                        make_body(None, None), s, None, length=rounds
+                    )
+                )
+            else:
+                spec = P(self.axis_name)
+                st_spec = ChocoState(x=spec, xhat=spec, key=P())
+
+                def f(s, self_w, match_w):
+                    return jax.lax.scan(
+                        make_body(self_w, match_w), s, None, length=rounds
+                    )
+
+                self._jit_run[rounds] = jax.jit(
+                    jax.shard_map(
+                        f,
+                        mesh=self.mesh,
+                        in_specs=(st_spec, spec, P(None, self.axis_name)),
+                        out_specs=(st_spec, P()),
+                        check_vma=False,
+                    )
+                )
+        if self.mesh is None:
+            return self._jit_run[rounds](state)
+        return self._jit_run[rounds](
+            state, self.engine._self_w, self.engine._match_w
+        )
+
+    def max_deviation(self, state: ChocoState) -> float:
+        return float(self.engine.max_deviation(state.x))

@@ -821,15 +821,10 @@ class Mixer:
                     f"expected {W.shape[0]} tokens for a {W.shape} mixing "
                     f"matrix, got {len(self.tokens)}"
                 )
-        missing = [t for t in self.tokens if t not in params]
-        if missing:
-            raise ValueError(f"params missing for agents: {missing}")
         self.engine = ConsensusEngine(W, mesh=mesh)
         self._logger = logger
         self._max_rounds = max_rounds
-        self._stacked = self.engine.shard(
-            ops.stack_trees([params[t] for t in self.tokens])
-        )
+        self.set_parameters(params)
 
     def mix(self, times: int = 1, eps: float | None = None) -> int:
         """Gossip ``times`` rounds; with ``eps`` keep going until the max
@@ -855,6 +850,17 @@ class Mixer:
         """Current per-agent parameter pytrees."""
         trees = ops.unstack_tree(self._stacked, len(self.tokens))
         return dict(zip(self.tokens, trees))
+
+    def set_parameters(self, params: Mapping[Hashable, Pytree]) -> None:
+        """Replace the device-resident state from per-agent pytrees (the
+        single owner of the stack/shard invariant — external adapters like
+        ``interop.TorchModelMixer`` resync through this, not ``_stacked``)."""
+        missing = [t for t in self.tokens if t not in params]
+        if missing:
+            raise ValueError(f"params missing for agents: {missing}")
+        self._stacked = self.engine.shard(
+            ops.stack_trees([params[t] for t in self.tokens])
+        )
 
     def stacked_parameters(self) -> Pytree:
         return self._stacked
