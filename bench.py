@@ -122,59 +122,86 @@ def main():
             "many distinct indices per agent"
         )
 
-    model = WideResNet(
-        depth=depth, widen_factor=widen, dropout_rate=0.3, num_classes=10,
-        dtype=jnp.bfloat16,
-    )
-    tx = optax.chain(
-        optax.add_decayed_weights(5e-4), optax.sgd(0.1, momentum=0.9)
-    )
-    engine = ConsensusEngine(Topology.ring(n_agents).metropolis_weights())
+    def measure(batch: int, pool: int) -> float:
+        model = WideResNet(
+            depth=depth, widen_factor=widen, dropout_rate=0.3, num_classes=10,
+            dtype=jnp.bfloat16,
+        )
+        tx = optax.chain(
+            optax.add_decayed_weights(5e-4), optax.sgd(0.1, momentum=0.9)
+        )
+        engine = ConsensusEngine(Topology.ring(n_agents).metropolis_weights())
 
-    rng = jax.random.key(0)
-    x0 = jnp.ones((batch, 32, 32, 3), jnp.float32)
-    variables = jax.jit(lambda r: model.init(r, x0, train=False))(rng)
-    stack = lambda t: jax.tree.map(
-        lambda v: jnp.broadcast_to(v[None], (n_agents,) + v.shape), t
-    )
-    params = stack(variables["params"])
-    bs = stack(variables["batch_stats"])
-    opt = jax.vmap(tx.init)(params)
-    state = (params, bs, opt, jax.random.key(1))
+        rng = jax.random.key(0)
+        x0 = jnp.ones((batch, 32, 32, 3), jnp.float32)
+        variables = jax.jit(lambda r: model.init(r, x0, train=False))(rng)
+        stack = lambda t: jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (n_agents,) + v.shape), t
+        )
+        params = stack(variables["params"])
+        bs = stack(variables["batch_stats"])
+        opt = jax.vmap(tx.init)(params)
+        state = (params, bs, opt, jax.random.key(1))
 
-    data_rng = np.random.default_rng(0)
-    Xs = jnp.asarray(
-        data_rng.normal(size=(n_agents, pool, 32, 32, 3)).astype(np.float32)
-    )
-    ys = jnp.asarray(
-        data_rng.integers(0, 10, size=(n_agents, pool)).astype(np.int32)
-    )
+        data_rng = np.random.default_rng(0)
+        Xs = jnp.asarray(
+            data_rng.normal(size=(n_agents, pool, 32, 32, 3)).astype(np.float32)
+        )
+        ys = jnp.asarray(
+            data_rng.integers(0, 10, size=(n_agents, pool)).astype(np.int32)
+        )
 
-    def epoch_idx(e):
-        r = np.random.default_rng(e)
-        idx = np.stack(
-            [r.permutation(pool)[: steps * batch] for _ in range(n_agents)]
-        ).astype(np.int32)
-        return jnp.asarray(idx.reshape(n_agents, steps, batch).swapaxes(0, 1))
+        def epoch_idx(e):
+            r = np.random.default_rng(e)
+            idx = np.stack(
+                [r.permutation(pool)[: steps * batch] for _ in range(n_agents)]
+            ).astype(np.int32)
+            return jnp.asarray(idx.reshape(n_agents, steps, batch).swapaxes(0, 1))
 
-    run_epoch = build_epoch(model, tx, engine, n_agents)
-    state, losses = run_epoch(state, Xs, ys, epoch_idx(0))  # compile
-    jax.block_until_ready(losses)
-    state, losses = run_epoch(state, Xs, ys, epoch_idx(1))  # warm
-    jax.block_until_ready(losses)
+        # Sync points are host copies of the (steps, n) losses, NOT
+        # block_until_ready: over a tunneled PJRT backend the latter can
+        # return before execution drains, silently timing only dispatch.
+        run_epoch = build_epoch(model, tx, engine, n_agents)
+        state, losses = run_epoch(state, Xs, ys, epoch_idx(0))  # compile
+        np.asarray(losses)
+        state, losses = run_epoch(state, Xs, ys, epoch_idx(1))  # warm
+        np.asarray(losses)
 
-    t0 = time.perf_counter()
-    for e in range(epochs):
-        state, losses = run_epoch(state, Xs, ys, epoch_idx(2 + e))
-    jax.block_until_ready(losses)
-    elapsed = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            state, losses = run_epoch(state, Xs, ys, epoch_idx(2 + e))
+        np.asarray(losses)
+        elapsed = time.perf_counter() - t0
+        return n_agents * batch * steps * epochs / elapsed
 
-    sps = n_agents * batch * steps * epochs / elapsed
+    # The headline configuration is sized for a 16 GB v5e; if a smaller
+    # chip (or co-tenant memory pressure) OOMs, halve the batch rather
+    # than die — the driver's record should be a measurement, not a crash.
+    while True:
+        try:
+            sps = measure(batch, pool)
+            break
+        except Exception as exc:  # jaxlib XlaRuntimeError, by message
+            if "RESOURCE_EXHAUSTED" not in str(exc) and "Out of memory" not in str(exc):
+                raise
+            if batch // 2 < 32:
+                raise
+            import sys
+
+            print(
+                f"OOM at batch {batch}; retrying with {batch // 2}",
+                file=sys.stderr, flush=True,
+            )
+            batch //= 2
+            pool = steps * batch
+
     result = {
         "metric": f"gossip_sgd_wrn{depth}x{widen}_cifar10_throughput_{platform}",
         "value": round(sps, 2),
         "unit": "samples/sec",
         "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+        "config": f"{n_agents} agents x batch {batch}, bf16, rbg dropout, "
+                  "mix 1/epoch",
     }
     print(json.dumps(result))
 

@@ -26,7 +26,12 @@ from distributed_learning_tpu.comm.agent import (
 from distributed_learning_tpu.comm.framing import FramedStream, FrameError, open_framed_connection
 from distributed_learning_tpu.comm.master import ConsensusMaster
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
-from distributed_learning_tpu.comm.tensor_codec import decode_tensor, encode_tensor
+from distributed_learning_tpu.comm.tensor_codec import (
+    decode_sparse,
+    decode_tensor,
+    encode_sparse,
+    encode_tensor,
+)
 
 __all__ = [
     "AgentStatus",
@@ -40,4 +45,6 @@ __all__ = [
     "open_framed_connection",
     "encode_tensor",
     "decode_tensor",
+    "encode_sparse",
+    "decode_sparse",
 ]

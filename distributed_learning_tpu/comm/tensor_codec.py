@@ -22,7 +22,13 @@ import numpy as np
 
 from distributed_learning_tpu import native
 
-__all__ = ["encode_tensor", "decode_tensor", "FLAG_BF16_COMPRESSED"]
+__all__ = [
+    "encode_tensor",
+    "decode_tensor",
+    "encode_sparse",
+    "decode_sparse",
+    "FLAG_BF16_COMPRESSED",
+]
 
 FLAG_BF16_COMPRESSED = 0x01
 
@@ -37,6 +43,10 @@ _DTYPE_CODES = {
 }
 _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 _MAX_NDIM = 16
+# Densification cap for sparse frames: 2^28 f32 elements = 1 GiB, matching
+# the largest single gossip tensor the backend is sized for (MAX_FRAME in
+# framing.py bounds dense frames the same way).
+_MAX_SPARSE_DENSE_ELEMS = 1 << 28
 
 
 def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
@@ -90,3 +100,76 @@ def decode_tensor(buf: bytes) -> np.ndarray:
     if flags & FLAG_BF16_COMPRESSED:
         x = native.bf16_to_f32(x)
     return x
+
+
+# --------------------------------------------------------------------- #
+# Sparse wire format (compressed-gossip corrections)                    #
+# --------------------------------------------------------------------- #
+def encode_sparse(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
+    """Serialize only the non-zero entries of a (dense) array.
+
+    The wire for CHOCO-style corrections
+    (:mod:`distributed_learning_tpu.parallel.compression`): a top-k
+    compressed correction is dense in memory but k-sparse in content, so
+    the payload is ``shape | u32 indices[k] | values[k]`` — ``O(k)`` bytes
+    instead of ``O(d)``.  Values ride :func:`encode_tensor` (so
+    ``bf16_wire`` composes), indices are flat positions into the C-order
+    ravel.  Per entry the sparse wire costs 4 (index) + 2 (bf16 value)
+    bytes vs 2 dense, so it wins below ~1/3 density (f32: 8 vs 4, below
+    ~1/2) — at CHOCO's typical 1-10% top-k fractions a 3-33x (bf16) /
+    5-50x (f32) byte reduction; measured 6.6x at 5% top-k, bf16.
+    """
+    x = np.asarray(x)
+    flat = x.ravel()  # C-order view (copy when non-contiguous)
+    if flat.size >= np.iinfo(np.uint32).max:
+        raise ValueError(f"sparse wire limited to u32 indices, got {flat.size}")
+    idx = np.flatnonzero(flat).astype(np.uint32)
+    vals = flat[idx]
+    header = struct.pack(f"<BBBB{x.ndim}I", 0xFF, 0, x.ndim, 0, *x.shape)
+    return (
+        header
+        + struct.pack("<I", idx.size)
+        + idx.tobytes()
+        + encode_tensor(vals, bf16_wire=bf16_wire)
+    )
+
+
+def decode_sparse(buf: bytes) -> np.ndarray:
+    """Inverse of :func:`encode_sparse`; returns the densified array."""
+    if len(buf) < 4:
+        raise ValueError("sparse frame too short")
+    magic, _flags, ndim, _ = struct.unpack_from("<BBBB", buf, 0)
+    if magic != 0xFF:
+        raise ValueError(f"not a sparse tensor frame (magic {magic:#x})")
+    if ndim > _MAX_NDIM:
+        raise ValueError(f"ndim {ndim} exceeds wire limit {_MAX_NDIM}")
+    if len(buf) < 4 + 4 * ndim + 4:
+        raise ValueError("sparse frame truncated in header")
+    dims: Tuple[int, ...] = struct.unpack_from(f"<{ndim}I", buf, 4)
+    offset = 4 + 4 * ndim
+    (k,) = struct.unpack_from("<I", buf, offset)
+    offset += 4
+    count = int(np.prod(dims, dtype=np.int64)) if ndim else 1
+    if count > _MAX_SPARSE_DENSE_ELEMS:
+        # The dense target is allocated from the (untrusted) shape header
+        # alone — unlike dense frames, the payload length scales with k,
+        # not count, so a tiny frame could otherwise demand an unbounded
+        # allocation.
+        raise ValueError(
+            f"sparse frame densifies to {count} elements "
+            f"(limit {_MAX_SPARSE_DENSE_ELEMS})"
+        )
+    if k > count:
+        raise ValueError(f"sparse frame claims {k} entries in {count} slots")
+    idx_bytes = buf[offset : offset + 4 * k]
+    if len(idx_bytes) != 4 * k:
+        raise ValueError("sparse frame truncated in indices")
+    idx = np.frombuffer(idx_bytes, dtype=np.uint32)
+    if k and int(idx.max()) >= count:
+        raise ValueError("sparse index out of range")
+    vals = decode_tensor(buf[offset + 4 * k :])
+    if vals.shape != (k,):
+        raise ValueError(f"sparse frame value count {vals.shape} != {k}")
+    out = np.zeros(count, dtype=vals.dtype)
+    out[idx] = vals
+    return out.reshape(dims)

@@ -26,10 +26,10 @@ mixing product on the estimate stack, so it rides the same fabric as every
 other engine here (dense batched MXU matmuls, or the ppermute matching
 schedule under ``shard_map``).  On-chip the full estimates move through
 the mixing product — the compression *math* is exact, and the wire saving
-is realized where the wire is real: the TCP backend's tensor codec keeps
-only ``k`` values + indices of each correction (the dense estimate never
-crosses a socket), and a future sparse collective-permute would do the
-same over ICI/DCN.
+is realized where the wire is real: the TCP backend's tensor codec has a
+sparse encoding (``comm.tensor_codec.encode_sparse``) that ships a top-k
+correction as ``k`` values + indices instead of the dense vector, and a
+sparse collective-permute would be the ICI/DCN analogue.
 """
 
 from __future__ import annotations

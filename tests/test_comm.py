@@ -348,3 +348,73 @@ def test_tcp_dead_peer_raises_instead_of_hanging():
         await agents[0].close()
 
     asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_sparse_codec_roundtrip_and_size():
+    """encode_sparse ships k values + indices, not the dense vector; the
+    wire for CHOCO corrections (parallel/compression.py)."""
+    from distributed_learning_tpu.comm.tensor_codec import (
+        decode_sparse,
+        encode_sparse,
+        encode_tensor,
+    )
+
+    rng = np.random.default_rng(0)
+    dense = np.zeros((64, 32), np.float32)
+    idx = rng.choice(dense.size, 64, replace=False)  # ~3% non-zero
+    dense.ravel()[idx] = rng.normal(size=64).astype(np.float32)
+
+    for bf16 in (False, True):
+        buf = encode_sparse(dense, bf16_wire=bf16)
+        out = decode_sparse(buf)
+        assert out.shape == dense.shape and out.dtype == np.float32
+        if bf16:
+            mask = dense != 0
+            np.testing.assert_allclose(out[mask], dense[mask], rtol=1e-2)
+            assert (out[~mask] == 0).all()
+        else:
+            np.testing.assert_array_equal(out, dense)
+        # The point: an order of magnitude fewer bytes than the dense wire.
+        assert len(buf) * 10 < len(encode_tensor(dense, bf16_wire=bf16))
+
+    # Degenerate shapes survive.
+    for arr in (np.zeros((3, 3), np.float32), np.float32(2.5)):
+        np.testing.assert_array_equal(
+            decode_sparse(encode_sparse(arr)), np.asarray(arr)
+        )
+
+
+def test_sparse_codec_rejects_corrupt_frames():
+    from distributed_learning_tpu.comm.tensor_codec import (
+        decode_sparse,
+        encode_sparse,
+        encode_tensor,
+    )
+
+    good = encode_sparse(np.eye(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="magic"):
+        decode_sparse(encode_tensor(np.zeros(3, np.float32)))
+    with pytest.raises(ValueError):
+        decode_sparse(good[: len(good) // 2])  # truncated
+    # Out-of-range index: corrupt one index byte to a huge value.
+    bad = bytearray(good)
+    # header = 4 + 4*2 dims, then u32 k, then first index u32
+    bad[16:20] = (10**6).to_bytes(4, "little")
+    with pytest.raises(ValueError):
+        decode_sparse(bytes(bad))
+
+
+def test_sparse_codec_bounds_hostile_headers():
+    """Corrupt/hostile frames must raise ValueError, never allocate
+    unbounded memory or leak struct.error."""
+    import struct
+
+    from distributed_learning_tpu.comm.tensor_codec import decode_sparse
+
+    # Huge claimed shape, k=0: must be rejected before densification.
+    huge = struct.pack("<BBBB2I", 0xFF, 0, 2, 0, 1 << 31, 2) + struct.pack("<I", 0)
+    with pytest.raises(ValueError, match="densifies"):
+        decode_sparse(huge + b"\x00\x00\x00\x00")
+    # Truncated inside the dims array / before k: ValueError, not struct.error.
+    with pytest.raises(ValueError, match="truncated"):
+        decode_sparse(b"\xff\x00\x02\x00" + b"\x01\x00\x00\x00")
