@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import enum
-from typing import Any, Dict, Hashable, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 import numpy as np
 
@@ -81,6 +81,7 @@ class ConsensusAgent:
         host: str = "127.0.0.1",
         port: int = 0,
         bf16_wire: bool = False,
+        sparse_wire: bool = False,
         rejoin: bool = False,
         debug: bool = False,
     ):
@@ -88,6 +89,12 @@ class ConsensusAgent:
         self.master_addr = (master_host, master_port)
         self.host, self.port = host, port
         self.bf16_wire = bf16_wire
+        # Sparse wire: value responses ship non-zeros as k values + indices
+        # (tensor_codec.encode_sparse) — for k-sparse payloads such as
+        # CHOCO compressed-gossip corrections (run_choco_once).  Deploy
+        # uniformly: every agent must understand both response kinds (they
+        # do), but only sparse senders realize the byte saving.
+        self.sparse_wire = sparse_wire
         # Rejoin mode (elastic master required): this process replaces a
         # dead agent with the same token.  It initiates connections to ALL
         # its neighbors (the usual smaller-token-accepts rule assumes
@@ -129,6 +136,10 @@ class ConsensusAgent:
         # a later call (the multiplexer uses the same pattern internally).
         self._master_task: Optional[asyncio.Task] = None
         self._mux_task: Optional[asyncio.Task] = None
+        # CHOCO state (run_choco_once): public estimates of self and of
+        # each neighbor, lazily initialized to zeros on first use.
+        self._choco_hat_self: Optional[np.ndarray] = None
+        self._choco_hat_nbrs: Dict[str, np.ndarray] = {}
 
     # ------------------------------------------------------------------ #
     def _debug(self, *args):
@@ -272,23 +283,32 @@ class ConsensusAgent:
         else:
             return  # stale (finished op/iteration): drop
         await self._neighbors[token].send(
-            P.ValueResponse(
-                round_id=req.round_id,
-                iteration=req.iteration,
-                value=value,
-                bf16_wire=self.bf16_wire,
-            )
+            self._make_response(req.round_id, req.iteration, value)
+        )
+
+    def _make_response(self, round_id: int, iteration: int, value):
+        """Pick the wire encoding per message: sparse only when the value
+        is actually below the sparse format's breakeven density (~1/3 with
+        bf16 values, ~1/2 f32 — see ``encode_sparse``); a dense value on a
+        ``sparse_wire`` agent would otherwise cost ~2-3x the dense wire."""
+        if self.sparse_wire and value is not None:
+            breakeven = value.size / (3 if self.bf16_wire else 2)
+            if np.count_nonzero(value) < breakeven:
+                return P.ValueResponseSparse(
+                    round_id=round_id, iteration=iteration, value=value,
+                    bf16_wire=self.bf16_wire,
+                )
+        return P.ValueResponse(
+            round_id=round_id, iteration=iteration, value=value,
+            bf16_wire=self.bf16_wire,
         )
 
     async def _flush_deferred(self) -> None:
         key = (self._op_id, self._iteration)
         for token in self._deferred.pop(key, []):
             await self._neighbors[token].send(
-                P.ValueResponse(
-                    round_id=self._op_id,
-                    iteration=self._iteration,
-                    value=self._iter_value,
-                    bf16_wire=self.bf16_wire,
+                self._make_response(
+                    self._op_id, self._iteration, self._iter_value
                 )
             )
         # Drop stale deferral keys from finished ops/iterations.
@@ -300,6 +320,21 @@ class ConsensusAgent:
         ``y <- (1 - sum_j w_j) y + sum_j w_j y_j`` (parity: run_once's
         update, agent.py:204-207).  Returns None if Done/Shutdown arrived
         mid-iteration (round aborted by the master)."""
+        values = await self._exchange_values(y)
+        if values is None:
+            return None
+        total_w = sum(self._weights.values())
+        out = (1.0 - total_w) * y
+        for token, v in values.items():
+            out = out + self._weights[token] * v
+        return out
+
+    async def _exchange_values(
+        self, y: np.ndarray
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Symmetric per-iteration exchange: publish ``y`` as this
+        iteration's value, collect every neighbor's.  Returns None if a
+        master Done ended the round mid-exchange."""
         self._prev_value = self._iter_value
         self._iter_value = y
         await self._flush_deferred()
@@ -331,7 +366,7 @@ class ConsensusAgent:
                 raise ConnectionError(f"neighbor {token} disconnected mid-gossip")
             if isinstance(msg, P.ValueRequest):
                 await self._answer(token, msg)
-            elif isinstance(msg, P.ValueResponse):
+            elif isinstance(msg, (P.ValueResponse, P.ValueResponseSparse)):
                 if (msg.round_id, msg.iteration) == (
                     self._op_id,
                     self._iteration,
@@ -356,11 +391,7 @@ class ConsensusAgent:
                 self._debug(f"unexpected {msg} mid-round")
         if done_seen:
             return None
-        total_w = sum(self._weights.values())
-        out = (1.0 - total_w) * y
-        for token, v in values.items():
-            out = out + self._weights[token] * v
-        return out
+        return values
 
     @staticmethod
     def _silence(task: asyncio.Task) -> None:
@@ -414,6 +445,70 @@ class ConsensusAgent:
         self._iteration = 0
         out = await self._gossip_iteration(y)
         assert out is not None  # no master Done in masterless mode
+        return out
+
+    async def run_choco_once(
+        self,
+        value: np.ndarray,
+        compressor: Callable[[np.ndarray], np.ndarray],
+        *,
+        gamma: float = 0.3,
+    ) -> np.ndarray:
+        """One CHOCO-GOSSIP iteration over the real wire
+        (``parallel/compression.py`` is the on-device engine; this is the
+        multi-process analogue).  Only the compressed correction
+        ``q = C(x - xhat_self)`` crosses the network — construct the agent
+        with ``sparse_wire=True`` so a top-k correction ships as k values +
+        indices (``tensor_codec.encode_sparse``) instead of the dense
+        vector.  All agents must call it concurrently with the same
+        ``gamma`` and compressor family; estimates persist across calls
+        and start at zero (the standard CHOCO initialization).
+        """
+        if self.status not in (AgentStatus.READY, AgentStatus.IN_ROUND):
+            raise RuntimeError(f"agent not ready (status={self.status})")
+        self._require_neighbors()
+        x = np.asarray(value, dtype=np.float32).ravel()
+        if self._choco_hat_self is None:
+            self._choco_hat_self = np.zeros_like(x)
+        if self._choco_hat_self.shape != x.shape:
+            raise ValueError(
+                f"value shape {x.shape} does not match existing CHOCO "
+                f"estimates {self._choco_hat_self.shape}"
+            )
+        for t in self._neighbors:
+            self._choco_hat_nbrs.setdefault(t, np.zeros_like(x))
+
+        q = np.asarray(compressor(x - self._choco_hat_self), np.float32).ravel()
+        # CRITICAL: every holder of an estimate must apply the SAME bytes.
+        # Neighbors receive q after the wire round-trip (bf16 narrowing,
+        # sparse re-densification); the sender must update its own hat with
+        # that wire-rounded q, not the exact one, or the replicated
+        # estimates permanently diverge and consensus stalls (measured:
+        # 0.167 residual floor with bf16_wire and the exact-q update).
+        from distributed_learning_tpu.comm.tensor_codec import (
+            decode_sparse,
+            decode_tensor,
+            encode_sparse,
+            encode_tensor,
+        )
+
+        if self.sparse_wire:
+            q = decode_sparse(encode_sparse(q, bf16_wire=self.bf16_wire))
+        elif self.bf16_wire:
+            q = decode_tensor(encode_tensor(q, bf16_wire=True))
+        self._op_id += 1
+        self._iteration = 0
+        neighbor_qs = await self._exchange_values(q)
+        assert neighbor_qs is not None  # no master Done in masterless mode
+
+        self._choco_hat_self = self._choco_hat_self + q
+        out = x.copy()
+        for t, qn in neighbor_qs.items():
+            self._choco_hat_nbrs[t] = self._choco_hat_nbrs[t] + qn
+            out += gamma * self._weights[t] * (
+                self._choco_hat_nbrs[t] - self._choco_hat_self
+            )
+        # Self term of sum_j W_ij (xhat_j - xhat_i): j = i contributes 0.
         return out
 
     async def run_round(

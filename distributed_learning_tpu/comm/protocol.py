@@ -40,6 +40,7 @@ __all__ = [
     "Done",
     "Shutdown",
     "Telemetry",
+    "ValueResponseSparse",
     "pack_message",
     "unpack_message",
 ]
@@ -348,12 +349,42 @@ class Telemetry(Message):
         return cls(token=token, payload=json.loads(payload))
 
 
+@dataclasses.dataclass
+class ValueResponseSparse(Message):
+    """Neighbor -> agent: a k-sparse value (e.g. a CHOCO compressed-gossip
+    correction, ``parallel/compression.py``) shipped as k values + indices
+    via :func:`~distributed_learning_tpu.comm.tensor_codec.encode_sparse`
+    instead of the dense vector.  This framework's addition — the
+    reference's wire is always dense pickled numpy
+    (``pickled_socket.py:12``)."""
+
+    TYPE_CODE: ClassVar[int] = 14
+    round_id: int = 0
+    iteration: int = 0
+    value: Optional[np.ndarray] = None
+    bf16_wire: bool = False
+
+    def _pack(self) -> bytes:
+        from distributed_learning_tpu.comm.tensor_codec import encode_sparse
+
+        v = self.value if self.value is not None else np.zeros(0, np.float32)
+        t = encode_sparse(np.asarray(v), bf16_wire=self.bf16_wire)
+        return struct.pack("<qqI", self.round_id, self.iteration, len(t)) + t
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "ValueResponseSparse":
+        from distributed_learning_tpu.comm.tensor_codec import decode_sparse
+
+        r, i, n = struct.unpack_from("<qqI", buf, 0)
+        return cls(round_id=r, iteration=i, value=decode_sparse(buf[20 : 20 + n]))
+
+
 _REGISTRY: Dict[int, Type[Message]] = {
     cls.TYPE_CODE: cls
     for cls in (
         Register, Ok, ErrorException, NeighborhoodData, NewRoundRequest,
         NewRoundNotification, ValueRequest, ValueResponse, Converged,
-        NotConverged, Done, Shutdown, Telemetry,
+        NotConverged, Done, Shutdown, Telemetry, ValueResponseSparse,
     )
 }
 

@@ -121,8 +121,13 @@ def encode_sparse(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
     """
     x = np.asarray(x)
     flat = x.ravel()  # C-order view (copy when non-contiguous)
-    if flat.size >= np.iinfo(np.uint32).max:
-        raise ValueError(f"sparse wire limited to u32 indices, got {flat.size}")
+    if flat.size > _MAX_SPARSE_DENSE_ELEMS:
+        # Mirror decode_sparse's densification cap: failing here is a clear
+        # local error instead of an opaque decode failure on every peer.
+        raise ValueError(
+            f"sparse wire limited to {_MAX_SPARSE_DENSE_ELEMS} dense "
+            f"elements, got {flat.size}"
+        )
     idx = np.flatnonzero(flat).astype(np.uint32)
     vals = flat[idx]
     header = struct.pack(f"<BBBB{x.ndim}I", 0xFF, 0, x.ndim, 0, *x.shape)

@@ -103,6 +103,10 @@ def test_protocol_message_roundtrips():
         P.NewRoundNotification(round_id=7, mean_weight=2.0),
         P.ValueRequest(round_id=7, iteration=3),
         P.ValueResponse(round_id=7, iteration=3, value=np.ones(4, np.float32)),
+        P.ValueResponseSparse(
+            round_id=7, iteration=3,
+            value=np.array([0, 0, 2.5, 0, -1.0, 0], np.float32),
+        ),
         P.Converged(round_id=7, iteration=3),
         P.NotConverged(round_id=7, iteration=3),
         P.Done(round_id=7),
@@ -110,6 +114,9 @@ def test_protocol_message_roundtrips():
         P.Shutdown(reason="bye"),
         P.Telemetry(token="a", payload={"loss": 0.5, "n": 3}),
     ]
+    assert {type(m).TYPE_CODE for m in msgs} == set(P._REGISTRY), (
+        "roundtrip list must cover every registered message type"
+    )
     for msg in msgs:
         code, body = P.pack_message(msg)
         out = P.unpack_message(code, body)
@@ -418,3 +425,56 @@ def test_sparse_codec_bounds_hostile_headers():
     # Truncated inside the dims array / before k: ValueError, not struct.error.
     with pytest.raises(ValueError, match="truncated"):
         decode_sparse(b"\xff\x00\x02\x00" + b"\x01\x00\x00\x00")
+
+
+@pytest.mark.parametrize("bf16", [False, True])
+def test_tcp_choco_rounds_converge_with_sparse_wire(bf16):
+    """Compressed gossip over the real wire: agents exchange top-k sparse
+    corrections (ValueResponseSparse) and still reach exact consensus at
+    the initial mean — CHOCO's error feedback at the comm-backend level
+    (the on-device analogue is parallel/compression.py).  The bf16 case
+    guards the hat-consistency fix: the sender must apply the
+    wire-ROUNDED correction to its own estimate or consensus stalls at a
+    ~1e-1 floor (measured before the fix)."""
+
+    def topk25(v: np.ndarray) -> np.ndarray:
+        k = max(1, v.size // 4)
+        out = np.zeros_like(v)
+        idx = np.argsort(np.abs(v))[-k:]
+        out[idx] = v[idx]
+        return out
+
+    async def main():
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3"), ("3", "1")], ["1", "2", "3"],
+            sparse_wire=True, bf16_wire=bf16,
+        )
+        rng = np.random.default_rng(0)
+        vals = [rng.normal(size=16).astype(np.float32) for _ in range(3)]
+        mean = np.mean(vals, axis=0)
+        xs = list(vals)
+        for _ in range(60):
+            xs = list(await asyncio.gather(
+                *(a.run_choco_once(xs[i], topk25, gamma=0.4)
+                  for i, a in enumerate(agents))
+            ))
+        for x in xs:
+            np.testing.assert_allclose(x, mean, atol=2e-2 if bf16 else 1e-3)
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_tcp_choco_rejects_shape_change():
+    async def main():
+        master, agents = await _deploy([("1", "2")], ["1", "2"],
+                                       sparse_wire=True)
+        ident = lambda v: v
+        await asyncio.gather(
+            *(a.run_choco_once(np.ones(4, np.float32), ident) for a in agents)
+        )
+        with pytest.raises(ValueError, match="shape"):
+            await agents[0].run_choco_once(np.ones(8, np.float32), ident)
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
