@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--time-varying-p", type=float, default=None)
     p.add_argument("--global-avg-every", type=int, default=None,
                    help="Gossip-PGA: exact all-reduce every H-th epoch")
+    p.add_argument("--compression", default=None,
+                   help="CHOCO-SGD compressed gossip: topk:F | randk:F | sign | none (disables, overriding a saved config)")
+    p.add_argument("--compression-gamma", type=float, default=None)
     p.add_argument("--augment", action="store_true",
                    help="jitted RandomCrop+Flip train augmentation")
     p.add_argument("--remat", action="store_true",
@@ -144,6 +147,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         ("mix_eps", args.mix_eps),
         ("time_varying_p", args.time_varying_p),
         ("global_avg_every", args.global_avg_every),
+        ("compression", args.compression),
+        ("compression_gamma", args.compression_gamma),
         ("n_train", args.n_train),
         ("seed", args.seed),
         ("stat_step", args.stat_step),

@@ -56,9 +56,33 @@ __all__ = [
     "scaled_sign",
     "identity",
     "compressor_delta",
+    "compressor_from_spec",
     "ChocoState",
     "ChocoGossipEngine",
 ]
+
+
+def compressor_from_spec(spec: str) -> "Compressor":
+    """Parse a config/CLI compressor spec: ``"topk:0.1"``, ``"randk:0.25"``,
+    ``"sign"``, or ``"none"`` (identity)."""
+    name, _, arg = str(spec).partition(":")
+    name = name.strip().lower()
+    if name in ("none", "identity"):
+        return identity()
+    if name in ("sign", "scaled_sign"):
+        return scaled_sign()
+    if name in ("topk", "top_k", "randk", "random_k"):
+        try:
+            fraction = float(arg) if arg else 0.1
+        except ValueError:
+            raise ValueError(
+                f"bad fraction in compressor spec {spec!r} (want e.g. "
+                f"'{name}:0.1')"
+            ) from None
+        return top_k(fraction) if name in ("topk", "top_k") else random_k(fraction)
+    raise ValueError(
+        f"unknown compressor spec {spec!r} (want topk:F, randk:F, sign, none)"
+    )
 
 
 # --------------------------------------------------------------------- #

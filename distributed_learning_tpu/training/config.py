@@ -83,6 +83,8 @@ class ExperimentConfig:
     chebyshev: bool = False
     time_varying_p: Optional[float] = None  # erdos_renyi edge prob per epoch
     global_avg_every: Optional[int] = None  # Gossip-PGA period (2105.09080)
+    compression: Optional[str] = None  # CHOCO-SGD spec: topk:F | randk:F | sign
+    compression_gamma: float = 0.2
     # misc
     seed: int = 0
     dropout: bool = True
@@ -264,6 +266,8 @@ class ExperimentConfig:
             mix_times=self.mix_times,
             mix_eps=self.mix_eps,
             global_avg_every=self.global_avg_every,
+            compression=self.compression,
+            compression_gamma=self.compression_gamma,
             mesh=mesh,
             telemetry=telemetry,
             seed=self.seed,

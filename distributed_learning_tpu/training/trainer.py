@@ -291,6 +291,8 @@ class GossipTrainer:
         chebyshev: bool = False,
         global_avg_every: Optional[int] = None,
         mix_times_schedule: Optional[Callable[[int], int]] = None,
+        compression: Any = None,
+        compression_gamma: float = 0.2,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         seed: int = 0,
@@ -359,10 +361,51 @@ class GossipTrainer:
             raise ValueError("global_avg_every must be >= 1")
         self.global_avg_every = global_avg_every
         self.mix_times_schedule = mix_times_schedule
+        # CHOCO-SGD (arXiv:1902.00340 via parallel/compression.py): gossip
+        # only compressed corrections between epochs; estimates persist
+        # across the whole run.  Exclusive with the other mixing variants —
+        # the compressed recurrence has its own step size and no eps-stop.
+        self._choco = None
+        self._choco_xhat = None
+        if isinstance(compression, str) and compression.strip().lower() in (
+            "none", "",
+        ):
+            # Trainer-level "none" means DISABLED (the plain dense gossip
+            # path), not CHOCO-with-identity-compressor: the latter would
+            # silently mix gamma-damped (x + gamma*(Wx - x)), ~1/gamma
+            # slower per round than engine.mix.  Lets a CLI/config override
+            # clear a saved compression setting.
+            compression = None
+        if compression is not None:
+            if self.chebyshev or topology_schedule is not None or mix_eps is not None:
+                raise ValueError(
+                    "compression is mutually exclusive with chebyshev, "
+                    "topology_schedule, and mix_eps"
+                )
+            if isinstance(compression, str):
+                from distributed_learning_tpu.parallel.compression import (
+                    compressor_from_spec,
+                )
+
+                compression = compressor_from_spec(compression)
+        self._compression = compression
+        self._compression_gamma = float(compression_gamma)
+
         if weights is None and topology_schedule is not None:
             weights = topology_schedule(0)
         W = resolve_mixing_matrix(weights, self.node_names)
         self.engine = ConsensusEngine(W, mesh=mesh)
+        if self._compression is not None:
+            from distributed_learning_tpu.parallel.compression import (
+                ChocoGossipEngine,
+            )
+
+            self._choco = ChocoGossipEngine(
+                W,
+                self._compression,
+                gamma=self._compression_gamma,
+                mesh=mesh,
+            )
         if (
             self.chebyshev
             and topology_schedule is None
@@ -602,6 +645,7 @@ class GossipTrainer:
             opt_state,
             jax.random.key(self.seed + 1),
         )
+        self._choco_xhat = None  # fresh run: CHOCO estimates restart at 0
         return self
 
     # ------------------------------------------------------------------ #
@@ -686,6 +730,25 @@ class GossipTrainer:
                     params = self.engine.mix_chebyshev_with(params, W_e, omegas)
                 else:
                     params = self.engine.mix_with(params, W_e, times=mix_times)
+            elif self._choco is not None:
+                # CHOCO-SGD: compressed-correction gossip; the public
+                # estimates persist across epochs (reset only by a fresh
+                # initialize_nodes / checkpoint restore — error feedback
+                # re-converges them).
+                from distributed_learning_tpu.parallel.compression import (
+                    ChocoState,
+                )
+
+                if self._choco_xhat is None:
+                    cstate = self._choco.init(params, seed=self.seed + 2)
+                else:
+                    cstate = ChocoState(
+                        x=params, xhat=self._choco_xhat, key=self._choco_key
+                    )
+                cstate, _ = self._choco.run(cstate, mix_times)
+                params = cstate.x
+                self._choco_xhat = cstate.xhat
+                self._choco_key = cstate.key
             elif self.chebyshev:
                 params = self.engine.mix_chebyshev(params, times=mix_times)
             elif self.mix_eps is None:
@@ -803,6 +866,9 @@ class GossipTrainer:
             restored["opt_state"],
             jax.random.wrap_key_data(restored["rng"]),
         )
+        # CHOCO estimates are not checkpointed: they restart at zero and
+        # error feedback re-converges them within a few epochs.
+        self._choco_xhat = None
         self._epochs_done = int(restored["epochs_done"])
         self._global_step = int(restored["global_step"])
 

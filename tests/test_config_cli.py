@@ -165,3 +165,21 @@ def test_cli_train_checkpoint_resume_testonly(tmp_path, capsys):
     assert main(base + ["--testOnly"]) == 0
     out3 = capsys.readouterr().out
     assert "test acc" in out3
+
+
+def test_config_compression_builds_choco_trainer(tmp_path):
+    from distributed_learning_tpu.training.config import ExperimentConfig
+
+    cfg = ExperimentConfig(
+        node_names=[0, 1], dataset="titanic", model="ann",
+        model_args=[2], epoch=1, batch_size=8, n_train=32,
+        compression="topk:0.5", compression_gamma=0.25,
+    )
+    # JSON roundtrip keeps the spec.
+    path = tmp_path / "c.json"
+    cfg.save(path)
+    cfg2 = ExperimentConfig.load(path)
+    assert cfg2.compression == "topk:0.5"
+    trainer = cfg2.build()
+    assert trainer._choco is not None
+    assert trainer._choco.gamma == 0.25

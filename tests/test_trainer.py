@@ -473,3 +473,109 @@ def test_remat_matches_plain_training():
     if ba is not None:
         for la, lb in zip(jax.tree.leaves(ba), jax.tree.leaves(bb)):
             np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5)
+
+
+def test_choco_compressed_mixing_trains_and_converges():
+    """CHOCO-SGD through the trainer: compression='topk:0.3' gossips only
+    compressed corrections between epochs; deviation still shrinks and
+    training matches dense gossip closely."""
+    from distributed_learning_tpu.models import ANNModel
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(0)
+    n, d = 4, 8
+    train = {
+        i: (
+            rng.normal(size=(64, d)).astype(np.float32),
+            rng.integers(0, 3, size=(64,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+    kw = dict(
+        node_names=list(range(n)),
+        model=ANNModel(hidden_dim=8, output_dim=3),
+        optimizer="sgd",
+        learning_rate=0.05,
+        error="cross_entropy",
+        weights=Topology.ring(n),
+        train_data=train,
+        batch_size=16,
+        stat_step=2,
+        epoch=4,
+        dropout=False,
+        seed=0,
+    )
+    dense = GossipTrainer(mix_times=4, **kw)
+    dense.initialize_nodes()
+    dense_out = [dense.train_epoch() for _ in range(4)]
+
+    choco = GossipTrainer(
+        mix_times=4, compression="topk:0.3", compression_gamma=0.3, **kw
+    )
+    choco.initialize_nodes()
+    choco_out = [choco.train_epoch() for _ in range(4)]
+
+    assert all(o["mixed"] for o in choco_out)
+    # Deviation must shrink epoch-over-epoch despite compressed gossip,
+    # and training loss must track the dense run to first-decimal level.
+    assert choco_out[-1]["deviation"] < choco_out[0]["deviation"]
+    dl = float(np.mean(np.asarray(dense_out[-1]["train_loss"])))
+    cl = float(np.mean(np.asarray(choco_out[-1]["train_loss"])))
+    assert abs(dl - cl) < 0.15, (dl, cl)
+    # Estimates persist across epochs (set after the first mixing epoch).
+    assert choco._choco_xhat is not None
+
+
+def test_choco_exclusive_with_other_mixing_modes():
+    from distributed_learning_tpu.models import ANNModel
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(0)
+    train = {
+        i: (
+            rng.normal(size=(16, 4)).astype(np.float32),
+            rng.integers(0, 2, size=(16,)).astype(np.int32),
+        )
+        for i in range(2)
+    }
+    kw = dict(
+        node_names=[0, 1],
+        model=ANNModel(hidden_dim=4, output_dim=2),
+        weights=Topology.ring(2),
+        train_data=train,
+        batch_size=8,
+        dropout=False,
+    )
+    with pytest.raises(ValueError, match="exclusive"):
+        GossipTrainer(compression="sign", chebyshev=True, **kw)
+    with pytest.raises(ValueError, match="exclusive"):
+        GossipTrainer(compression="sign", mix_eps=1e-4, **kw)
+    with pytest.raises(ValueError, match="unknown compressor"):
+        GossipTrainer(compression="nonsense:9", **kw)
+
+
+def test_compression_none_means_dense_gossip():
+    """Trainer-level 'none' disables CHOCO entirely (a CLI override for a
+    saved config) — it must NOT run gamma-damped identity-CHOCO."""
+    from distributed_learning_tpu.models import ANNModel
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(0)
+    train = {
+        i: (
+            rng.normal(size=(16, 4)).astype(np.float32),
+            rng.integers(0, 2, size=(16,)).astype(np.int32),
+        )
+        for i in range(2)
+    }
+    t = GossipTrainer(
+        node_names=[0, 1],
+        model=ANNModel(hidden_dim=4, output_dim=2),
+        weights=Topology.ring(2),
+        train_data=train,
+        batch_size=8,
+        dropout=False,
+        compression="none",
+        chebyshev=True,  # would raise if compression were considered active
+    )
+    assert t._choco is None
