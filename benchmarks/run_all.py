@@ -16,6 +16,7 @@ from benchmarks import (
     bench_cifar_wrn,
     bench_timevarying,
     bench_attention,
+    bench_compression,
 )
 
 CONFIGS = [
@@ -25,6 +26,7 @@ CONFIGS = [
     ("4: CIFAR-10 WRN gossip-SGD (ring)", bench_cifar_wrn.run),
     ("5: CIFAR-100 WRN time-varying + Chebyshev", bench_timevarying.run),
     ("+: flash-attention kernel TFLOP/s (beyond-parity)", bench_attention.run),
+    ("+: compressed gossip rounds/bytes (beyond-parity)", bench_compression.run),
 ]
 
 

@@ -367,9 +367,9 @@ class GossipTrainer:
         # the compressed recurrence has its own step size and no eps-stop.
         self._choco = None
         self._choco_xhat = None
-        if isinstance(compression, str) and compression.strip().lower() in (
-            "none", "",
-        ):
+        if isinstance(compression, str) and compression.partition(":")[
+            0
+        ].strip().lower() in ("none", "identity", ""):
             # Trainer-level "none" means DISABLED (the plain dense gossip
             # path), not CHOCO-with-identity-compressor: the latter would
             # silently mix gamma-damped (x + gamma*(Wx - x)), ~1/gamma
@@ -381,6 +381,13 @@ class GossipTrainer:
                 raise ValueError(
                     "compression is mutually exclusive with chebyshev, "
                     "topology_schedule, and mix_eps"
+                )
+            if mix_times_schedule is not None:
+                raise ValueError(
+                    "compression is mutually exclusive with "
+                    "mix_times_schedule: the CHOCO scan compiles per static "
+                    "round count, so a per-epoch schedule would recompile "
+                    "every epoch"
                 )
             if isinstance(compression, str):
                 from distributed_learning_tpu.parallel.compression import (
@@ -712,6 +719,10 @@ class GossipTrainer:
                 # Gossip-PGA (arXiv:2105.09080): every H-th consensus epoch
                 # is one exact all-reduce, zeroing the consensus residual.
                 params = self.engine.global_average(params)
+                # CHOCO estimates tracked the pre-all-reduce iterates; kept,
+                # they would push the now-identical params apart again next
+                # epoch.  Reset — error feedback re-converges from zero.
+                self._choco_xhat = None
             elif self.topology_schedule is not None:
                 # Time-varying graph: resample, resolve, mix via the
                 # traced-W path (no recompilation per epoch).

@@ -97,3 +97,15 @@ def test_publish_merges_jsonl_into_baseline(tmp_path):
     assert "skip_me" not in out  # null values dropped
     assert out["m2__tpu"]["value"] == 1
     assert out["m2__tpu"]["platform"] == "tpu"  # provenance passes through
+
+
+def test_bench_compression_smoke(capsys):
+    from benchmarks import bench_compression
+
+    bench_compression.run()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert len(lines) == 1  # smoke runs one fraction
+    r = lines[0]
+    assert r["value"] is not None and r["value"] > 0
+    assert r["byte_reduction"] > 3
+    assert r["final_residual"] < 1e-4

@@ -579,3 +579,27 @@ def test_compression_none_means_dense_gossip():
         chebyshev=True,  # would raise if compression were considered active
     )
     assert t._choco is None
+
+
+def test_compression_none_with_arg_still_disables():
+    from distributed_learning_tpu.models import ANNModel
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(0)
+    train = {0: (rng.normal(size=(16, 4)).astype(np.float32),
+                 rng.integers(0, 2, size=(16,)).astype(np.int32)),
+             1: (rng.normal(size=(16, 4)).astype(np.float32),
+                 rng.integers(0, 2, size=(16,)).astype(np.int32))}
+    t = GossipTrainer(
+        node_names=[0, 1], model=ANNModel(hidden_dim=4, output_dim=2),
+        weights=Topology.ring(2), train_data=train, batch_size=8,
+        dropout=False, compression="none:0",
+    )
+    assert t._choco is None
+    with pytest.raises(ValueError, match="mix_times_schedule"):
+        GossipTrainer(
+            node_names=[0, 1], model=ANNModel(hidden_dim=4, output_dim=2),
+            weights=Topology.ring(2), train_data=train, batch_size=8,
+            dropout=False, compression="sign",
+            mix_times_schedule=lambda e: 1 + e,
+        )
