@@ -55,7 +55,7 @@ def run() -> None:
         state = choco.init(x0)
         rounds, chunk = 0, 200
         reached = False
-        res_trace = []
+        last_res = float("inf")
         while rounds < 60_000:
             state, r = choco.run(state, chunk)
             trace = np.asarray(r)
@@ -63,11 +63,11 @@ def run() -> None:
             if below.size:
                 # Exact crossing round inside this chunk.
                 rounds += int(below[0]) + 1
-                res_trace.append(float(trace[below[0]]))
+                last_res = float(trace[below[0]])
                 reached = True
                 break
             rounds += chunk
-            res_trace.append(float(trace[-1]))
+            last_res = float(trace[-1])
         k = max(1, int(round(fraction * dim)))
         sparse_bytes_per_round = 6 * k
         emit({
@@ -91,7 +91,7 @@ def run() -> None:
                 if reached
                 else None
             ),
-            "final_residual": res_trace[-1],
+            "final_residual": last_res,
         })
 
 

@@ -369,13 +369,17 @@ class GossipTrainer:
         self._choco_xhat = None
         if isinstance(compression, str) and compression.partition(":")[
             0
-        ].strip().lower() in ("none", "identity", ""):
+        ].strip().lower() in ("none", "identity") and compression.strip():
             # Trainer-level "none" means DISABLED (the plain dense gossip
             # path), not CHOCO-with-identity-compressor: the latter would
             # silently mix gamma-damped (x + gamma*(Wx - x)), ~1/gamma
             # slower per round than engine.mix.  Lets a CLI/config override
             # clear a saved compression setting.
             compression = None
+        elif isinstance(compression, str) and not compression.strip():
+            raise ValueError(
+                "empty compression spec; use None or 'none' to disable"
+            )
         if compression is not None:
             if self.chebyshev or topology_schedule is not None or mix_eps is not None:
                 raise ValueError(

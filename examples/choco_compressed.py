@@ -1,0 +1,57 @@
+"""Compressed gossip (CHOCO) vs naive compression, end to end.
+
+Beyond-parity demo (``parallel/compression.py``): naive compressed gossip
+— sending top-k of the raw values — stalls at a noise floor; CHOCO's
+error feedback reaches exact consensus on the same per-round byte budget.
+Also shows the trainer-level CHOCO-SGD switch.
+
+Run:  python -m examples.choco_compressed
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_tpu.parallel import (
+    ChocoGossipEngine,
+    Topology,
+    top_k,
+)
+
+N, DIM, ROUNDS, FRACTION = 8, 4096, 400, 0.1
+
+
+def main() -> None:
+    W = Topology.ring(N).metropolis_weights()
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.normal(size=(N, DIM)).astype(np.float32))
+    mean = np.asarray(x0).mean(axis=0)
+
+    # CHOCO: compressed corrections + error feedback.
+    eng = ChocoGossipEngine(W, top_k(FRACTION), gamma=0.2)
+    state, residuals = eng.run(eng.init(x0), ROUNDS)
+    choco_err = float(np.abs(np.asarray(state.x) - mean[None]).max())
+
+    # Naive: gossip the top-k of the values directly (same bytes/round).
+    comp = top_k(FRACTION)
+    Wj = jnp.asarray(W, jnp.float32)
+
+    def naive_body(x, _):
+        cx = jax.vmap(comp, in_axes=(0, None))(x, jax.random.key(0))
+        return x + 0.2 * (Wj @ cx - cx), None
+
+    x_naive, _ = jax.lax.scan(naive_body, x0, None, length=ROUNDS)
+    naive_err = float(np.abs(np.asarray(x_naive) - mean[None]).max())
+
+    k = max(1, int(FRACTION * DIM))
+    print(f"ring-{N}, dim {DIM}, top-k {FRACTION:.0%} "
+          f"({6 * k} B/message sparse vs {2 * DIM} B dense bf16)")
+    print(f"naive compressed gossip error after {ROUNDS} rounds: {naive_err:.2e}  (stalls)")
+    print(f"CHOCO error feedback      error after {ROUNDS} rounds: {choco_err:.2e}")
+    print(f"final consensus residual: {float(residuals[-1]):.2e}")
+
+
+if __name__ == "__main__":
+    main()
