@@ -31,7 +31,32 @@ from distributed_learning_tpu.comm.tensor_codec import (
     decode_tensor,
     encode_sparse,
     encode_tensor,
+    top_k_sparse,
 )
+
+
+def top_k_compressor(fraction: float):
+    """Host-side top-k compressor for :meth:`ConsensusAgent.run_choco_once`
+    (densified k-sparse output).  Selection is numpy introselect
+    (``tensor_codec.top_k_sparse``, 285 ms at n=36M, k=1%).  The dense
+    output is not waste: the CHOCO recurrence updates the full public
+    estimate with q either way, so densification happens exactly once
+    here; only ``encode_sparse``'s flatnonzero re-scan (~1 extra pass)
+    is redundant with the selection."""
+    import numpy as np
+
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+
+    def compress(v: "np.ndarray") -> "np.ndarray":
+        flat = np.asarray(v, np.float32).ravel()
+        k = max(1, int(round(fraction * flat.size)))
+        idx, vals = top_k_sparse(flat, k)
+        out = np.zeros_like(flat)
+        out[idx] = vals
+        return out.reshape(np.shape(v))
+
+    return compress
 
 __all__ = [
     "AgentStatus",
@@ -47,4 +72,5 @@ __all__ = [
     "decode_tensor",
     "encode_sparse",
     "decode_sparse",
+    "top_k_compressor",
 ]

@@ -27,6 +27,7 @@ __all__ = [
     "decode_tensor",
     "encode_sparse",
     "decode_sparse",
+    "top_k_sparse",
     "FLAG_BF16_COMPRESSED",
 ]
 
@@ -178,3 +179,34 @@ def decode_sparse(buf: bytes) -> np.ndarray:
     out = np.zeros(count, dtype=vals.dtype)
     out[idx] = vals
     return out.reshape(dims)
+
+
+def top_k_sparse(v: "np.ndarray", k: int):
+    """Indices (ascending, uint32) and values of the k largest-|v| entries
+    — the host-side selection for sparse-wire corrections.
+
+    Deterministic: magnitude ties at the k-th boundary go to the LOWEST
+    indices; NaN magnitudes count as above-threshold (a NaN-poisoned
+    correction should be loud, not dropped).  Implementation is numpy
+    introselect (``argpartition``) + a threshold sweep; a g++ -O3
+    ``nth_element`` version was measured 2.3x SLOWER at n=36M (numpy's
+    partition is simply better optimized), so unlike bf16/crc32 this op
+    intentionally has no native-codec path.
+    """
+    v = np.ascontiguousarray(v, dtype=np.float32).ravel()
+    k = int(k)
+    if k <= 0 or v.size == 0:
+        return np.empty(0, np.uint32), np.empty(0, np.float32)
+    k = min(k, v.size)
+    mag = np.abs(v)
+    part = np.argpartition(mag, v.size - k)
+    thresh = mag[part[v.size - k]]
+    above = np.flatnonzero((mag > thresh) | np.isnan(mag))
+    if above.size >= k:
+        sel = above[:k]
+    else:
+        ties = np.flatnonzero(mag == thresh)
+        sel = np.concatenate([above, ties[: k - above.size]])
+        sel.sort()
+    sel = sel.astype(np.uint32)
+    return sel, v[sel]

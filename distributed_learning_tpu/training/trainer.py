@@ -369,7 +369,7 @@ class GossipTrainer:
         self._choco_xhat = None
         if isinstance(compression, str) and compression.partition(":")[
             0
-        ].strip().lower() in ("none", "identity") and compression.strip():
+        ].strip().lower() in ("none", "identity"):
             # Trainer-level "none" means DISABLED (the plain dense gossip
             # path), not CHOCO-with-identity-compressor: the latter would
             # silently mix gamma-damped (x + gamma*(Wx - x)), ~1/gamma

@@ -478,3 +478,52 @@ def test_tcp_choco_rejects_shape_change():
         await _teardown(master, agents)
 
     asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_top_k_sparse_deterministic_and_exact():
+    """Deterministic selection: ties to the lowest index, NaN selected,
+    exactly the k largest magnitudes."""
+    from distributed_learning_tpu.comm.tensor_codec import top_k_sparse
+
+    rng = np.random.default_rng(1)
+    v = rng.normal(size=10_000).astype(np.float32)
+    v[17] = v[42] = 3.0  # exact tie crossing the k-th boundary
+    idx, vals = top_k_sparse(v, 100)
+    assert idx.dtype == np.uint32 and len(idx) == 100
+    assert (np.diff(idx.astype(np.int64)) > 0).all()  # ascending, unique
+    np.testing.assert_array_equal(vals, v[idx])
+    kth = np.sort(np.abs(v))[-100]
+    assert (np.abs(vals) >= kth - 1e-12).all()
+
+
+def test_comm_top_k_compressor_roundtrip_choco():
+    """The packaged native compressor drives a 3-agent CHOCO deployment."""
+    from distributed_learning_tpu.comm import top_k_compressor
+
+    comp = top_k_compressor(0.25)
+    v = np.arange(8, dtype=np.float32) - 4.0  # [-4..3]
+    out = comp(v)
+    assert np.count_nonzero(out) == 2  # 25% of 8
+    # |v| ranking: 4.0 at idx 0, then a 3.0 tie between idx 1 (-3) and
+    # idx 7 (+3) — documented tie-break keeps the LOWER index.
+    np.testing.assert_array_equal(out[[0, 1]], v[[0, 1]])
+
+    async def main():
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3"), ("3", "1")], ["1", "2", "3"],
+            sparse_wire=True,
+        )
+        rng = np.random.default_rng(0)
+        vals = [rng.normal(size=64).astype(np.float32) for _ in range(3)]
+        mean = np.mean(vals, axis=0)
+        xs = list(vals)
+        for _ in range(80):
+            xs = list(await asyncio.gather(
+                *(a.run_choco_once(xs[i], comp, gamma=0.3)
+                  for i, a in enumerate(agents))
+            ))
+        for x in xs:
+            np.testing.assert_allclose(x, mean, atol=5e-3)
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 120))
