@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import emit, full_scale, platform, smoke
+from benchmarks.common import emit, full_scale, platform, smoke, sync
 
 V5E_BF16_PEAK_TFLOPS = 197.0
 
@@ -40,13 +40,13 @@ def _measure(
         interpret=interpret,
     )
     out = fn()
-    jax.block_until_ready(out)  # compile
+    sync(out)  # compile
     out = fn()
-    jax.block_until_ready(out)  # warm
+    sync(out)  # warm
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn()
-    jax.block_until_ready(out)
+    sync(out)
     dt = (time.perf_counter() - t0) / iters
     flops = 4 * B * H * T * T * D / 2  # causal
     return flops / dt / 1e12, dt

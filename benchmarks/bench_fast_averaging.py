@@ -44,14 +44,14 @@ def run(n_agents: int = 8, dim: int | None = None, eps: float = 1e-4):
         engine = ConsensusEngine(W, mesh=m)
         xs = engine.shard(x)
         out, t_rounds, res = engine.mix_until(xs, eps=eps, max_rounds=5000)
-        jax.block_until_ready(jax.tree.leaves(out)[0])
+        common.sync(out)
         rounds = int(t_rounds)
         # Timed fixed-rounds run (pure gossip, no residual checks).
         warm = engine.mix(xs, times=2)
-        jax.block_until_ready(jax.tree.leaves(warm)[0])
+        common.sync(warm)
         with common.stopwatch() as t:
             out2 = engine.mix(xs, times=rounds)
-            jax.block_until_ready(jax.tree.leaves(out2)[0])
+            common.sync(out2)
         rps = rounds / t["s"]
         common.emit(
             {

@@ -63,10 +63,10 @@ def run(n_agents: int = 4, iters: int | None = None, mix_eps: float = 1e-9):
 
     w0 = engine.shard(jnp.zeros((n_agents, Xs.shape[-1])))
     w = run_loop(w0, 2)  # compile + warm
-    jax.block_until_ready(w)
+    common.sync(w)
     with common.stopwatch() as t:
         w = run_loop(w0, iters)
-        jax.block_until_ready(w)
+        common.sync(w)
 
     accs = [
         float(logreg_accuracy(w[a], jnp.asarray(X_te), jnp.asarray(y_te, jnp.float32)))

@@ -32,6 +32,7 @@ __all__ = [
     "smoke",
     "emit",
     "stopwatch",
+    "sync",
     "agent_mesh_or_none",
 ]
 
@@ -65,6 +66,23 @@ def emit(record: Dict[str, Any]) -> Dict[str, Any]:
         with open(out, "a") as f:
             f.write(line + "\n")
     return record
+
+
+def sync(x) -> None:
+    """Drain the device pipeline by host-copying one element of ``x``.
+
+    The timing sync for every benchmark: ``jax.block_until_ready`` can
+    return before execution drains on tunneled PJRT backends (measured on
+    the axon-tunneled v5e: a 17 TFLOP step "completed" in 0.6 ms), which
+    would silently time dispatch instead of execution.  A device->host
+    copy cannot complete until the producing computation has.
+    """
+    for leaf in jax.tree.leaves(x):
+        # Every leaf: independent dispatches would otherwise still be in
+        # flight after the first leaf's copy lands.
+        np.asarray(
+            jax.device_get(leaf.ravel()[:1] if hasattr(leaf, "ravel") else leaf)
+        )
 
 
 @contextlib.contextmanager

@@ -487,13 +487,22 @@ def test_top_k_sparse_deterministic_and_exact():
 
     rng = np.random.default_rng(1)
     v = rng.normal(size=10_000).astype(np.float32)
-    v[17] = v[42] = 3.0  # exact tie crossing the k-th boundary
     idx, vals = top_k_sparse(v, 100)
     assert idx.dtype == np.uint32 and len(idx) == 100
     assert (np.diff(idx.astype(np.int64)) > 0).all()  # ascending, unique
     np.testing.assert_array_equal(vals, v[idx])
     kth = np.sort(np.abs(v))[-100]
     assert (np.abs(vals) >= kth - 1e-12).all()
+
+    # Tie AT the k-th boundary: 3 entries share the threshold magnitude
+    # but only 2 slots remain after the strictly-greater entries — the
+    # LOWEST indices must win (documented contract).
+    w = np.zeros(64, np.float32)
+    w[[3, 9]] = [5.0, -4.0]          # strictly above
+    w[[30, 10, 50]] = [2.0, -2.0, 2.0]  # 3-way boundary tie, 2 slots
+    idx, vals = top_k_sparse(w, 4)
+    np.testing.assert_array_equal(idx, [3, 9, 10, 30])
+    np.testing.assert_array_equal(vals, w[[3, 9, 10, 30]])
 
 
 def test_comm_top_k_compressor_roundtrip_choco():
