@@ -101,6 +101,11 @@ class ConsensusAgent:
         # everyone handshakes at once); its first collective op must be a
         # master round (round tags re-align it with the survivors).
         self.rejoin = bool(rejoin)
+        # A rejoiner's local op counter starts fresh while survivors' are
+        # far ahead; until a master round re-derives the shared tag, any
+        # MASTERLESS collective would deadlock (its requests look stale to
+        # everyone).  Tracked so those calls fail loudly instead.
+        self._tag_realigned = not self.rejoin
         self.debug = debug
         self.status = AgentStatus.NEW
 
@@ -140,6 +145,7 @@ class ConsensusAgent:
         # each neighbor, lazily initialized to zeros on first use.
         self._choco_hat_self: Optional[np.ndarray] = None
         self._choco_hat_nbrs: Dict[str, np.ndarray] = {}
+        self._choco_invalidated_by: Optional[str] = None
 
     # ------------------------------------------------------------------ #
     def _debug(self, *args):
@@ -259,6 +265,15 @@ class ConsensusAgent:
             # watching it under the same token.
             self._mux.remove(token)
             old.close()
+        if self._choco_hat_self is not None:
+            # CHOCO estimates are REPLICATED state (every holder of
+            # x̂_j applies identical corrections).  A replacement process
+            # starts with zero estimates while ours are non-zero, so the
+            # copies have permanently diverged — run_choco_once must not
+            # continue silently.  Flag it; the caller resets via
+            # reset_choco() on every agent (a coordinated restart of the
+            # compressed stream; plain run_once/run_round are unaffected).
+            self._choco_invalidated_by = token
         self._neighbors[token] = stream
         self._mux.add(token, stream)
 
@@ -363,6 +378,12 @@ class ConsensusAgent:
                 # and fail the current op loudly rather than wait forever —
                 # recovery happens between rounds, not inside one.
                 self._neighbors.pop(token, None)
+                if self._choco_hat_self is not None:
+                    # Replicated estimates may now differ across survivors
+                    # (some applied this round's corrections before the
+                    # death surfaced, some did not): the compressed stream
+                    # must not continue without a coordinated reset.
+                    self._choco_invalidated_by = token
                 raise ConnectionError(f"neighbor {token} disconnected mid-gossip")
             if isinstance(msg, P.ValueRequest):
                 await self._answer(token, msg)
@@ -430,12 +451,21 @@ class ConsensusAgent:
         return msg
 
     # ------------------------------------------------------------------ #
+    def _require_realigned(self) -> None:
+        if not self._tag_realigned:
+            raise RuntimeError(
+                "rejoined agent must complete one master run_round before "
+                "masterless collectives (its gossip tags re-align through "
+                "the broadcast round id); calling now would deadlock"
+            )
+
     async def run_once(self, value: np.ndarray) -> np.ndarray:
         """One masterless gossip iteration (parity: ``run_once``,
         agent.py:158-212).  All agents must call it concurrently."""
         if self.status not in (AgentStatus.READY, AgentStatus.IN_ROUND):
             raise RuntimeError(f"agent not ready (status={self.status})")
         self._require_neighbors()
+        self._require_realigned()
         y = np.asarray(value, dtype=np.float32).ravel()
         # New collective op: op ids advance identically on every agent
         # (collective calls happen in the same order everywhere), which
@@ -463,10 +493,25 @@ class ConsensusAgent:
         vector.  All agents must call it concurrently with the same
         ``gamma`` and compressor family; estimates persist across calls
         and start at zero (the standard CHOCO initialization).
+
+        Elastic deployments: an agent rejoin invalidates the replicated
+        estimates (the replacement starts at zero; survivors' copies do
+        not) — the next call raises, and recovery is ``reset_choco()`` on
+        every agent followed by one master ``run_round`` (tag re-align),
+        then the compressed stream resumes.
         """
         if self.status not in (AgentStatus.READY, AgentStatus.IN_ROUND):
             raise RuntimeError(f"agent not ready (status={self.status})")
         self._require_neighbors()
+        self._require_realigned()
+        if self._choco_invalidated_by is not None:
+            raise RuntimeError(
+                f"CHOCO estimates invalidated: neighbor "
+                f"{self._choco_invalidated_by!r} reconnected with fresh "
+                "(zero) estimates while ours are non-zero — the replicated "
+                "copies have diverged.  Call reset_choco() on EVERY agent "
+                "(same collective position), then rerun."
+            )
         x = np.asarray(value, dtype=np.float32).ravel()
         if self._choco_hat_self is None:
             self._choco_hat_self = np.zeros_like(x)
@@ -511,6 +556,17 @@ class ConsensusAgent:
         # Self term of sum_j W_ij (xhat_j - xhat_i): j = i contributes 0.
         return out
 
+    def reset_choco(self) -> None:
+        """Restart the compressed-gossip stream: drop all public estimates.
+
+        Must run on EVERY agent at the same collective position (e.g.
+        after an elastic rejoin, before the next ``run_choco_once``) — the
+        estimates are replicated state, so a one-sided reset would itself
+        diverge the copies.  Error feedback re-converges from zero."""
+        self._choco_hat_self = None
+        self._choco_hat_nbrs.clear()
+        self._choco_invalidated_by = None
+
     async def run_round(
         self,
         value: np.ndarray,
@@ -543,6 +599,7 @@ class ConsensusAgent:
             # just rejoined with fresh local state — lands on the same tag
             # regardless of how many run_once calls it has or hasn't seen.
             self._op_id = msg.round_id * _OPS_PER_ROUND
+            self._tag_realigned = True
             self._iteration = -1
             # Weighted lift: y = x * w / mean(w) (consensus_asyncio.py:231).
             y = np.asarray(value, dtype=np.float32).ravel() * (

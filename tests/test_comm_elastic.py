@@ -274,3 +274,122 @@ def test_non_elastic_master_still_fails_loudly():
         await master.shutdown()
 
     asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_choco_invalidated_by_rejoin_then_coordinated_reset():
+    """CHOCO estimates are replicated state; a rejoined neighbor starts at
+    zero while survivors' copies are non-zero.  The next run_choco_once
+    must fail LOUDLY (silent continuation would converge to the wrong
+    point), and a coordinated reset_choco() on every agent restarts the
+    compressed stream cleanly."""
+
+    def topk50(v):
+        k = max(1, v.size // 2)
+        out = np.zeros_like(v)
+        idx = np.argsort(np.abs(v))[-k:]
+        out[idx] = v[idx]
+        return out
+
+    async def main():
+        master, agents = await _deploy_elastic()
+        host, port = master.address
+        rng = np.random.default_rng(0)
+        vals = {t: rng.normal(size=8).astype(np.float32) for t in "ABC"}
+        xs = dict(vals)
+        for _ in range(5):
+            outs = await asyncio.gather(
+                *(a.run_choco_once(xs[t], topk50, gamma=0.4)
+                  for t, a in agents.items())
+            )
+            xs = dict(zip(agents, outs))
+
+        # B dies and a replacement rejoins.
+        await agents["B"].close()
+        await asyncio.sleep(0.05)
+        b2 = ConsensusAgent("B", host, port, rejoin=True)
+        await b2.start()
+        agents["B"] = b2
+        await agents["A"].wait_neighbors(timeout=20.0)
+        await agents["C"].wait_neighbors(timeout=20.0)
+
+        # Survivors must refuse to continue the compressed stream.
+        with pytest.raises(RuntimeError, match="invalidated"):
+            await agents["A"].run_choco_once(xs["A"], topk50, gamma=0.4)
+
+        # Coordinated restart: reset everywhere.  A rejoiner's first
+        # collective op must be a MASTER round (its gossip tags re-align
+        # through the broadcast round id); after that, the compressed
+        # stream resumes and stays at the consensus point.
+        for a in agents.values():
+            a.reset_choco()
+        mean = np.mean([xs[t] for t in "ABC"], axis=0)
+        outs = await asyncio.gather(
+            *(a.run_round(xs[t], 1.0) for t, a in agents.items())
+        )
+        xs = dict(zip(agents, outs))
+        for t in "ABC":
+            np.testing.assert_allclose(xs[t], mean, atol=1e-3)
+        for _ in range(10):
+            outs = await asyncio.gather(
+                *(a.run_choco_once(xs[t], topk50, gamma=0.4)
+                  for t, a in agents.items())
+            )
+            xs = dict(zip(agents, outs))
+        for t in "ABC":
+            np.testing.assert_allclose(xs[t], mean, atol=1e-3)
+
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_rejoiner_masterless_collective_fails_loudly_until_realigned():
+    """A fresh rejoiner's op tags are behind the survivors'; a masterless
+    run_once/run_choco_once would deadlock — it must raise instead, and
+    work again after one master round re-aligns the tags."""
+
+    async def main():
+        master, agents = await _deploy_elastic()
+        host, port = master.address
+        vals = {t: np.full(2, float(i), np.float32)
+                for i, t in enumerate("ABC")}
+        await asyncio.gather(
+            *(a.run_round(vals[t], 1.0) for t, a in agents.items())
+        )
+        await agents["B"].close()
+        await asyncio.sleep(0.05)
+        b2 = ConsensusAgent("B", host, port, rejoin=True)
+        await b2.start()
+        agents["B"] = b2
+
+        with pytest.raises(RuntimeError, match="re-align"):
+            await b2.run_once(vals["B"])
+        with pytest.raises(RuntimeError, match="re-align"):
+            await b2.run_choco_once(vals["B"], lambda v: v)
+
+        async def heal_round(token, agent):
+            for _ in range(3):
+                try:
+                    return await agent.run_round(vals[token], 1.0)
+                except ConnectionError:
+                    await agent.wait_neighbors(timeout=20.0)
+            raise AssertionError(f"{token} could not complete the round")
+
+        outs = await asyncio.gather(
+            *(heal_round(t, a) for t, a in agents.items())
+        )
+        for out in outs:
+            np.testing.assert_allclose(out, [1.0, 1.0], atol=1e-3)
+        # Tags re-aligned: masterless collectives work again.
+        outs2 = await asyncio.gather(
+            *(a.run_once(vals[t]) for t, a in agents.items())
+        )
+        assert all(np.isfinite(o).all() for o in outs2)
+
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 90))
