@@ -106,6 +106,8 @@ class ConsensusAgent:
         # MASTERLESS collective would deadlock (its requests look stale to
         # everyone).  Tracked so those calls fail loudly instead.
         self._tag_realigned = not self.rejoin
+        self._ever_connected: set = set()
+        self._in_master_round = False
         self.debug = debug
         self.status = AgentStatus.NEW
 
@@ -274,6 +276,15 @@ class ConsensusAgent:
             # reset_choco() on every agent (a coordinated restart of the
             # compressed stream; plain run_once/run_round are unaffected).
             self._choco_invalidated_by = token
+        if token in self._ever_connected:
+            # The replacement's op counter is behind ours: a masterless
+            # collective would deadlock on both sides (its requests look
+            # stale to us, ours look future to it and get dropped when its
+            # first master round jumps the tag).  Suspend masterless ops
+            # until a master round re-aligns everyone — symmetric to the
+            # rejoiner's own guard.
+            self._tag_realigned = False
+        self._ever_connected.add(token)
         self._neighbors[token] = stream
         self._mux.add(token, stream)
 
@@ -370,20 +381,27 @@ class ConsensusAgent:
                 # iteration's request on the fresh stream and keep going.
                 cur = self._neighbors.get(token)
                 if cur is not None and cur is not src:
-                    if token not in values:
-                        await cur.send(req)
-                    continue
+                    if self._in_master_round:
+                        # Round tags re-derive from the master broadcast,
+                        # so the replacement WILL reach this tag: resend.
+                        if token not in values:
+                            await cur.send(req)
+                        continue
+                    # Masterless op: the replacement cannot reach this tag
+                    # until a master round (which cannot happen while we
+                    # block here) — fail loudly, keep the live stream.
+                    raise ConnectionError(
+                        f"neighbor {token} was replaced mid-op; run a "
+                        "master run_round to re-align, then retry"
+                    )
                 # Genuine death: drop the corpse (a rejoined replacement
                 # re-registers through _handle_peer; see wait_neighbors)
                 # and fail the current op loudly rather than wait forever —
                 # recovery happens between rounds, not inside one.
+                # (CHOCO note: no invalidation needed here — the only
+                # path back into run_choco_once is via the replacement
+                # dialing in, and _add_neighbor flags it then.)
                 self._neighbors.pop(token, None)
-                if self._choco_hat_self is not None:
-                    # Replicated estimates may now differ across survivors
-                    # (some applied this round's corrections before the
-                    # death surfaced, some did not): the compressed stream
-                    # must not continue without a coordinated reset.
-                    self._choco_invalidated_by = token
                 raise ConnectionError(f"neighbor {token} disconnected mid-gossip")
             if isinstance(msg, P.ValueRequest):
                 await self._answer(token, msg)
@@ -454,9 +472,10 @@ class ConsensusAgent:
     def _require_realigned(self) -> None:
         if not self._tag_realigned:
             raise RuntimeError(
-                "rejoined agent must complete one master run_round before "
-                "masterless collectives (its gossip tags re-align through "
-                "the broadcast round id); calling now would deadlock"
+                "gossip tags are not aligned (this agent rejoined, or a "
+                "neighbor reconnected with fresh state): one master "
+                "run_round re-aligns every agent; a masterless collective "
+                "now would deadlock"
             )
 
     async def run_once(self, value: np.ndarray) -> np.ndarray:
@@ -600,6 +619,7 @@ class ConsensusAgent:
             # regardless of how many run_once calls it has or hasn't seen.
             self._op_id = msg.round_id * _OPS_PER_ROUND
             self._tag_realigned = True
+            self._in_master_round = True
             self._iteration = -1
             # Weighted lift: y = x * w / mean(w) (consensus_asyncio.py:231).
             y = np.asarray(value, dtype=np.float32).ravel() * (
@@ -622,6 +642,7 @@ class ConsensusAgent:
                 )
             return y
         finally:
+            self._in_master_round = False
             if self.status is not AgentStatus.SHUTDOWN:
                 self.status = AgentStatus.READY
 

@@ -312,20 +312,25 @@ def test_choco_invalidated_by_rejoin_then_coordinated_reset():
         await agents["A"].wait_neighbors(timeout=20.0)
         await agents["C"].wait_neighbors(timeout=20.0)
 
-        # Survivors must refuse to continue the compressed stream.
-        with pytest.raises(RuntimeError, match="invalidated"):
+        # Survivors must refuse to continue the compressed stream (the
+        # tag-alignment guard trips first; estimate invalidation backs it
+        # up if a master round runs without reset_choco).
+        with pytest.raises(RuntimeError, match="re-align|invalidated"):
             await agents["A"].run_choco_once(xs["A"], topk50, gamma=0.4)
 
-        # Coordinated restart: reset everywhere.  A rejoiner's first
-        # collective op must be a MASTER round (its gossip tags re-align
-        # through the broadcast round id); after that, the compressed
-        # stream resumes and stays at the consensus point.
-        for a in agents.values():
-            a.reset_choco()
+        # A master round re-aligns the TAGS but the estimates are still
+        # stale: the second guard layer must now surface the invalidation
+        # specifically, prescribing reset_choco().
         mean = np.mean([xs[t] for t in "ABC"], axis=0)
         outs = await asyncio.gather(
             *(a.run_round(xs[t], 1.0) for t, a in agents.items())
         )
+        with pytest.raises(RuntimeError, match="invalidated"):
+            await agents["A"].run_choco_once(outs[0], topk50, gamma=0.4)
+        # Coordinated restart: reset everywhere; the compressed stream
+        # then resumes and stays at the consensus point.
+        for a in agents.values():
+            a.reset_choco()
         xs = dict(zip(agents, outs))
         for t in "ABC":
             np.testing.assert_allclose(xs[t], mean, atol=1e-3)

@@ -1,0 +1,146 @@
+"""Cross-engine invariant matrix.
+
+The reference's consensus math rests on two invariants
+(``wiki/consensus_basics.ipynb`` cells 1-4): symmetric row-stochastic
+mixing PRESERVES the network mean at every round, and CONTRACTS the
+disagreement toward zero on connected graphs.  Every engine in this
+framework implements some variant of that recurrence; this module asserts
+both invariants uniformly across the whole algorithm zoo on randomized
+connected graphs — the distilled spec each new engine must continue to
+satisfy.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.parallel import (
+    ChocoGossipEngine,
+    PushSumEngine,
+    Topology,
+    push_sum_matrix,
+    scaled_sign,
+    top_k,
+)
+from distributed_learning_tpu.parallel.consensus import (
+    ConsensusEngine,
+    make_agent_mesh,
+)
+N, DIM = 8, 24
+
+
+def _graph(seed: int) -> Topology:
+    """Random connected graph (retry until connected)."""
+    for s in range(seed, seed + 50):
+        t = Topology.erdos_renyi(N, 0.35, seed=s)
+        if t.connected():
+            return t
+    raise AssertionError("no connected sample")
+
+
+def _x0(seed: int = 0) -> jnp.ndarray:
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=(N, DIM)).astype(np.float32)
+    )
+
+
+def _mean_gap(x) -> float:
+    x = np.asarray(x, np.float64)
+    return float(np.abs(x.mean(axis=0) - np.asarray(_x0()).mean(axis=0)).max())
+
+
+def _spread(x) -> float:
+    x = np.asarray(x, np.float64)
+    return float(np.abs(x - x.mean(axis=0, keepdims=True)).max())
+
+
+@pytest.mark.parametrize("seed", [11, 29])
+@pytest.mark.parametrize(
+    "runner",
+    [
+        "gossip_dense",
+        "gossip_sharded",
+        "chebyshev",
+        "time_varying",
+        "pushsum",
+        "choco_topk",
+        "choco_sign",
+    ],
+)
+def test_mean_preserved_and_spread_contracts(runner, seed):
+    topo = _graph(seed)
+    W = topo.metropolis_weights()
+    x0 = _x0()
+    spread0 = _spread(x0)
+
+    if runner == "gossip_dense":
+        out = ConsensusEngine(W).mix(x0, times=40)
+    elif runner == "gossip_sharded":
+        eng = ConsensusEngine(W, mesh=make_agent_mesh(N))
+        out = eng.mix(eng.shard(x0), times=40)
+    elif runner == "chebyshev":
+        out = ConsensusEngine(W).mix_chebyshev(x0, times=15)
+    elif runner == "time_varying":
+        eng = ConsensusEngine(W)
+        out = x0
+        for e in range(12):
+            W_e = _graph(seed + 100 + e).metropolis_weights()
+            out = eng.mix_with(out, W_e, times=1)
+    elif runner == "pushsum":
+        # Directed cycle: column-stochastic, preserves totals; the
+        # ratio readout converges to the uniform mean.
+        P = push_sum_matrix([(i, (i + 1) % N) for i in range(N)], N)
+        eng = PushSumEngine(P)
+        out, _, _ = eng.mix_until(x0, eps=1e-7, max_rounds=3000)
+    elif runner == "choco_topk":
+        eng = ChocoGossipEngine(W, top_k(0.25), gamma=0.3)
+        state, _ = eng.run(eng.init(x0), 300)
+        out = state.x
+    elif runner == "choco_sign":
+        eng = ChocoGossipEngine(W, scaled_sign(), gamma=0.2)
+        state, _ = eng.run(eng.init(x0), 300)
+        out = state.x
+
+    assert _mean_gap(out) < 5e-4, f"{runner}: mean not preserved"
+    assert _spread(out) < spread0 / 20, (
+        f"{runner}: spread {_spread(out)} vs initial {spread0}"
+    )
+
+
+def test_dsgt_invariant_on_random_graph():
+    """DSGT's tracking invariant sum(y) == sum(g) on a random graph, plus
+    consensus contraction of x (optimality is covered in its own suite)."""
+    from distributed_learning_tpu.parallel import GradientTrackingEngine
+
+    topo = _graph(47)
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.normal(size=(N, DIM, DIM)).astype(np.float32))
+    A = jnp.einsum("nij,nkj->nik", A, A) + 2.0 * jnp.eye(DIM)[None]
+    b = jnp.asarray(rng.normal(size=(N, DIM)).astype(np.float32))
+    eng = GradientTrackingEngine(
+        topo.metropolis_weights(),
+        lambda x, i, s: A[i] @ x - b[i],
+        learning_rate=2e-3,
+    )
+    state = eng.init(_x0())
+    state, res = eng.run(state, 800)
+    assert eng.tracker_sum_gap(state) < 1e-2
+    assert float(res[-1]) < float(res[0]) / 20
+
+
+def test_weighted_round_fixed_point_random_graph():
+    """run_round semantics: the weighted mean is the fixed point on a
+    random graph (reference: consensus_basics cells 2-3)."""
+    topo = _graph(83)
+    eng = ConsensusEngine(topo.metropolis_weights())
+    x0 = _x0(5)
+    w = jnp.asarray(np.random.default_rng(7).uniform(1, 5, size=N), jnp.float32)
+    out = eng.run_round(x0, w, convergence_eps=1e-7, max_rounds=5000)
+    expect = np.average(
+        np.asarray(x0, np.float64), axis=0, weights=np.asarray(w, np.float64)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float64),
+        np.tile(expect, (N, 1)),
+        atol=1e-4,
+    )
