@@ -10,6 +10,7 @@ connected graphs — the distilled spec each new engine must continue to
 satisfy.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -144,3 +145,33 @@ def test_weighted_round_fixed_point_random_graph():
         np.tile(expect, (N, 1)),
         atol=1e-4,
     )
+
+
+def test_pairwise_gossip_preserves_mean_and_contracts():
+    """Randomized pairwise gossip (the asynchronous-gossip model of
+    Boyd et al. 2006): exact mean preservation every round, spread
+    contraction over enough rounds, and the mesh restriction is loud."""
+    topo = _graph(61)
+    eng = ConsensusEngine(topo.metropolis_weights())
+    x0 = _x0(9)
+    out = eng.mix_pairwise(x0, jax.random.key(0), rounds=400)
+    assert _spread(out) < _spread(x0) / 20
+    x0_64 = np.asarray(x0, np.float64)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float64).mean(axis=0),
+        x0_64.mean(axis=0),
+        atol=1e-5,
+    )
+    # One round changes exactly two rows.
+    one = eng.mix_pairwise(x0, jax.random.key(1), rounds=1)
+    changed = np.flatnonzero(
+        np.abs(np.asarray(one) - np.asarray(x0)).max(axis=1) > 0
+    )
+    assert len(changed) == 2
+
+    sharded = ConsensusEngine(
+        topo.metropolis_weights(), mesh=make_agent_mesh(N)
+    )
+    with pytest.raises(ValueError, match="dense-mode"):
+        sharded.mix_pairwise(x0, jax.random.key(0), rounds=4)
+
