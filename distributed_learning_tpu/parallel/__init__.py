@@ -19,6 +19,7 @@ from distributed_learning_tpu.parallel.gradient_tracking import (
     GradientTrackingEngine,
     TrackingState,
 )
+from distributed_learning_tpu.parallel.extra import ExtraEngine, ExtraState
 from distributed_learning_tpu.parallel.compression import (
     ChocoGossipEngine,
     top_k,
@@ -28,6 +29,8 @@ from distributed_learning_tpu.parallel.compression import (
 
 __all__ = [
     "ChocoGossipEngine",
+    "ExtraEngine",
+    "ExtraState",
     "top_k",
     "random_k",
     "scaled_sign",

@@ -1,0 +1,109 @@
+"""Shared SPMD plumbing for the iterative decentralized-optimizer engines
+(gradient tracking, EXTRA, CHOCO).
+
+Each engine composes a :class:`~.consensus.ConsensusEngine` for mixing and
+runs its recurrence as one jitted ``lax.scan``, dense or under
+``shard_map`` with one agent per mesh device.  The three subtle contracts
+live HERE, once:
+
+* schedule weights must flow through ``shard_map`` in_specs as per-device
+  slices (``P(ax)`` / ``P(None, ax)``) — closure capture would hand every
+  device agent 0's weights (``_local_mix_once`` indexes ``[0]``);
+* per-agent gradient oracles vmap over the stacked axis in dense mode and
+  read ``lax.axis_index`` inside ``shard_map``;
+* the per-round consensus residual is ``max`` agent deviation (dense) or
+  ``sqrt(pmax(local_sq_deviation))`` (sharded).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distributed_learning_tpu.ops import mixing as ops
+
+Pytree = Any
+
+__all__ = ["per_agent_grads", "mix_once", "residual", "cached_scan"]
+
+
+def per_agent_grads(engine, grad_fn, x: Pytree, step: jax.Array) -> Pytree:
+    """Stacked per-agent gradients for a ``(x_i, agent_idx, step)`` oracle."""
+    if engine.mesh is None:
+        idx = jnp.arange(engine.n)
+        return jax.vmap(lambda xi, i: grad_fn(xi, i, step))(x, idx)
+    i = jax.lax.axis_index(engine.axis_name)
+    g = grad_fn(jax.tree.map(lambda v: v[0], x), i, step)
+    return jax.tree.map(lambda v: v[None], g)
+
+
+def mix_once(engine, t: Pytree, self_w, match_w) -> Pytree:
+    """One gossip round; sharded mode consumes the per-device weight
+    slices delivered through in_specs (never closure constants)."""
+    if engine.mesh is None:
+        return engine._dense_mix_once(t)
+    return engine._local_mix_once(t, self_w, match_w)
+
+
+def residual(engine, x: Pytree) -> jax.Array:
+    if engine.mesh is None:
+        return jnp.max(ops.agent_deviations(x))
+    return jnp.sqrt(
+        jax.lax.pmax(engine._local_sq_deviation(x), engine.axis_name)
+    )
+
+
+def cached_scan(
+    owner,
+    cache: dict,
+    steps: int,
+    state_spec,
+    step_fn: Callable,
+):
+    """Build (or fetch) the jitted ``steps``-long scan of ``step_fn``.
+
+    ``step_fn(state, self_w, match_w) -> state``; the driver appends the
+    residual trace.  ``state_spec`` is the state-shaped PartitionSpec tree
+    for sharded mode (scalars replicated as ``P()``).  Returns a callable
+    taking the state (weights are supplied here, through in_specs).
+    """
+    steps = int(steps)
+    engine = owner.engine
+    if steps not in cache:
+        def make_body(self_w, match_w):
+            def body(s, _):
+                s = step_fn(s, self_w, match_w)
+                return s, residual(engine, s.x)
+            return body
+
+        if engine.mesh is None:
+            fn = jax.jit(
+                lambda s: jax.lax.scan(
+                    make_body(None, None), s, None, length=steps
+                )
+            )
+            cache[steps] = lambda state: fn(state)
+        else:
+            spec = P(engine.axis_name)
+
+            def f(s, self_w, match_w):
+                return jax.lax.scan(
+                    make_body(self_w, match_w), s, None, length=steps
+                )
+
+            fn = jax.jit(
+                jax.shard_map(
+                    f,
+                    mesh=engine.mesh,
+                    in_specs=(state_spec, spec, P(None, engine.axis_name)),
+                    out_specs=(state_spec, P()),
+                    check_vma=False,
+                )
+            )
+            cache[steps] = lambda state: fn(
+                state, engine._self_w, engine._match_w
+            )
+    return cache[steps]

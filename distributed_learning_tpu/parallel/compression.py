@@ -43,6 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_learning_tpu.ops import mixing as ops
+from ._spmd import cached_scan, mix_once
 from .consensus import ConsensusEngine
 
 Pytree = Any
@@ -223,9 +224,7 @@ class ChocoGossipEngine:
         return jax.tree.unflatten(treedef, comp)
 
     def _mix(self, t: Pytree, self_w, match_w) -> Pytree:
-        if self.mesh is None:
-            return self.engine._dense_mix_once(t)
-        return self.engine._local_mix_once(t, self_w, match_w)
+        return mix_once(self.engine, t, self_w, match_w)
 
     def _step(self, s: ChocoState, self_w, match_w) -> ChocoState:
         key, sub = jax.random.split(s.key)
@@ -250,52 +249,10 @@ class ChocoGossipEngine:
     def run(self, state: ChocoState, rounds: int) -> Tuple[ChocoState, jax.Array]:
         """``rounds`` CHOCO iterations in one jitted ``lax.scan``; returns
         the final state and the per-round consensus-residual trace."""
-        rounds = int(rounds)
-        if rounds not in self._jit_run:
-            def make_body(self_w, match_w):
-                def body(s, _):
-                    s = self._step(s, self_w, match_w)
-                    if self.mesh is None:
-                        res = jnp.max(ops.agent_deviations(s.x))
-                    else:
-                        res = jnp.sqrt(
-                            jax.lax.pmax(
-                                self.engine._local_sq_deviation(s.x),
-                                self.axis_name,
-                            )
-                        )
-                    return s, res
-                return body
-
-            if self.mesh is None:
-                self._jit_run[rounds] = jax.jit(
-                    lambda s: jax.lax.scan(
-                        make_body(None, None), s, None, length=rounds
-                    )
-                )
-            else:
-                spec = P(self.axis_name)
-                st_spec = ChocoState(x=spec, xhat=spec, key=P())
-
-                def f(s, self_w, match_w):
-                    return jax.lax.scan(
-                        make_body(self_w, match_w), s, None, length=rounds
-                    )
-
-                self._jit_run[rounds] = jax.jit(
-                    jax.shard_map(
-                        f,
-                        mesh=self.mesh,
-                        in_specs=(st_spec, spec, P(None, self.axis_name)),
-                        out_specs=(st_spec, P()),
-                        check_vma=False,
-                    )
-                )
-        if self.mesh is None:
-            return self._jit_run[rounds](state)
-        return self._jit_run[rounds](
-            state, self.engine._self_w, self.engine._match_w
-        )
+        spec = P(self.axis_name)
+        st_spec = ChocoState(x=spec, xhat=spec, key=P())
+        fn = cached_scan(self, self._jit_run, rounds, st_spec, self._step)
+        return fn(state)
 
     def max_deviation(self, state: ChocoState) -> float:
         return float(self.engine.max_deviation(state.x))

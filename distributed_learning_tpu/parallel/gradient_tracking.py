@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_learning_tpu.ops import mixing as ops
+from ._spmd import cached_scan, mix_once, per_agent_grads
 from .consensus import ConsensusEngine
 
 Pytree = Any
@@ -98,26 +99,10 @@ class GradientTrackingEngine:
 
     # ------------------------------------------------------------------ #
     def _grads(self, x: Pytree, step: jax.Array) -> Pytree:
-        """Stacked per-agent gradients (vmap in dense mode; inside
-        shard_map the local shard is one agent, indexed by its mesh
-        coordinate)."""
-        if self.mesh is None:
-            idx = jnp.arange(self.n)
-            return jax.vmap(lambda xi, i: self.grad_fn(xi, i, step))(x, idx)
-        i = jax.lax.axis_index(self.axis_name)
-        sq = jax.tree.map(lambda v: v[0], x)
-        g = self.grad_fn(sq, i, step)
-        return jax.tree.map(lambda v: v[None], g)
+        return per_agent_grads(self.engine, self.grad_fn, x, step)
 
     def _mix(self, x: Pytree, self_w, match_w) -> Pytree:
-        """One gossip round.  In sharded mode ``self_w``/``match_w`` are this
-        device's slices of the schedule weights — they must arrive through
-        ``shard_map`` in_specs (``P(ax)`` / ``P(None, ax)``), NOT as closure
-        constants, or ``_local_mix_once``'s ``[0]`` indexing would read
-        agent 0's weights on every device."""
-        if self.mesh is None:
-            return self.engine._dense_mix_once(x)
-        return self.engine._local_mix_once(x, self_w, match_w)
+        return mix_once(self.engine, x, self_w, match_w)
 
     def _step(self, state: TrackingState, self_w, match_w) -> TrackingState:
         alpha = self._lr(state.step)
@@ -176,54 +161,10 @@ class GradientTrackingEngine:
     ) -> Tuple[TrackingState, jax.Array]:
         """``steps`` DSGT iterations in one ``lax.scan``; returns the final
         state and the (steps,) consensus-residual trace of ``x``."""
-        steps = int(steps)
-        if steps not in self._jit_run:
-            def make_body(self_w, match_w):
-                def body(s, _):
-                    s = self._step(s, self_w, match_w)
-                    if self.mesh is None:
-                        res = jnp.max(ops.agent_deviations(s.x))
-                    else:
-                        res = jnp.sqrt(
-                            jax.lax.pmax(
-                                self.engine._local_sq_deviation(s.x),
-                                self.axis_name,
-                            )
-                        )
-                    return s, res
-                return body
-
-            if self.mesh is None:
-                self._jit_run[steps] = jax.jit(
-                    lambda s: jax.lax.scan(
-                        make_body(None, None), s, None, length=steps
-                    )
-                )
-            else:
-                spec = P(self.axis_name)
-                st_spec = TrackingState(x=spec, y=spec, g=spec, step=P())
-
-                def f(s, self_w, match_w):
-                    return jax.lax.scan(
-                        make_body(self_w, match_w), s, None, length=steps
-                    )
-
-                self._jit_run[steps] = jax.jit(
-                    jax.shard_map(
-                        f,
-                        mesh=self.mesh,
-                        # Schedule weights arrive sliced per device (the
-                        # same contract as ConsensusEngine's programs).
-                        in_specs=(st_spec, spec, P(None, self.axis_name)),
-                        out_specs=(st_spec, P()),
-                        check_vma=False,
-                    )
-                )
-        if self.mesh is None:
-            return self._jit_run[steps](state)
-        return self._jit_run[steps](
-            state, self.engine._self_w, self.engine._match_w
-        )
+        spec = P(self.axis_name)
+        st_spec = TrackingState(x=spec, y=spec, g=spec, step=P())
+        fn = cached_scan(self, self._jit_run, steps, st_spec, self._step)
+        return fn(state)
 
     # ------------------------------------------------------------------ #
     def tracker_sum_gap(self, state: TrackingState) -> float:
