@@ -94,7 +94,47 @@ def build_epoch(model, tx, engine, n_agents):
     return jax.jit(epoch, donate_argnums=donate)
 
 
+def _arm_watchdog():
+    """Self-describing failure instead of an opaque hang.
+
+    The tunneled TPU backend can wedge such that the first device op (or
+    even backend init) blocks forever; the driver would then record only a
+    timeout kill.  A daemon timer turns that into a diagnostic on stderr
+    and a clean non-zero exit.  It guards ONLY the time to the first
+    completed device op — once measurement progress is signalled (the
+    returned event), it stands down, so legitimately long runs (e.g. the
+    OOM-retry ladder recompiling at several batch sizes) are never killed.
+    Disabled with BENCH_WATCHDOG_SECS=0.
+    """
+    import sys
+    import threading
+
+    progressed = threading.Event()
+    secs = float(os.environ.get("BENCH_WATCHDOG_SECS", 1500))
+    if secs <= 0:
+        progressed.set()
+        return progressed
+
+    def fire():
+        if progressed.is_set():
+            return
+        print(
+            f"bench.py watchdog: no completed device op after {secs:.0f}s "
+            "— the backend is likely unresponsive (tunnel wedge); no "
+            "measurement was taken",
+            file=sys.stderr,
+            flush=True,
+        )
+        os._exit(2)
+
+    t = threading.Timer(secs, fire)
+    t.daemon = True
+    t.start()
+    return progressed
+
+
 def main():
+    watchdog_progress = _arm_watchdog()
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         # Accelerator plugins may outrank the env var; honor an explicit pin.
         jax.config.update("jax_platforms", "cpu")
@@ -164,6 +204,7 @@ def main():
         run_epoch = build_epoch(model, tx, engine, n_agents)
         state, losses = run_epoch(state, Xs, ys, epoch_idx(0))  # compile
         np.asarray(losses)
+        watchdog_progress.set()  # first device op completed: no wedge
         state, losses = run_epoch(state, Xs, ys, epoch_idx(1))  # warm
         np.asarray(losses)
 
