@@ -27,6 +27,11 @@ Subpackages
 """
 
 from distributed_learning_tpu.parallel.topology import Topology, gamma, spectral_gap
+from distributed_learning_tpu.parallel.consensus import (
+    ConsensusEngine,
+    Mixer,
+    make_agent_mesh,
+)
 from distributed_learning_tpu.parallel.fast_averaging import (
     find_optimal_weights,
     solve_fastest_mixing,
@@ -39,6 +44,9 @@ from distributed_learning_tpu.parallel.pushsum import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "ConsensusEngine",
+    "Mixer",
+    "make_agent_mesh",
     "Topology",
     "gamma",
     "spectral_gap",

@@ -20,6 +20,11 @@ from distributed_learning_tpu.parallel.gradient_tracking import (
     TrackingState,
 )
 from distributed_learning_tpu.parallel.extra import ExtraEngine, ExtraState
+from distributed_learning_tpu.parallel.consensus import (
+    ConsensusEngine,
+    Mixer,
+    make_agent_mesh,
+)
 from distributed_learning_tpu.parallel.compression import (
     ChocoGossipEngine,
     top_k,
@@ -29,6 +34,9 @@ from distributed_learning_tpu.parallel.compression import (
 
 __all__ = [
     "ChocoGossipEngine",
+    "ConsensusEngine",
+    "Mixer",
+    "make_agent_mesh",
     "ExtraEngine",
     "ExtraState",
     "top_k",

@@ -100,7 +100,7 @@ def cached_scan(
                     mesh=engine.mesh,
                     in_specs=(state_spec, spec, P(None, engine.axis_name)),
                     out_specs=(state_spec, P()),
-                    check_vma=False,
+                    check_vma=True,
                 )
             )
             cache[steps] = lambda state: fn(

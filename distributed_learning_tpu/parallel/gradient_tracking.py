@@ -151,7 +151,7 @@ class GradientTrackingEngine:
                         out_specs=TrackingState(
                             x=spec, y=spec, g=spec, step=P()
                         ),
-                        check_vma=False,
+                        check_vma=True,
                     )
                 )
         return self._jit_init(self.shard(x0))

@@ -61,7 +61,7 @@ def main() -> None:
     state, residuals = eng.run(state, STEPS)
     gt_err = float(jnp.abs(jnp.asarray(state.x) - x_star[None]).max())
 
-    # --- EXTRA: same guarantee, half the bandwidth, f32 floor ~1e-3 ----- #
+    # --- EXTRA: same guarantee, half the mixing bandwidth --------------- #
     ex = ExtraEngine(W, grad_fn, learning_rate=ALPHA)
     ex_state, _ = ex.run(ex.init(jnp.zeros((N, DIM), jnp.float32)), STEPS)
     ex_err = float(jnp.abs(jnp.asarray(ex_state.x) - x_star[None]).max())
@@ -69,7 +69,7 @@ def main() -> None:
     print(f"ring of {N} agents, heterogeneous quadratics, alpha={ALPHA}")
     print(f"gossip SGD optimality gap after {STEPS} steps: {gossip_err:.2e}  (bias floor)")
     print(f"DSGT       optimality gap after {STEPS} steps: {gt_err:.2e}  (2 mixes/step)")
-    print(f"EXTRA      optimality gap after {STEPS} steps: {ex_err:.2e}  (1 mix/step; f32 floor)")
+    print(f"EXTRA      optimality gap after {STEPS} steps: {ex_err:.2e}  (1 mix/step)")
     print(f"DSGT consensus residual: {float(residuals[-1]):.2e}")
 
 

@@ -109,7 +109,6 @@ def test_transformer_lm_sequence_parallel_matches_full(impl):
             mesh=mesh,
             in_specs=(tok_spec,),
             out_specs=P(None, "seq", None),
-            check_vma=False,
         )
     )
     got = sharded_apply(tokens)
