@@ -316,6 +316,51 @@ class ConsensusEngine:
             jnp.int32(max_rounds),
         )
 
+    def mix_until_with(
+        self,
+        stacked: Pytree,
+        W,
+        *,
+        eps: float,
+        min_times: int = 0,
+        max_rounds: int = 10_000,
+        route: str = "auto",
+    ) -> Tuple[Pytree, jax.Array, jax.Array]:
+        """Eps-stopping under a *traced* mixing matrix: the composition of
+        :meth:`mix_until` (the reference's eps-or-times rule,
+        ``mixer.py:40-41``, as a ``lax.while_loop``) with :meth:`mix_with`
+        (time-varying graphs as runtime arguments).  Resampling the
+        topology every epoch keeps both the compiled program AND the
+        adaptive stopping rule; returns ``(state, rounds_done,
+        final_residual)`` like ``mix_until``.
+
+        Sharded routing matches :meth:`mix_with`: sparse graphs relay over
+        the device ring with <=k hops, dense graphs use the masked
+        all-to-all; ``route="auto"`` picks whichever moves less data.
+        """
+        W_traced, decomp = self._traced_w_dispatch(W, route)
+        args = (
+            jnp.float32(eps),
+            jnp.int32(min_times),
+            jnp.int32(max_rounds),
+        )
+        if W_traced is not None:
+            return self._get_jitted("mix_until_with")(
+                stacked, W_traced, *args
+            )
+        self_w, w_fwd, w_bwd, k_hops = decomp
+        fn = self._get_ring_jitted(
+            "mix_until_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
+        )
+        return fn(
+            stacked,
+            jnp.asarray(self_w),
+            jnp.asarray(w_fwd),
+            jnp.asarray(w_bwd),
+            jnp.int32(k_hops),
+            *args,
+        )
+
     def mix_pairwise(
         self,
         stacked: Pytree,
@@ -328,19 +373,22 @@ class ConsensusEngine:
         drawn uniformly and its two endpoints average,
         ``x_i, x_j <- (x_i + x_j) / 2``.
 
-        The entire schedule compiles into one ``lax.scan`` — per round an
-        edge index is sampled on device and the two rows are updated by
-        gather/scatter, so "asynchrony" costs no host round-trips.  Mean
-        is preserved exactly every round; E[spread^2] contracts at the
-        pairwise rate lambda_2(E[W_pair]).  Dense mode only (a single pair
-        per round leaves every other device idle — on a mesh, use the
-        synchronous schedules instead).
+        Dense mode is the literal model: per round one edge index is
+        sampled on device and the two rows are updated by gather/scatter
+        inside one ``lax.scan`` — "asynchrony" costs no host round-trips.
+
+        Sharded mode runs the natural mesh variant: each round draws a
+        uniformly random **maximal matching** of the mixing graph (from a
+        host-precomputed pool that covers every edge) and all matched
+        pairs average simultaneously — each device talks to at most ONE
+        partner per round (a single ``ppermute``), no device idles behind
+        a lone active edge, and the per-round update is still an
+        (I + P_M)/2 pairwise-averaging matrix, so the Boyd-style analysis
+        applies with E[W] averaged over the matching pool.
+
+        Both modes preserve the mean exactly every round and contract
+        E[spread^2] at the rate lambda_2(E[W]).
         """
-        if self.mesh is not None:
-            raise ValueError(
-                "mix_pairwise is a dense-mode algorithm (one active edge "
-                "per round; a mesh would idle n-2 devices)"
-            )
         # Same edge convention as MatchingSchedule.from_matrix: magnitude
         # above tolerance (SDP weights can legitimately be negative, and
         # roundoff noise must not become a full-strength averaging edge).
@@ -348,6 +396,8 @@ class ConsensusEngine:
         edges = np.argwhere(np.abs(upper) > 1e-12)
         if len(edges) == 0:
             return stacked
+        if self.mesh is not None:
+            return self._mix_pairwise_sharded(stacked, key, rounds, edges)
         ckey = ("pairwise", len(edges))
         if ckey not in self._jit_cache:
             edges_dev = jnp.asarray(edges, jnp.int32)
@@ -373,6 +423,102 @@ class ConsensusEngine:
                 return out
 
             self._jit_cache[ckey] = jax.jit(f)
+        return self._jit_cache[ckey](stacked, key, jnp.int32(rounds))
+
+    def _random_maximal_matchings(
+        self, edges: np.ndarray
+    ) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
+        """Host-side pool of random maximal matchings of the edge set.
+
+        Greedy completion of random edge orders; seeding one order per
+        edge guarantees every edge appears in at least one matching (so
+        E[W] over the pool is supported on the whole graph and consensus
+        reaches every component the graph connects).  Deduplicated; a few
+        extra fully-random orders add diversity on dense graphs.
+        """
+        cached = getattr(self, "_pairwise_matchings", None)
+        if cached is not None:  # W is fixed after __init__, so is the pool
+            return cached
+        rng = np.random.default_rng(0x5EED)
+        E = [(int(i), int(j)) for i, j in edges]
+
+        def greedy(order):
+            used, M = set(), []
+            for (i, j) in order:
+                if i not in used and j not in used:
+                    M.append((i, j))
+                    used.update((i, j))
+            return tuple(sorted(M))
+
+        pool = dict()
+        for k, e in enumerate(E):
+            rest = E[:k] + E[k + 1:]
+            rng.shuffle(rest)
+            pool.setdefault(greedy([e] + rest), None)
+        for _ in range(8):
+            order = list(E)
+            rng.shuffle(order)
+            pool.setdefault(greedy(order), None)
+        # Memoized for reuse (and exposed for tests/diagnostics).
+        self._pairwise_matchings = tuple(pool.keys())
+        return self._pairwise_matchings
+
+    def _mix_pairwise_sharded(
+        self, stacked: Pytree, key: jax.Array, rounds: int, edges: np.ndarray
+    ) -> Pytree:
+        """Sharded pairwise gossip: ``lax.switch`` over one statically
+        compiled ppermute per matching in the pool; the per-round matching
+        index is sampled on device from the (replicated) key, so all
+        devices agree on the draw without any coordination traffic."""
+        matchings = self._random_maximal_matchings(edges)
+        ckey = ("pairwise_sharded", matchings)
+        if ckey not in self._jit_cache:
+            mesh, ax, n = self.mesh, self.axis_name, self.n
+
+            def matching_branch(M):
+                pairs = [(i, j) for (i, j) in M] + [(j, i) for (i, j) in M]
+                matched = np.zeros((n,), np.float32)
+                for (i, j) in M:
+                    matched[i] = matched[j] = 1.0
+                half = jnp.asarray(0.5 * matched)  # (n,) constant
+
+                def f(x):
+                    i = lax.axis_index(ax)
+                    c = half[i]  # 0.5 if this device is matched else 0.0
+                    nb = jax.tree.map(
+                        lambda v: lax.ppermute(v, ax, pairs), x
+                    )
+                    # Unmatched devices receive zeros from ppermute and
+                    # keep (1 - 0) = full self weight.
+                    return jax.tree.map(
+                        lambda v, b: (
+                            (1.0 - c) * v.astype(jnp.float32)
+                            + c * b.astype(jnp.float32)
+                        ).astype(v.dtype),
+                        x, nb,
+                    )
+
+                return f
+
+            branches = [matching_branch(M) for M in matchings]
+
+            def local(x, key, rounds):
+                def body(r, xx):
+                    m = jax.random.randint(
+                        jax.random.fold_in(key, r), (), 0, len(branches)
+                    )
+                    return lax.switch(m, branches, xx)
+
+                return lax.fori_loop(0, rounds, body, x)
+
+            self._jit_cache[ckey] = jax.jit(
+                jax.shard_map(
+                    local,
+                    mesh=mesh,
+                    in_specs=(P(ax), P(), P()),
+                    out_specs=P(ax),
+                )
+            )
         return self._jit_cache[ckey](stacked, key, jnp.int32(rounds))
 
     def mix_chebyshev(self, stacked: Pytree, times: int) -> Pytree:
@@ -603,6 +749,17 @@ class ConsensusEngine:
                         lambda s: ops.dense_mix(s, W, precision=self.precision),
                     )
                 )
+            elif name == "mix_until_with":
+                fn = wrap(
+                    lambda x, W, eps, mn, mx: self._run_until(
+                        x,
+                        eps,
+                        mn,
+                        mx,
+                        lambda s: ops.dense_mix(s, W, precision=self.precision),
+                        lambda s: jnp.max(ops.agent_deviations(s)),
+                    )
+                )
             elif name == "mix_chebyshev_with":
                 fn = wrap(
                     lambda x, W, om: self._cheby_traced(
@@ -692,6 +849,24 @@ class ConsensusEngine:
                     )
 
                 fn = sharded(local_mw, P(ax), extra_in=(P(ax), P()))
+            elif name == "mix_until_with":
+                def local_uw(x, W_rows, eps, mn, mx):
+                    return self._run_until(
+                        x,
+                        eps,
+                        mn,
+                        mx,
+                        lambda s: self._local_allgather_mix(s, W_rows),
+                        lambda s: lax.pmax(
+                            jnp.sqrt(self._local_sq_deviation(s)), ax
+                        ),
+                    )
+
+                fn = sharded(
+                    local_uw,
+                    (P(ax), P(), P()),
+                    extra_in=(P(ax), P(), P(), P()),
+                )
             elif name == "mix_chebyshev_with":
                 def local_cw(x, W_rows, om):
                     return self._cheby_traced(
@@ -730,6 +905,8 @@ class ConsensusEngine:
                 use_fwd=use_fwd, use_bwd=use_bwd,
             )
 
+        in_specs = (P(ax), P(ax), P(ax), P(ax), P(), P())
+        out_specs: Any = P(ax)
         if name == "mix_with_ring":
             def local_mr(x, sw, wf, wb, k, t):
                 return self._run_times(
@@ -744,14 +921,30 @@ class ConsensusEngine:
                 )
 
             body = local_cr
+        elif name == "mix_until_with_ring":
+            def local_ur(x, sw, wf, wb, k, eps, mn, mx):
+                return self._run_until(
+                    x,
+                    eps,
+                    mn,
+                    mx,
+                    lambda s: ring_once(s, sw, wf, wb, k),
+                    lambda s: lax.pmax(
+                        jnp.sqrt(self._local_sq_deviation(s)), ax
+                    ),
+                )
+
+            body = local_ur
+            in_specs = (P(ax), P(ax), P(ax), P(ax), P(), P(), P(), P())
+            out_specs = (P(ax), P(), P())
         else:
             raise KeyError(name)
         fn = jax.jit(
             jax.shard_map(
                 body,
                 mesh=mesh,
-                in_specs=(P(ax), P(ax), P(ax), P(ax), P(), P()),
-                out_specs=P(ax),
+                in_specs=in_specs,
+                out_specs=out_specs,
             )
         )
         self._jit_cache[key] = fn

@@ -352,11 +352,6 @@ class GossipTrainer:
                 "mix_eps (eps-stopping) and chebyshev (fixed accelerated "
                 "schedule) are mutually exclusive; pick one stopping rule"
             )
-        if topology_schedule is not None and mix_eps is not None:
-            raise ValueError(
-                "mix_eps is not supported with topology_schedule; "
-                "time-varying mixing runs a fixed mix_times rounds per epoch"
-            )
         if global_avg_every is not None and global_avg_every < 1:
             raise ValueError("global_avg_every must be >= 1")
         self.global_avg_every = global_avg_every
@@ -743,6 +738,13 @@ class GossipTrainer:
                         )
                     omegas = chebyshev_omegas(g_e, mix_times)
                     params = self.engine.mix_chebyshev_with(params, W_e, omegas)
+                elif self.mix_eps is not None:
+                    # Eps-stopping composed with the traced-W path: the
+                    # resampled graph still gossips until the residual
+                    # drops below eps (at least mix_times rounds).
+                    params, _, _ = self.engine.mix_until_with(
+                        params, W_e, eps=self.mix_eps, min_times=mix_times
+                    )
                 else:
                     params = self.engine.mix_with(params, W_e, times=mix_times)
             elif self._choco is not None:

@@ -149,8 +149,9 @@ def test_weighted_round_fixed_point_random_graph():
 
 def test_pairwise_gossip_preserves_mean_and_contracts():
     """Randomized pairwise gossip (the asynchronous-gossip model of
-    Boyd et al. 2006): exact mean preservation every round, spread
-    contraction over enough rounds, and the mesh restriction is loud."""
+    Boyd et al. 2006): exact mean preservation every round and spread
+    contraction over enough rounds, in both dense (single random edge)
+    and sharded (random maximal matching) modes."""
     topo = _graph(61)
     eng = ConsensusEngine(topo.metropolis_weights())
     x0 = _x0(9)
@@ -172,6 +173,85 @@ def test_pairwise_gossip_preserves_mean_and_contracts():
     sharded = ConsensusEngine(
         topo.metropolis_weights(), mesh=make_agent_mesh(N)
     )
-    with pytest.raises(ValueError, match="dense-mode"):
-        sharded.mix_pairwise(x0, jax.random.key(0), rounds=4)
+    out_s = sharded.mix_pairwise(x0, jax.random.key(0), rounds=400)
+    assert _spread(out_s) < _spread(x0) / 20
+    np.testing.assert_allclose(
+        np.asarray(out_s, np.float64).mean(axis=0),
+        x0_64.mean(axis=0),
+        atol=1e-5,
+    )
+
+
+def test_sharded_pairwise_is_one_matching_per_round():
+    """Sharded pairwise gossip: every round applies (I + P_M)/2 for ONE
+    maximal matching M from the engine's pool — each device exchanges
+    with at most one partner, matched pairs average, unmatched rows pass
+    through untouched."""
+    topo = _graph(61)
+    W = topo.metropolis_weights()
+    eng = ConsensusEngine(W, mesh=make_agent_mesh(N))
+    x0 = _x0(4)
+    one = np.asarray(eng.mix_pairwise(x0, jax.random.key(7), rounds=1))
+    pool = eng._pairwise_matchings
+    edges = {
+        (i, j)
+        for i in range(N)
+        for j in range(i + 1, N)
+        if abs(W[i, j]) > 1e-12
+    }
+    hits = 0
+    x0n = np.asarray(x0)
+    for M in pool:
+        # Pool sanity: a valid maximal matching of the mixing graph.
+        used = [i for pair in M for i in pair]
+        assert len(used) == len(set(used)), f"{M} reuses a vertex"
+        assert all(tuple(sorted(p)) in edges for p in M)
+        free = set(range(N)) - set(used)
+        assert not any(
+            tuple(sorted((a, b))) in edges
+            for a in free
+            for b in free
+            if a < b
+        ), f"{M} is not maximal"
+        expect = x0n.copy()
+        for (i, j) in M:
+            avg = (x0n[i] + x0n[j]) / 2.0
+            expect[i] = expect[j] = avg
+        if np.allclose(one, expect, atol=1e-6):
+            hits += 1
+    assert hits == 1, f"one round matched {hits} pool entries"
+    # Every edge of the graph is covered by the pool (E[W] spans the graph).
+    covered = {tuple(sorted(p)) for M in pool for p in M}
+    assert covered == edges
+
+
+@pytest.mark.parametrize("graph,route", [(Topology.ring(N), "ring"),
+                                         (None, "allgather")])
+def test_mix_until_with_stops_at_eps_on_resampled_graphs(graph, route):
+    """mix_until_with = eps-stopping composed with the traced-W path: for
+    both sharded routes (k-hop ring relays and masked all-to-all) the
+    returned residual is below eps, at least min_times rounds ran, and
+    the result agrees with dense mix_until on the same W."""
+    topo = graph if graph is not None else _graph(17)
+    W = topo.metropolis_weights()
+    x0 = _x0(2)
+    eps = 1e-4
+    dense = ConsensusEngine(W)
+    ref, t_ref, res_ref = dense.mix_until(x0, eps=eps, min_times=2)
+    # Dense traced-W
+    out_d, t_d, res_d = dense.mix_until_with(x0, W, eps=eps, min_times=2)
+    assert float(res_d) < eps and int(t_d) >= 2
+    np.testing.assert_allclose(
+        np.asarray(out_d), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+    assert int(t_d) == int(t_ref)
+    # Sharded, forced route
+    sh = ConsensusEngine(W, mesh=make_agent_mesh(N))
+    out_s, t_s, res_s = sh.mix_until_with(
+        x0, W, eps=eps, min_times=2, route=route
+    )
+    assert float(res_s) < eps and int(t_s) >= 2
+    np.testing.assert_allclose(
+        np.asarray(out_s), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
 

@@ -314,13 +314,47 @@ def test_chebyshev_config_validation():
     # eps-stopping and the fixed chebyshev schedule are mutually exclusive.
     with pytest.raises(ValueError, match="mutually exclusive"):
         GossipTrainer(chebyshev=True, mix_eps=1e-4, **kw)
-    # eps-stopping is undefined under a time-varying schedule.
-    with pytest.raises(ValueError, match="topology_schedule"):
-        from distributed_learning_tpu.parallel.topology import Topology
 
-        GossipTrainer(
-            topology_schedule=lambda e: Topology.ring(3), mix_eps=1e-4, **kw
+
+def test_eps_stopping_composes_with_topology_schedule():
+    """mix_eps + topology_schedule: each epoch's resampled graph gossips
+    until the residual drops below eps (engine.mix_until_with), so the
+    post-mix deviation must sit at/below eps even though the graph
+    changes every epoch."""
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(3)
+    train = {
+        i: (
+            rng.normal(size=(32, 6)).astype(np.float32),
+            rng.integers(0, 2, size=(32,)).astype(np.int32),
         )
+        for i in range(3)
+    }
+    schedules = []
+
+    def schedule(e):
+        schedules.append(e)
+        return Topology.ring(3) if e % 2 == 0 else Topology.complete(3)
+
+    tr = GossipTrainer(
+        node_names=[0, 1, 2],
+        model="mlp",
+        model_kwargs={"hidden_dim": 8, "output_dim": 2},
+        train_data=train,
+        batch_size=8,
+        dropout=False,
+        epoch=2,
+        topology_schedule=schedule,
+        mix_eps=1e-4,
+        mix_times=1,
+        seed=5,
+    )
+    for _ in range(2):
+        payload = tr.train_epoch()
+        assert payload["mixed"]
+        assert payload["deviation"] <= 1e-4 + 1e-6
+    assert set(schedules) >= {0, 1}
 
 
 def test_gossip_pga_and_adaptive_mix_times():
