@@ -101,10 +101,10 @@ def _arm_watchdog():
     even backend init) blocks forever; the driver would then record only a
     timeout kill.  A daemon timer turns that into a diagnostic on stderr
     and a clean non-zero exit.  It guards ONLY the time to the first
-    completed device op — once measurement progress is signalled (the
-    returned event), it stands down, so legitimately long runs (e.g. the
-    OOM-retry ladder recompiling at several batch sizes) are never killed.
-    Disabled with BENCH_WATCHDOG_SECS=0.
+    completed (or OOM-failed — that too proves the backend is alive)
+    device op; after that it stands down, so legitimately long runs
+    (e.g. the OOM-retry ladder recompiling at several batch sizes) are
+    never killed.  Disabled with BENCH_WATCHDOG_SECS=0.
     """
     import sys
     import threading
@@ -225,6 +225,11 @@ def main():
         except Exception as exc:  # jaxlib XlaRuntimeError, by message
             if "RESOURCE_EXHAUSTED" not in str(exc) and "Out of memory" not in str(exc):
                 raise
+            # An OOM is proof the backend is alive (the op ran and failed),
+            # so the retry ladder counts as liveness: stand the watchdog
+            # down or a slow recompile at the smaller batch could be
+            # killed mid-flight.
+            watchdog_progress.set()
             if batch // 2 < 32:
                 raise
             import sys

@@ -136,6 +136,8 @@ class ConsensusAgent:
         self._iteration = -1
         self._iter_value: Optional[np.ndarray] = None
         self._prev_value: Optional[np.ndarray] = None
+        # Two-slot (array, sparse-beats-dense) memo for _sparse_wins.
+        self._sparse_cache: list = [(None, False), (None, False)]
         self._deferred: Dict[Tuple[int, int], list] = {}
         # Persistent read tasks: a FramedStream.recv interrupted mid-frame
         # would corrupt the stream, so reads are never cancelled — a
@@ -312,18 +314,33 @@ class ConsensusAgent:
             self._make_response(req.round_id, req.iteration, value)
         )
 
+    def _sparse_wins(self, value) -> bool:
+        """Whether the sparse wire beats dense for this value: its density
+        must be below the sparse format's breakeven (~1/3 with bf16
+        values, ~1/2 f32 — see ``encode_sparse``).  The O(d) nonzero scan
+        is memoized per array object: the same iteration value is
+        answered once per neighbor plus every deferred resend, and it is
+        never mutated in place (``_exchange_values`` rebinds, mixing
+        allocates new arrays).  Two slots, because answers alternate
+        between ``_iter_value`` and ``_prev_value`` when neighbors run
+        one iteration behind — a single slot would thrash exactly then."""
+        for ref, verdict in self._sparse_cache:
+            if ref is value:
+                return verdict
+        breakeven = value.size / (3 if self.bf16_wire else 2)
+        verdict = bool(np.count_nonzero(value) < breakeven)
+        self._sparse_cache = [(value, verdict), self._sparse_cache[0]]
+        return verdict
+
     def _make_response(self, round_id: int, iteration: int, value):
-        """Pick the wire encoding per message: sparse only when the value
-        is actually below the sparse format's breakeven density (~1/3 with
-        bf16 values, ~1/2 f32 — see ``encode_sparse``); a dense value on a
-        ``sparse_wire`` agent would otherwise cost ~2-3x the dense wire."""
-        if self.sparse_wire and value is not None:
-            breakeven = value.size / (3 if self.bf16_wire else 2)
-            if np.count_nonzero(value) < breakeven:
-                return P.ValueResponseSparse(
-                    round_id=round_id, iteration=iteration, value=value,
-                    bf16_wire=self.bf16_wire,
-                )
+        """Pick the wire encoding per message: sparse only when it
+        actually saves bytes; a dense value on a ``sparse_wire`` agent
+        would otherwise cost ~2-3x the dense wire."""
+        if self.sparse_wire and value is not None and self._sparse_wins(value):
+            return P.ValueResponseSparse(
+                round_id=round_id, iteration=iteration, value=value,
+                bf16_wire=self.bf16_wire,
+            )
         return P.ValueResponse(
             round_id=round_id, iteration=iteration, value=value,
             bf16_wire=self.bf16_wire,
@@ -675,7 +692,51 @@ class ConsensusAgent:
             await asyncio.sleep(0.02)
 
     # ------------------------------------------------------------------ #
-    async def close(self) -> None:
+    async def close(self, *, drain: float = 0.5) -> None:
+        """Tear down, after answering straggler neighbor requests.
+
+        The exchange protocol is pull-based: a peer's request is answered
+        only while this agent is awaiting inside an exchange, and round
+        completion skews up to one iteration across an edge — so a fast
+        agent closing immediately after its last round can strand a
+        slower neighbor mid-exchange.  Before tearing down, keep serving
+        ``ValueRequest``s until the fabric has been quiet for 100 ms (or
+        ``drain`` seconds total, whichever comes first).  ``drain=0``
+        skips the grace period (used for tests that simulate dying
+        agents).
+        """
+        deadline = asyncio.get_event_loop().time() + drain
+        while drain > 0:
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                break
+            if self._master_task is None and self._master is not None:
+                self._master_task = asyncio.ensure_future(self._master.recv())
+                self._master_task.add_done_callback(self._silence)
+            if self._mux_task is None:
+                self._mux_task = asyncio.ensure_future(self._mux.__anext__())
+            tasks = {
+                t for t in (self._master_task, self._mux_task) if t is not None
+            }
+            if not tasks:
+                break
+            done, _ = await asyncio.wait(
+                tasks,
+                timeout=min(0.1, remaining),
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if not done:
+                break  # quiet: no straggler left waiting on us
+            try:
+                if self._master_task in done:
+                    self._master_task = None  # Done/Shutdown etc.: ignore
+                    continue
+                token, msg, _stream = self._mux_task.result()
+                self._mux_task = None
+                if isinstance(msg, P.ValueRequest):
+                    await self._answer(token, msg)
+            except Exception:
+                break  # a dying fabric must not block teardown
         self._mux.close()
         for task in (self._master_task, self._mux_task):
             if task is not None:

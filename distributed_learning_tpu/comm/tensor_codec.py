@@ -129,6 +129,10 @@ def encode_sparse(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
             f"sparse wire limited to {_MAX_SPARSE_DENSE_ELEMS} dense "
             f"elements, got {flat.size}"
         )
+    if x.ndim > _MAX_NDIM:
+        # Same clear-local-error policy: decode_sparse rejects ndim >
+        # _MAX_NDIM, so encoding it would fail on every peer instead.
+        raise ValueError(f"ndim {x.ndim} exceeds wire limit {_MAX_NDIM}")
     idx = np.flatnonzero(flat).astype(np.uint32)
     vals = flat[idx]
     header = struct.pack(f"<BBBB{x.ndim}I", 0xFF, 0, x.ndim, 0, *x.shape)

@@ -466,12 +466,22 @@ class GossipTrainer:
         if max(lens) > m:
             import warnings
 
-            warnings.warn(
-                f"node shards are imbalanced ({min(lens)}..{max(lens)} "
-                f"samples); every shard is truncated to {m} (the smallest, "
-                "batch-aligned) so the stacked epoch has a common batch grid",
-                stacklevel=3,
-            )
+            if max(lens) > min(lens):
+                msg = (
+                    f"node shards are imbalanced ({min(lens)}..{max(lens)} "
+                    f"samples); every shard is truncated to {m} (the "
+                    "smallest, batch-aligned) so the stacked epoch has a "
+                    "common batch grid"
+                )
+            else:
+                # Equal shards merely not batch-aligned: still worth a
+                # notice (samples are dropped), but not "imbalanced".
+                msg = (
+                    f"node shards ({min(lens)} samples) are not a multiple "
+                    f"of batch_size; each is truncated to {m} so the "
+                    "stacked epoch has a whole number of batches"
+                )
+            warnings.warn(msg, stacklevel=3)
         Xs = jnp.stack(
             [jnp.asarray(train_data[t][0][:m]) for t in self.node_names]
         )
@@ -850,17 +860,39 @@ class GossipTrainer:
         if self._state is None:
             self.initialize_nodes()
         params, bs, opt, rng = self._state
-        save_checkpoint(
-            path,
-            {
-                "params": params,
-                "batch_stats": bs if bs is not None else {},
-                "opt_state": opt,
-                "rng": jax.random.key_data(rng),
-                "epochs_done": self._epochs_done,
-                "global_step": self._global_step,
-            },
-        )
+        tree = {
+            "params": params,
+            "batch_stats": bs if bs is not None else {},
+            "opt_state": opt,
+            "rng": jax.random.key_data(rng),
+            "epochs_done": self._epochs_done,
+            "global_step": self._global_step,
+        }
+        if self._choco is not None:
+            # Compressed runs checkpoint the CHOCO error-feedback state:
+            # resuming with fresh (zero) estimates would re-converge, but
+            # the resumed trajectory would silently diverge from the
+            # uninterrupted one.  The tree shape is config-determined
+            # (compression on/off), so templates stay structural.
+            tree["choco"] = self._choco_tree()
+        save_checkpoint(path, tree)
+
+    def _choco_tree(self):
+        """CHOCO state as a checkpointable subtree; ``present`` records
+        whether estimates exist yet (no gossip round has run before the
+        first consensus epoch)."""
+        params = self._state[0]
+        if self._choco_xhat is not None:
+            return {
+                "present": 1,
+                "xhat": self._choco_xhat,
+                "key": jax.random.key_data(self._choco_key),
+            }
+        return {
+            "present": 0,
+            "xhat": jax.tree.map(jnp.zeros_like, params),
+            "key": jax.random.key_data(jax.random.key(self.seed + 2)),
+        }
 
     def restore_checkpoint(self, path: str) -> None:
         from distributed_learning_tpu.training.checkpoint import restore_checkpoint
@@ -876,16 +908,59 @@ class GossipTrainer:
             "epochs_done": 0,
             "global_step": 0,
         }
-        restored = restore_checkpoint(path, template)
+        def _is_structure_mismatch(exc: Exception) -> bool:
+            # Orbax reports template/on-disk tree divergence as a
+            # ValueError mentioning the structures; anything else (missing
+            # path, corrupt data, dtype drift inside a leaf) must surface.
+            text = str(exc)
+            return isinstance(exc, ValueError) and (
+                "structure" in text or "MISSING" in text
+            )
+
+        import warnings
+
+        restored = None
+        with_choco = {**template, "choco": self._choco_tree()}
+        if self._choco is not None:
+            try:
+                restored = restore_checkpoint(path, with_choco)
+            except Exception as exc:
+                if not _is_structure_mismatch(exc):
+                    raise
+                # Checkpoint saved before CHOCO state was checkpointed (or
+                # by a dense trainer): old semantics — estimates reset,
+                # error feedback re-converges.
+                warnings.warn(
+                    "checkpoint has no CHOCO state (saved by an older "
+                    "version or a dense trainer); estimates reset to zero "
+                    "and error feedback re-converges over the next few "
+                    "epochs"
+                )
+        if restored is None:
+            try:
+                restored = restore_checkpoint(path, template)
+            except Exception as exc:
+                if self._choco is not None or not _is_structure_mismatch(exc):
+                    raise
+                # Dense trainer reading a compressed run's checkpoint:
+                # restore the training state and ignore the CHOCO subtree.
+                warnings.warn(
+                    "checkpoint contains CHOCO state but this trainer has "
+                    "no compression; the estimates are ignored"
+                )
+                restored = restore_checkpoint(path, with_choco)
+                restored.pop("choco", None)
         self._state = (
             restored["params"],
             restored["batch_stats"] if bs is not None else None,
             restored["opt_state"],
             jax.random.wrap_key_data(restored["rng"]),
         )
-        # CHOCO estimates are not checkpointed: they restart at zero and
-        # error feedback re-converges them within a few epochs.
         self._choco_xhat = None
+        choco_tree = restored.get("choco")
+        if choco_tree is not None and int(choco_tree["present"]):
+            self._choco_xhat = choco_tree["xhat"]
+            self._choco_key = jax.random.wrap_key_data(choco_tree["key"])
         self._epochs_done = int(restored["epochs_done"])
         self._global_step = int(restored["global_step"])
 

@@ -55,7 +55,7 @@ async def main():
         print(f"agent {agent.token} round {r}: {np.round(x, 4).tolist()}",
               flush=True)
         await agent.send_telemetry({"round": r, "norm": float(np.linalg.norm(x))})
-    await agent.close()
+    await agent.close()  # drains straggler neighbor requests, then exits
 
 
 if __name__ == "__main__":

@@ -509,6 +509,197 @@ def test_remat_matches_plain_training():
             np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5)
 
 
+def test_choco_state_survives_checkpoint_resume(tmp_path):
+    """Compressed-run resume reproduces the uninterrupted trajectory:
+    the CHOCO error-feedback state (public estimates xhat + PRNG key) is
+    checkpointed, so save/restore mid-run must yield the same parameters
+    as never stopping (previously estimates reset to zero on restore and
+    the resumed run silently diverged)."""
+    from distributed_learning_tpu.models import ANNModel
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(1)
+    n, d = 4, 8
+    train = {
+        i: (
+            rng.normal(size=(64, d)).astype(np.float32),
+            rng.integers(0, 3, size=(64,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+    kw = dict(
+        node_names=list(range(n)),
+        model=ANNModel(hidden_dim=8, output_dim=3),
+        optimizer="sgd",
+        learning_rate=0.05,
+        weights=Topology.ring(n),
+        train_data=train,
+        batch_size=16,
+        epoch=4,
+        dropout=False,
+        seed=7,
+        mix_times=4,
+        compression="topk:0.3",
+        compression_gamma=0.3,
+    )
+    straight = GossipTrainer(**kw)
+    straight.initialize_nodes()
+    for _ in range(4):
+        straight.train_epoch()
+
+    t1 = GossipTrainer(**kw)
+    t1.initialize_nodes()
+    t1.train_epoch()
+    t1.train_epoch()
+    assert t1._choco_xhat is not None  # estimates exist mid-run
+    ckpt = str(tmp_path / "choco-ckpt")
+    t1.save_checkpoint(ckpt)
+
+    t2 = GossipTrainer(**kw)
+    t2.restore_checkpoint(ckpt)
+    assert t2._epochs_done == 2
+    assert t2._choco_xhat is not None  # estimates restored, not reset
+    t2.train_epoch()
+    t2.train_epoch()
+
+    for a, b in zip(
+        jax.tree.leaves(straight.state[0]), jax.tree.leaves(t2.state[0])
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_choco_restore_falls_back_on_pre_choco_checkpoint(tmp_path):
+    """A checkpoint written without CHOCO state (older version / dense
+    trainer) still restores into a compressed trainer: estimates reset
+    with a warning instead of an unrecoverable structure mismatch."""
+    import warnings as _warnings
+
+    from distributed_learning_tpu.models import ANNModel
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(2)
+    n, d = 3, 6
+    train = {
+        i: (
+            rng.normal(size=(32, d)).astype(np.float32),
+            rng.integers(0, 2, size=(32,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+    kw = dict(
+        node_names=list(range(n)),
+        model=ANNModel(hidden_dim=6, output_dim=2),
+        weights=Topology.ring(n),
+        train_data=train,
+        batch_size=16,
+        epoch=2,
+        dropout=False,
+        seed=3,
+    )
+    old = GossipTrainer(**kw)  # no compression: saves no choco subtree
+    old.initialize_nodes()
+    old.train_epoch()
+    ckpt = str(tmp_path / "old-ckpt")
+    old.save_checkpoint(ckpt)
+
+    new = GossipTrainer(compression="topk:0.5", **kw)
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        new.restore_checkpoint(ckpt)
+    assert any("no CHOCO state" in str(w.message) for w in caught)
+    assert new._epochs_done == 1 and new._choco_xhat is None
+    new.train_epoch()  # and the resumed run still trains + mixes
+
+
+def test_dense_trainer_restores_compressed_checkpoint(tmp_path):
+    """The reverse compatibility direction: a compressed run's checkpoint
+    (which carries a 'choco' subtree) restores into a dense trainer —
+    training state loads, the estimates are ignored with a warning."""
+    import warnings as _warnings
+
+    from distributed_learning_tpu.models import ANNModel
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(4)
+    n, d = 3, 6
+    train = {
+        i: (
+            rng.normal(size=(32, d)).astype(np.float32),
+            rng.integers(0, 2, size=(32,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+    kw = dict(
+        node_names=list(range(n)),
+        model=ANNModel(hidden_dim=6, output_dim=2),
+        weights=Topology.ring(n),
+        train_data=train,
+        batch_size=16,
+        epoch=2,
+        dropout=False,
+        seed=3,
+    )
+    comp = GossipTrainer(compression="topk:0.5", **kw)
+    comp.initialize_nodes()
+    comp.train_epoch()
+    ckpt = str(tmp_path / "comp-ckpt")
+    comp.save_checkpoint(ckpt)
+
+    dense = GossipTrainer(**kw)
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        dense.restore_checkpoint(ckpt)
+    assert any("estimates are ignored" in str(w.message) for w in caught)
+    assert dense._epochs_done == 1
+    for a, b in zip(
+        jax.tree.leaves(comp.state[0]), jax.tree.leaves(dense.state[0])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    dense.train_epoch()
+
+
+def test_shard_truncation_warnings_distinguish_imbalance():
+    """Balanced-but-unaligned shards warn about batch-grid truncation
+    (samples ARE dropped), imbalanced shards warn about imbalance; the
+    old message called equal shards 'imbalanced'."""
+    import warnings as _warnings
+
+    def build(lens):
+        rng = np.random.default_rng(0)
+        train = {
+            i: (
+                rng.normal(size=(ln, 4)).astype(np.float32),
+                rng.integers(0, 2, size=(ln,)).astype(np.int32),
+            )
+            for i, ln in enumerate(lens)
+        }
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            GossipTrainer(
+                node_names=list(range(len(lens))),
+                model="mlp",
+                model_kwargs={"hidden_dim": 4, "output_dim": 2},
+                train_data=train,
+                batch_size=16,
+                dropout=False,
+            )
+        return [str(w.message) for w in caught]
+
+    balanced = build([100, 100, 100])  # truncated to 96, equal shards
+    assert any("not a multiple" in m for m in balanced), balanced
+    assert not any("imbalanced" in m for m in balanced), balanced
+
+    imbalanced = build([100, 120, 100])
+    assert any("imbalanced" in m for m in imbalanced), imbalanced
+
+    aligned = build([96, 96, 96])  # nothing dropped: silent
+    assert not any(
+        "truncat" in m or "imbalanced" in m for m in aligned
+    ), aligned
+
+
 def test_choco_compressed_mixing_trains_and_converges():
     """CHOCO-SGD through the trainer: compression='topk:0.3' gossips only
     compressed corrections between epochs; deviation still shrinks and
