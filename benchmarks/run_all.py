@@ -11,6 +11,7 @@ import traceback
 
 from benchmarks import (
     bench_titanic,
+    bench_titanic_noniid,
     bench_fast_averaging,
     bench_cifar_mlp,
     bench_cifar_wrn,
@@ -27,6 +28,7 @@ CONFIGS = [
     ("5: CIFAR-100 WRN time-varying + Chebyshev", bench_timevarying.run),
     ("+: flash-attention kernel TFLOP/s (beyond-parity)", bench_attention.run),
     ("+: compressed gossip rounds/bytes (beyond-parity)", bench_compression.run),
+    ("+: label-skewed Titanic non-IID accuracy (real data)", bench_titanic_noniid.run),
 ]
 
 

@@ -706,11 +706,17 @@ class ConsensusAgent:
         agents).
         """
         deadline = asyncio.get_event_loop().time() + drain
+        # Once the master stream yields anything during close — a message
+        # we no longer care about, or EOF from a master that exited first
+        # — stop listening to it: respawning recv() on an EOF'd stream
+        # completes instantly and would busy-spin the drain loop, starving
+        # the neighbor mux it exists to serve.
+        master_live = self._master is not None
         while drain > 0:
             remaining = deadline - asyncio.get_event_loop().time()
             if remaining <= 0:
                 break
-            if self._master_task is None and self._master is not None:
+            if master_live and self._master_task is None:
                 self._master_task = asyncio.ensure_future(self._master.recv())
                 self._master_task.add_done_callback(self._silence)
             if self._mux_task is None:
@@ -727,16 +733,17 @@ class ConsensusAgent:
             )
             if not done:
                 break  # quiet: no straggler left waiting on us
-            try:
-                if self._master_task in done:
-                    self._master_task = None  # Done/Shutdown etc.: ignore
-                    continue
-                token, msg, _stream = self._mux_task.result()
-                self._mux_task = None
-                if isinstance(msg, P.ValueRequest):
-                    await self._answer(token, msg)
-            except Exception:
-                break  # a dying fabric must not block teardown
+            if self._master_task is not None and self._master_task in done:
+                self._master_task = None
+                master_live = False
+            if self._mux_task is not None and self._mux_task in done:
+                try:
+                    token, msg, _stream = self._mux_task.result()
+                    self._mux_task = None
+                    if isinstance(msg, P.ValueRequest):
+                        await self._answer(token, msg)
+                except Exception:
+                    break  # a dying fabric must not block teardown
         self._mux.close()
         for task in (self._master_task, self._mux_task):
             if task is not None:

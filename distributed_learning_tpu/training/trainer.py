@@ -920,10 +920,11 @@ class GossipTrainer:
         import warnings
 
         restored = None
-        with_choco = {**template, "choco": self._choco_tree()}
         if self._choco is not None:
             try:
-                restored = restore_checkpoint(path, with_choco)
+                restored = restore_checkpoint(
+                    path, {**template, "choco": self._choco_tree()}
+                )
             except Exception as exc:
                 if not _is_structure_mismatch(exc):
                     raise
@@ -948,7 +949,9 @@ class GossipTrainer:
                     "checkpoint contains CHOCO state but this trainer has "
                     "no compression; the estimates are ignored"
                 )
-                restored = restore_checkpoint(path, with_choco)
+                restored = restore_checkpoint(
+                    path, {**template, "choco": self._choco_tree()}
+                )
                 restored.pop("choco", None)
         self._state = (
             restored["params"],

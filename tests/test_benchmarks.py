@@ -29,6 +29,24 @@ def test_bench_titanic_smoke(capsys):
         assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
 
 
+def test_bench_titanic_noniid_smoke(capsys, tmp_path):
+    from benchmarks import bench_titanic_noniid
+
+    # Explicit out_path keeps the committed curves file untouched.
+    out = bench_titanic_noniid.run(
+        iters=400, eval_every=100, out_path=str(tmp_path / "curves.json")
+    )
+    f = out["final"]
+    # The benchmark's claim at smoke scale: skewed-isolated is visibly
+    # worse than gossip, and gossip is in the centralized ballpark.
+    assert f["isolated"] < f["gossip"] - 0.05
+    assert abs(f["gossip"] - f["centralized"]) < 0.1
+    assert len(out["curves"]["gossip"]) == 4
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert lines[0]["metric"] == "titanic_noniid_gossip_test_accuracy"
+
+
 def test_bench_fast_averaging_smoke(capsys):
     from benchmarks import bench_fast_averaging
 
