@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from benchmarks import common
-from distributed_learning_tpu.data import load_titanic, split_data
+from distributed_learning_tpu.data import load_titanic, split_data, titanic_source
 from distributed_learning_tpu.models import logreg_loss
 from distributed_learning_tpu.models.logreg import accuracy as logreg_accuracy
 from distributed_learning_tpu.parallel import (
@@ -65,6 +65,7 @@ def run(
         iters = 100 if common.smoke() else 3000
     if eval_every is None:
         eval_every = max(1, iters // 60)
+    data_source = titanic_source()
     X_tr, y_tr, X_te, y_te = load_titanic()
     Xs, ys = _label_skewed_shards(X_tr, y_tr, N_AGENTS)
     dim = Xs.shape[-1]
@@ -144,6 +145,7 @@ def run(
             "unit": "accuracy",
             "vs_baseline": round(final["gossip"] / REFERENCE_ACC, 4),
             "config": f"titanic-labelskew-ring{N_AGENTS}-alpha{ALPHA}",
+            "data_source": data_source,
             "centralized": round(final["centralized"], 4),
             "isolated": round(final["isolated"], 4),
             "dsgt": round(final["dsgt"], 4),
@@ -153,9 +155,18 @@ def run(
         }
     )
 
-    out = out_path or os.path.join(
-        os.path.dirname(__file__), "results", "titanic_noniid_curves.json"
-    )
+    if out_path is None:
+        # The canonical filename is committed real-data full-scale
+        # evidence (cited by BASELINE.md); a smoke run or a synthetic
+        # fallback must never overwrite it, so those land in a
+        # disambiguated sibling instead.
+        canonical = data_source.startswith("real:") and iters >= 3000
+        name = (
+            "titanic_noniid_curves.json" if canonical
+            else f"titanic_noniid_curves_{'real' if data_source.startswith('real:') else 'synthetic'}_{iters}it.json"
+        )
+        out_path = os.path.join(os.path.dirname(__file__), "results", name)
+    out = out_path
     record = {
         "description": (
             "Label-sorted (maximally non-IID) Titanic shards, 4 agents, "
@@ -165,6 +176,7 @@ def run(
         "alpha": ALPHA,
         "tau": TAU,
         "iters": iters,
+        "data_source": data_source,
         "platform": common.platform(),
         "curves": curves,
         "final": final,

@@ -6,6 +6,7 @@ from distributed_learning_tpu.data.titanic import (
     prepare_rows,
     split_data,
     synthetic_titanic,
+    titanic_source,
 )
 from distributed_learning_tpu.data.cifar import (
     CIFAR_MEAN,
@@ -24,6 +25,7 @@ __all__ = [
     "prepare_rows",
     "split_data",
     "synthetic_titanic",
+    "titanic_source",
     "CIFAR_MEAN",
     "CIFAR_STD",
     "augment_batch",

@@ -109,6 +109,17 @@ def synthetic_titanic(
     return X, y
 
 
+def titanic_source(data_dir: str | None = None) -> str:
+    """Which dataset :func:`load_titanic` would use: ``"real:<dir>"`` or
+    ``"synthetic"``.  Benchmarks record this so synthetic-fallback results
+    can never masquerade as real-data evidence."""
+    dirs = [data_dir] if data_dir else [d for d in _DEFAULT_DIRS if d]
+    for d in dirs:
+        if os.path.exists(os.path.join(d, "train.csv")):
+            return f"real:{d}"
+    return "synthetic"
+
+
 def load_titanic(
     data_dir: str | None = None, *, test_fraction: float = 0.1
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -118,12 +129,9 @@ def load_titanic(
     Reads ``train.csv`` from ``data_dir`` or the first existing default
     directory; falls back to :func:`synthetic_titanic`.
     """
-    dirs = [data_dir] if data_dir else [d for d in _DEFAULT_DIRS if d]
-    for d in dirs:
-        path = os.path.join(d, "train.csv")
-        if os.path.exists(path):
-            X, y = prepare_rows(_read_csv(path))
-            break
+    source = titanic_source(data_dir)
+    if source.startswith("real:"):
+        X, y = prepare_rows(_read_csv(os.path.join(source[5:], "train.csv")))
     else:
         X, y = synthetic_titanic()
     n_test = int(len(X) * test_fraction)
