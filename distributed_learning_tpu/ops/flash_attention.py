@@ -1,4 +1,4 @@
-"""Fused flash attention as a Pallas TPU kernel.
+"""Fused flash attention as Pallas TPU kernels — forward AND backward.
 
 The single-device hot op behind the transformer path: O(T^2) attention
 computed blockwise with the online-softmax recurrence, so neither the
@@ -11,11 +11,35 @@ stream HBM->VMEM via the grid's implicit double-buffered DMA, matmuls hit
 the MXU with f32 accumulation, and the causal path skips the compute for
 fully-masked blocks.
 
+Training works through the kernel: a ``jax.custom_vjp`` supplies the
+standard recompute-based flash backward.  The forward additionally saves
+the per-row logsumexp of the scaled scores — lane-replicated to shape
+``(BH, T, 128)``, the layout the TPU Pallas lowering requires (the last
+two block dims must tile to (8, 128); a ``(1, block_q)`` block does not
+lower, as the real compiler taught this module the hard way).  The
+backward recomputes each score block from (Q, K) on the MXU instead of
+materializing the (T, T) probability matrix, and splits into two kernels
+so every accumulator is a sequential reduction over its innermost grid
+axis:
+
+* dQ kernel  — grid (BH, q-blocks, k-blocks): for one Q block, walk K/V
+  blocks accumulating dQ += scale * dS @ K with dS = P * (dP - delta),
+  P = exp(S - lse), dP = dO @ V^T, delta = rowsum(dO * O)  (computed
+  in-kernel from the O block — cheaper than materializing a (BH, T, 128)
+  delta tensor in HBM).
+* dK/dV kernel — grid (BH, k-blocks, q-blocks): for one K/V block, walk
+  Q blocks accumulating dV += P^T @ dO and dK += scale * dS^T @ Q.
+
+Head dims that do not fill a 128-lane tile are zero-padded to 128 before
+the kernels and sliced after — scores and softmax are unchanged by zero
+columns, and the pad/slice pair is differentiable, so the padding
+composes with the custom VJP.
+
 Context length is bounded by HBM, not VMEM.  Measured throughput comes
 from ``benchmarks/bench_attention.py`` (TFLOP/s at 8k/32k/131k with a
 block-size sweep); numbers live in ``BASELINE.json:"published"``, not
-here.  On CPU the same kernel runs under ``interpret=True`` for the
-tests; correctness bar: match
+here.  On CPU the same kernels run under ``interpret=True`` for the
+tests; correctness bar: values and gradients match
 :func:`~distributed_learning_tpu.ops.ring_attention.attention_reference`.
 """
 
@@ -35,15 +59,39 @@ from distributed_learning_tpu.ops.ring_attention import attention_reference
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30  # large-but-finite: exp(-1e30 - m) underflows to 0 cleanly
-_LANES = 128  # scratch vectors are lane-replicated to the native tile width
+_LANES = 128  # native tile width: scratch vectors and lse are lane-replicated
+
+
+def _causal_live(qi, kj, block_q, block_k):
+    """Whether block (qi, kj) holds any unmasked (row >= col) pair."""
+    return kj * block_k <= (qi + 1) * block_q - 1
+
+
+def _masked_scores(q, k_blk, qi, kj, block_q, block_k, sm_scale, causal):
+    """Scaled (block_q, block_k) scores with causal masking applied."""
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32) * sm_scale, k_blk.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(cols <= rows, s, _NEG_INF)
+    return s
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, sm_scale, causal
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, sm_scale, causal,
 ):
     """One (bh, qi, kj) grid step of the online-softmax recurrence."""
     qi, kj = pl.program_id(1), pl.program_id(2)
-    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
     nk = pl.num_programs(2)
 
@@ -55,26 +103,14 @@ def _flash_kernel(
 
     # Causal: blocks whose first key is beyond this q block's last query
     # are fully masked — skip their FLOPs entirely.
-    live = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+    live = _causal_live(qi, kj, block_q, block_k) if causal else True
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k_blk = k_ref[0].astype(jnp.float32)
+        s = _masked_scores(
+            q_ref[0], k_ref[0], qi, kj, block_q, block_k, sm_scale, causal
+        )
         v_blk = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(cols <= rows, s, _NEG_INF)
         m_prev = m_ref[:, :1]  # lane-replicated; any lane is the value
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -93,8 +129,220 @@ def _flash_kernel(
 
     @pl.when(kj == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l[:, :1]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # Per-row logsumexp of the SCALED scores — the backward's
+            # softmax normalizer, so P is recomputed without a second
+            # online pass.  Lane-replicated (block_q, 128): pure
+            # elementwise on the already-replicated m/l scratch, which the
+            # Mosaic lowering takes.  The primal (inference) path omits
+            # this output entirely rather than write-and-discard it.
+            lse_ref[0] = m_ref[...] + jnp.log(l)
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc,
+    *, sm_scale, causal,
+):
+    """dQ for one Q block: sequential accumulation over K/V blocks."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = _causal_live(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        s = _masked_scores(
+            q_ref[0], k_ref[0], qi, kj, block_q, block_k, sm_scale, causal
+        )
+        p = jnp.exp(s - lse_ref[0][:, :1])  # (bq, bk); masked entries -> 0
+        do = do_ref[0].astype(jnp.float32)
+        # delta_i = sum_d dO_id O_id, rowwise — recomputed per step; a
+        # (bq, D) multiply-reduce is noise next to the two MXU matmuls.
+        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)
+        dp = jax.lax.dot_general(  # dO @ V^T -> (bq, bk)
+            do, v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq_acc[...] += sm_scale * jax.lax.dot_general(  # dS @ K -> (bq, D)
+            ds, k_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, sm_scale, causal,
+):
+    """dK and dV for one K/V block: sequential accumulation over Q blocks."""
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = _causal_live(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q_blk = q_ref[0]
+        s = _masked_scores(
+            q_blk, k_ref[0], qi, kj, block_q, block_k, sm_scale, causal
+        )
+        p = jnp.exp(s - lse_ref[0][:, :1])  # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)
+        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)
+        dv_acc[...] += jax.lax.dot_general(  # P^T @ dO -> (bk, D)
+            p, do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(  # dO @ V^T -> (bq, bk)
+            do, v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk_acc[...] += sm_scale * jax.lax.dot_general(  # dS^T @ Q -> (bk, D)
+            ds, q_blk.astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
+              *, with_lse):
+    """Forward pallas_call; ``with_lse=False`` (the inference/primal path)
+    omits the lse output entirely so forward-only callers don't pay a
+    (BH, T, 128) f32 HBM write they would immediately discard."""
+    BH, T, D = qb.shape
+    kernel = functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal)
+    if not with_lse:
+        def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+            _flash_kernel(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref,
+                          l_ref, sm_scale=sm_scale, causal=causal)
+    o_spec = pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0))
+    lse_spec = pl.BlockSpec(
+        (1, block_q, _LANES), lambda bh, qi, kj: (bh, qi, 0)
+    )
+    o_shape = jax.ShapeDtypeStruct((BH, T, D), qb.dtype)
+    lse_shape = jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, T // block_q, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=[o_spec, lse_spec] if with_lse else o_spec,
+        out_shape=[o_shape, lse_shape] if with_lse else o_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
+    return _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                     interpret, with_lse=False)
+
+
+def _flash_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                         interpret, with_lse=True)
+    return out, (qb, kb, vb, out, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    qb, kb, vb, out, lse = res
+    BH, T, D = qb.shape
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, sm_scale=sm_scale, causal=causal),
+        grid=(BH, T // block_q, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kj: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), qb.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb, out, do, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, sm_scale=sm_scale, causal=causal),
+        grid=(BH, T // block_k, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, kj, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), kb.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), vb.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb, out, do, lse)
+
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
@@ -113,9 +361,12 @@ def flash_attention(
 ) -> jax.Array:
     """Fused attention on (B, T, H, D); T must divide by the block sizes.
 
-    Off-TPU without ``interpret`` this falls back to the reference
-    einsum/softmax path (XLA fuses it well enough on CPU; the kernel is
-    the TPU fast path).
+    Differentiable: gradients run through the Pallas backward kernels
+    (``jax.custom_vjp``), so the transformer's ``attention="flash"`` mode
+    trains on TPU.  Head dims off the 128-lane grid are zero-padded
+    through the kernels and sliced back.  Off-TPU without ``interpret``
+    this falls back to the reference einsum/softmax path (XLA fuses it
+    well enough on CPU; the kernel is the TPU fast path).
     """
     B, T, H, D = q.shape
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(D))
@@ -130,29 +381,17 @@ def flash_attention(
             f"({block_q}, {block_k})"
         )
 
-    # (B, T, H, D) -> (B*H, T, D): one grid row per (batch, head).
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    # The TPU lowering tiles the last two block dims to (8, 128): pad the
+    # head dim up to a lane multiple.  Zero K/Q columns leave every score
+    # unchanged; zero V columns produce zero output columns, sliced off.
+    Dp = max(_LANES, -(-D // _LANES) * _LANES)
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
 
-    kernel = functools.partial(_flash_kernel, sm_scale=scale, causal=causal)
-    out = pl.pallas_call(
-        kernel,
-        grid=(B * H, T // block_q, T // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(qb, kb, vb)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    # (B, T, H, D) -> (B*H, T, D): one grid row per (batch, head).
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, Dp)
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    out = _flash(qb, kb, vb, scale, causal, block_q, block_k, interpret)
+    out = out.reshape(B, H, T, Dp).transpose(0, 2, 1, 3)
+    return out[..., :D] if Dp != D else out

@@ -127,3 +127,41 @@ def test_bench_compression_smoke(capsys):
     assert r["value"] is not None and r["value"] > 0
     assert r["byte_reduction"] > 3
     assert r["final_residual"] < 1e-4
+
+
+def test_titanic_source_reports_real_or_synthetic(tmp_path, monkeypatch):
+    from distributed_learning_tpu.data import titanic_source
+
+    # Explicit missing dir -> synthetic fallback is disclosed.
+    assert titanic_source(str(tmp_path / "nope")) == "synthetic"
+    # A dir with train.csv -> real, naming the dir.
+    d = tmp_path / "titanic"
+    d.mkdir()
+    (d / "train.csv").write_text("PassengerId,Survived\n")
+    assert titanic_source(str(d)) == f"real:{d}"
+
+
+def test_noniid_default_outpath_never_clobbers_canonical(tmp_path, monkeypatch):
+    """A smoke-scale run must not land on the committed canonical curves
+    filename, and the record must disclose its data source."""
+    import os
+
+    from benchmarks import bench_titanic_noniid
+
+    results_dir = os.path.join(
+        os.path.dirname(bench_titanic_noniid.__file__), "results"
+    )
+    try:
+        out = bench_titanic_noniid.run(iters=100, eval_every=50)
+        written = [
+            f for f in os.listdir(results_dir)
+            if f.startswith("titanic_noniid_curves_") and "100it" in f
+        ]
+        assert written, "smoke run should write a disambiguated sibling file"
+        assert "data_source" in out
+    finally:
+        # Unconditional: a failed assert must not leave strays in the
+        # committed results directory.
+        for f in os.listdir(results_dir):
+            if f.startswith("titanic_noniid_curves_") and "100it" in f:
+                os.remove(os.path.join(results_dir, f))
