@@ -241,8 +241,11 @@ def _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
     omits the lse output entirely so forward-only callers don't pay a
     (BH, T, 128) f32 HBM write they would immediately discard."""
     BH, T, D = qb.shape
-    kernel = functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal)
-    if not with_lse:
+    if with_lse:
+        kernel = functools.partial(
+            _flash_kernel, sm_scale=sm_scale, causal=causal
+        )
+    else:
         def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
             _flash_kernel(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref,
                           l_ref, sm_scale=sm_scale, causal=causal)

@@ -28,6 +28,7 @@ from distributed_learning_tpu.parallel.consensus import (
 from distributed_learning_tpu.parallel.compression import (
     ChocoGossipEngine,
     top_k,
+    approx_top_k,
     random_k,
     scaled_sign,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "ExtraEngine",
     "ExtraState",
     "top_k",
+    "approx_top_k",
     "random_k",
     "scaled_sign",
     "GradientTrackingEngine",

@@ -53,6 +53,7 @@ Compressor = Callable[[jax.Array, jax.Array], jax.Array]
 
 __all__ = [
     "top_k",
+    "approx_top_k",
     "random_k",
     "scaled_sign",
     "identity",
@@ -72,7 +73,7 @@ def compressor_from_spec(spec: str) -> "Compressor":
         return identity()
     if name in ("sign", "scaled_sign"):
         return scaled_sign()
-    if name in ("topk", "top_k", "randk", "random_k"):
+    if name in ("topk", "top_k", "randk", "random_k", "atopk", "approx_top_k"):
         try:
             fraction = float(arg) if arg else 0.1
         except ValueError:
@@ -80,9 +81,14 @@ def compressor_from_spec(spec: str) -> "Compressor":
                 f"bad fraction in compressor spec {spec!r} (want e.g. "
                 f"'{name}:0.1')"
             ) from None
-        return top_k(fraction) if name in ("topk", "top_k") else random_k(fraction)
+        if name in ("topk", "top_k"):
+            return top_k(fraction)
+        if name in ("atopk", "approx_top_k"):
+            return approx_top_k(fraction)
+        return random_k(fraction)
     raise ValueError(
-        f"unknown compressor spec {spec!r} (want topk:F, randk:F, sign, none)"
+        f"unknown compressor spec {spec!r} (want topk:F, atopk:F, randk:F, "
+        f"sign, none)"
     )
 
 
@@ -99,6 +105,38 @@ def top_k(fraction: float) -> Compressor:
         flat = v.ravel()
         k = max(1, int(round(fraction * flat.size)))
         _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        out = jnp.zeros_like(flat).at[idx].set(flat[idx])
+        return out.reshape(v.shape)
+
+    return compress
+
+
+def approx_top_k(fraction: float, recall_target: float = 0.95) -> Compressor:
+    """Hardware-aware top-k: ``jax.lax.approx_max_k``, the TPU's native
+    bucketed selection, instead of the exact sort-based ``lax.top_k``.
+
+    Exact top-k at large dim is the wall-clock pathology of compressed
+    gossip on TPU (a 65k-entry sort per agent per round dwarfs the mixing
+    matmul).  The approximate op trades a bounded recall miss — it keeps
+    >= ``recall_target`` of the true top-k in expectation — for an
+    order-of-magnitude cheaper selection.  For CHOCO that is still a
+    delta-contractive compressor (the kept mass is a superset-biased
+    sample of the exact one), so convergence theory is unchanged with a
+    marginally smaller delta; measure with :func:`compressor_delta`.
+    """
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+    if not 0.0 < recall_target <= 1.0:
+        raise ValueError(
+            f"recall_target must be in (0, 1], got {recall_target}"
+        )
+
+    def compress(v: jax.Array, key: jax.Array) -> jax.Array:
+        flat = v.ravel()
+        k = max(1, int(round(fraction * flat.size)))
+        _, idx = jax.lax.approx_max_k(
+            jnp.abs(flat), k, recall_target=recall_target
+        )
         out = jnp.zeros_like(flat).at[idx].set(flat[idx])
         return out.reshape(v.shape)
 

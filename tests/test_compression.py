@@ -14,7 +14,9 @@ import pytest
 from distributed_learning_tpu.parallel import Topology
 from distributed_learning_tpu.parallel.compression import (
     ChocoGossipEngine,
+    approx_top_k,
     compressor_delta,
+    compressor_from_spec,
     identity,
     random_k,
     scaled_sign,
@@ -32,7 +34,8 @@ def _x0(seed=0):
 
 
 @pytest.mark.parametrize(
-    "comp", [top_k(0.1), random_k(0.25), scaled_sign(), identity()]
+    "comp", [top_k(0.1), approx_top_k(0.1), random_k(0.25), scaled_sign(),
+             identity()]
 )
 def test_compressors_are_contractive(comp):
     delta = compressor_delta(comp, dim=128, trials=30)
@@ -115,3 +118,38 @@ def test_identity_compressor_matches_plain_gossip_on_estimates():
     # gamma=1, delta=1: xhat == x after the first round; K_n Metropolis
     # mixes to the mean fast.
     assert float(res[-1]) < 1e-5
+
+
+def test_approx_top_k_matches_exact_at_high_recall():
+    """The TPU-native bucketed selection keeps (at least) nearly the same
+    mass as exact top-k; on CPU the op is exact, so outputs coincide."""
+    v = jnp.asarray(
+        np.random.default_rng(3).normal(size=(512,)).astype(np.float32)
+    )
+    exact = top_k(0.1)(v, jax.random.key(0))
+    approx = approx_top_k(0.1, recall_target=0.95)(v, jax.random.key(0))
+    kept_exact = float(jnp.sum(exact != 0))
+    kept_approx = float(jnp.sum(approx != 0))
+    assert kept_approx >= 0.9 * kept_exact
+    # Kept entries are a subset of v's entries (no value distortion).
+    mask = approx != 0
+    np.testing.assert_allclose(
+        np.asarray(approx[mask]), np.asarray(v[mask]), atol=0
+    )
+
+
+def test_choco_converges_with_approx_top_k():
+    W = Topology.ring(N).metropolis_weights()
+    eng = ChocoGossipEngine(W, approx_top_k(0.2), gamma=0.25)
+    st = eng.init(_x0())
+    st, res = eng.run(st, 400)
+    assert float(res[-1]) < 1e-3
+
+
+def test_compressor_from_spec_atopk():
+    comp = compressor_from_spec("atopk:0.25")
+    v = jnp.asarray(
+        np.random.default_rng(4).normal(size=(64,)).astype(np.float32)
+    )
+    out = comp(v, jax.random.key(0))
+    assert 0 < int(jnp.sum(out != 0)) <= 20
