@@ -109,10 +109,25 @@ def main() -> None:
     if args.trace:
         trace_dir = os.path.join(outdir, f"profile_{stamp}")
         sps = _measure_config(**base, trace_dir=trace_dir)
-        print(json.dumps({
+        rec = {
             "metric": "profile_trace", "samples_per_sec": round(sps, 1),
             "trace_dir": trace_dir,
-        }))
+        }
+        try:
+            from distributed_learning_tpu.utils.profiling import (
+                format_trace_summary, summarize_trace,
+            )
+            rows = summarize_trace(trace_dir, top=20)
+            rec["top_ops"] = rows
+            # Persist the computed table BEFORE the cosmetic print: a
+            # formatting hiccup must not discard the summary artifact.
+            with open(os.path.join(outdir,
+                                   f"profile_summary_{stamp}.json"), "w") as f:
+                json.dump(rec, f, indent=1)
+            print(format_trace_summary(rows))
+        except Exception as exc:  # missing xprof / empty trace: keep the dir
+            rec["summary_error"] = f"{type(exc).__name__}: {exc}"
+        print(json.dumps({k: v for k, v in rec.items() if k != "top_ops"}))
         return
 
     ablations: dict[str, dict] = {

@@ -22,7 +22,7 @@ import logging
 import time
 from typing import Iterator, Optional
 
-__all__ = ["trace", "annotate", "DebugLogger"]
+__all__ = ["trace", "annotate", "DebugLogger", "summarize_trace", "format_trace_summary"]
 
 
 @contextlib.contextmanager
@@ -75,3 +75,92 @@ class DebugLogger:
         reference's Mixer debug lines printed, ``mixer.py:37,54``)."""
         self.residuals.append((round_idx, float(residual)))
         self.debug(f"round {round_idx}: residual {residual:.3e}")
+
+
+def _as_percent(row: dict):
+    """Self-time share in percent regardless of source tool:
+    ``framework_op_stats`` reports 0-100 percents, ``hlo_stats`` reports
+    0-1 fractions.  Explicit None checks — a legitimate 0.0 must not
+    fall through to the other column."""
+    pct = row.get("device_total_self_time_percent")
+    if pct is not None:
+        return pct
+    frac = row.get("total_self_time_as_fraction")
+    if frac is not None:
+        return frac * 100.0
+    return None
+
+
+def summarize_trace(
+    log_dir: str, *, top: int = 15, tool: str = "framework_op_stats"
+) -> list:
+    """Digest a ``jax.profiler`` trace into the top-``top`` ops by
+    self-time — the "where did the step go" table, without TensorBoard.
+
+    Parses the ``.xplane.pb`` files under ``log_dir`` with xprof's
+    converter (the TensorBoard profile plugin's own backend).  Returns a
+    list of dicts sorted by total self-time, each with ``operation``,
+    ``type``, ``occurrences``, ``total_self_us``, ``avg_self_us``, and
+    (on device rows) ``device_self_pct``.  Raises ``FileNotFoundError``
+    when the dir holds no xplanes and ``ImportError`` when xprof isn't
+    installed — callers decide whether that is fatal.
+    """
+    import glob
+    import json as _json
+
+    paths = sorted(
+        glob.glob(f"{log_dir}/**/*.xplane.pb", recursive=True)
+    )
+    if not paths:
+        raise FileNotFoundError(f"no .xplane.pb under {log_dir}")
+    from xprof.convert import raw_to_tool_data as _rtd  # tensorboard plugin
+
+    # No output-format option: the converter returns gviz-DataTable JSON,
+    # which is exactly what the parser below consumes.
+    data, _ = _rtd.xspace_to_tool_data(paths, tool, {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    table = _json.loads(data)
+    # DataTable-style payload: a list of {"cols": [...], "rows": [...]}
+    # blocks (framework_op_stats emits device and host tables separately).
+    blocks = table if isinstance(table, list) else [table]
+    out = []
+    for block in blocks:
+        if not isinstance(block, dict) or "cols" not in block:
+            continue
+        cols = [c["id"] for c in block["cols"]]
+        for r in block.get("rows") or block.get("data") or []:
+            cells = r.get("c") if isinstance(r, dict) else r
+            row = dict(zip(cols, [
+                c.get("v") if isinstance(c, dict) else c for c in cells
+            ]))
+            # Column ids differ per tool (framework_op_stats vs
+            # hlo_stats); coalesce the common concepts.
+            out.append({
+                "operation": row.get("operation") or row.get("hlo_op_name")
+                or row.get("hlo_op_expression"),
+                "type": row.get("type") or row.get("category"),
+                "host_or_device": row.get("host_or_device"),
+                "occurrences": row.get("occurrences"),
+                "total_self_us": row.get("total_self_time")
+                or row.get("total_self_time_us"),
+                "avg_self_us": row.get("avg_self_time")
+                or row.get("avg_self_time_us"),
+                "device_self_pct": _as_percent(row),
+            })
+    out.sort(key=lambda d: -(d["total_self_us"] or 0.0))
+    return out[:top]
+
+
+def format_trace_summary(rows: list) -> str:
+    """Readable table for :func:`summarize_trace` output."""
+    lines = [
+        f"{'self us':>12} {'avg us':>10} {'n':>6} {'where':>6}  operation"
+    ]
+    for r in rows:
+        lines.append(
+            f"{(r['total_self_us'] or 0):12.1f} {(r['avg_self_us'] or 0):10.2f} "
+            f"{int(r['occurrences'] or 0):6d} {(r['host_or_device'] or '?'):>6}  "
+            f"{(r['type'] or '')}: {str(r['operation'] or '')[:70]}"
+        )
+    return "\n".join(lines)
