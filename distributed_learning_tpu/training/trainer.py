@@ -30,6 +30,7 @@ destructive under permutation symmetry).
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Callable, Dict, Hashable, List, Mapping, Optional, Sequence, Tuple
 
 import jax
@@ -400,6 +401,22 @@ class GossipTrainer:
         if weights is None and topology_schedule is not None:
             weights = topology_schedule(0)
         W = resolve_mixing_matrix(weights, self.node_names)
+        if (n > 1 and topology_schedule is None
+                and np.allclose(W, np.eye(n))):
+            # With a topology_schedule the epoch-0 graph may legitimately
+            # be edgeless (time-varying B-connected schedules); only the
+            # static case is a guaranteed no-gossip run.
+            # Documented (weights=None -> isolated nodes), but silently
+            # training n disconnected replicas while train_epoch reports
+            # mixed=True is the kind of footgun that wastes a run: say so
+            # once, loudly.
+            warnings.warn(
+                "GossipTrainer: mixing matrix is the identity (weights=None"
+                " or an edgeless topology) — nodes will train in isolation"
+                " with no gossip. Pass weights=Topology.ring(n) (or any"
+                " connected topology/matrix) for consensus training.",
+                stacklevel=2,
+            )
         self.engine = ConsensusEngine(W, mesh=mesh)
         if self._compression is not None:
             from distributed_learning_tpu.parallel.compression import (

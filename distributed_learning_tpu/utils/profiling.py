@@ -135,17 +135,23 @@ def summarize_trace(
                 c.get("v") if isinstance(c, dict) else c for c in cells
             ]))
             # Column ids differ per tool (framework_op_stats vs
-            # hlo_stats); coalesce the common concepts.
+            # hlo_stats); coalesce the common concepts.  Numeric fields
+            # use first-non-None (not `or`): a legitimate 0.0 must not
+            # fall through to the other tool's absent column.
+            first = lambda *keys: next(
+                (row[k] for k in keys if row.get(k) is not None), None
+            )
             out.append({
-                "operation": row.get("operation") or row.get("hlo_op_name")
-                or row.get("hlo_op_expression"),
-                "type": row.get("type") or row.get("category"),
+                "operation": first(
+                    "operation", "hlo_op_name", "hlo_op_expression"
+                ),
+                "type": first("type", "category"),
                 "host_or_device": row.get("host_or_device"),
                 "occurrences": row.get("occurrences"),
-                "total_self_us": row.get("total_self_time")
-                or row.get("total_self_time_us"),
-                "avg_self_us": row.get("avg_self_time")
-                or row.get("avg_self_time_us"),
+                "total_self_us": first(
+                    "total_self_time", "total_self_time_us"
+                ),
+                "avg_self_us": first("avg_self_time", "avg_self_time_us"),
                 "device_self_pct": _as_percent(row),
             })
     out.sort(key=lambda d: -(d["total_self_us"] or 0.0))

@@ -1,0 +1,94 @@
+"""Decentralized language-model training: GossipTrainer x TransformerLM.
+
+The reference has no sequence models anywhere (SURVEY.md §5); this demo
+is the beyond-parity composition the framework enables: the same
+``MasterNode``-surface trainer that drives the vision zoo trains a
+decoder-only transformer with per-node token shards, local steps, and
+per-epoch ring gossip.  The ``cross_entropy`` loss and argmax metric
+broadcast over the sequence dimension, so nothing LM-specific is needed
+in the trainer.
+
+The corpus is a synthetic token-cycle task (vocab 16, window 8) dealt
+genuinely non-IID: node a only sees windows starting in its own quarter
+of the cycle, so the next-token transitions for ~4 of the 16 tokens
+NEVER appear in its shard.  An isolated node therefore caps out around
+75-80%% next-token accuracy on the full-cycle test set; after gossip
+every node answers the transitions it never saw — the Titanic-notebook
+agreement check, restated for sequences with real knowledge transfer.
+
+Run:  python -m examples.lm_gossip
+Env knobs (rot-guard fast path): LMG_EPOCHS, LMG_SEQS, LMG_NODES.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from distributed_learning_tpu.models.transformer import TransformerLM
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.training.trainer import GossipTrainer
+
+VOCAB, T = 16, 8
+
+
+def pattern_batch(n_seq: int, phases):
+    """x = cyclic windows starting only at the given ``phases``; y = next
+    token.  With T + 1 < VOCAB a window covers a strict arc of the cycle,
+    so restricting the start phases genuinely hides transitions."""
+    phases = np.asarray(list(phases))
+    starts = phases[np.arange(n_seq) % len(phases)]
+    seq = (starts[:, None] + np.arange(T + 1)[None, :]) % VOCAB
+    return seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+
+
+def node_phases(a: int, n_nodes: int) -> range:
+    """Node ``a``'s quarter (generally ``1/n_nodes``-arc) of the cycle."""
+    width = VOCAB // n_nodes
+    return range(width * a, width * (a + 1))
+
+
+def main() -> None:
+    n_nodes = int(os.environ.get("LMG_NODES", 4))
+    n_seq = int(os.environ.get("LMG_SEQS", 64))
+    epochs = int(os.environ.get("LMG_EPOCHS", 20))
+
+    nodes = list(range(n_nodes))
+    train = {a: pattern_batch(n_seq, node_phases(a, n_nodes)) for a in nodes}
+    test = pattern_batch(32, range(VOCAB))  # every phase: ~1/n unseen per node
+
+    trainer = GossipTrainer(
+        node_names=nodes,
+        model=TransformerLM(
+            vocab_size=VOCAB, num_layers=1, num_heads=2, head_dim=8,
+            max_len=T,
+        ),
+        optimizer="adam",
+        learning_rate=3e-3,
+        error="cross_entropy",
+        weights=Topology.ring(n_nodes),
+        train_data=train,
+        test_data=test,
+        epoch=epochs,
+        batch_size=16,
+        mix_times=8,
+        stat_step=1000,
+        dropout=False,
+        eval_batch_size=16,
+        seed=0,
+    )
+    trainer.initialize_nodes()
+    for _ in range(epochs):
+        payload = trainer.train_epoch()
+    accs = payload["test_acc"]
+    print(
+        f"nodes={n_nodes} epochs={epochs} "
+        f"final train_loss={float(payload['train_loss'].mean()):.4f} "
+        f"next-token acc per node={np.round(np.asarray(accs), 4).tolist()} "
+        f"deviation={payload['deviation']:.2e}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -177,3 +177,17 @@ def test_tcp_consensus_example_pair():
             master.wait(timeout=30)
         except subprocess.TimeoutExpired:
             master.kill()
+
+
+def test_lm_gossip_example():
+    out = _run(
+        "lm_gossip",
+        env_extra={"LMG_EPOCHS": "6", "LMG_SEQS": "32"},
+    )
+    # Computed-output assert: the per-node accuracies must parse and the
+    # short run must beat chance (1/16) decisively; the full-budget run
+    # (tests/test_trainer_lm.py) pins the >0.95 knowledge-transfer claim.
+    m = re.search(r"acc per node=\[([0-9., ]+)\]", out)
+    assert m, out
+    accs = [float(v) for v in m.group(1).split(",")]
+    assert len(accs) == 4 and min(accs) > 0.12, out

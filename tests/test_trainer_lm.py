@@ -1,0 +1,64 @@
+"""GossipTrainer x TransformerLM: decentralized language-model training.
+
+The reference has no sequence models at all (SURVEY.md §5), so this is
+beyond-parity coverage: the C16-replacement trainer drives the
+transformer exactly like the vision models — per-node token shards,
+local steps, per-epoch gossip — because the ``cross_entropy`` loss and
+argmax metric broadcast over the sequence dimension unchanged.
+
+The corpus (shared with ``examples/lm_gossip.py``) is genuinely non-IID:
+with vocab 16 and window 8, each node's start phases are restricted to
+its quarter of the cycle, so ~4 of the 16 next-token transitions never
+appear in its shard.  A node training alone caps out well below full
+accuracy on the all-phase test set; after gossip every node must answer
+the transitions it never saw.
+"""
+
+import pytest
+
+from distributed_learning_tpu.models.transformer import TransformerLM
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.training.trainer import GossipTrainer
+
+# Corpus generator shared with the runnable demo — one copy to keep honest
+# (examples are importable from the repo root, as the rot-guard tests do).
+from examples.lm_gossip import VOCAB, T, node_phases, pattern_batch
+
+
+@pytest.mark.slow
+def test_gossip_trainer_trains_transformer_lm():
+    nodes = list(range(4))
+    train = {a: pattern_batch(64, node_phases(a, 4)) for a in nodes}
+    X_test, y_test = pattern_batch(32, range(VOCAB))
+
+    trainer = GossipTrainer(
+        node_names=nodes,
+        model=TransformerLM(
+            vocab_size=VOCAB, num_layers=1, num_heads=2, head_dim=8,
+            max_len=T,
+        ),
+        optimizer="adam",
+        learning_rate=3e-3,
+        error="cross_entropy",
+        weights=Topology.ring(4),
+        train_data=train,
+        test_data=(X_test, y_test),
+        epoch=20,
+        mix_times=8,
+        batch_size=16,
+        stat_step=1000,
+        dropout=False,
+        eval_batch_size=16,
+        seed=0,
+    )
+    trainer.initialize_nodes()
+    first = trainer.train_epoch()
+    for _ in range(trainer.num_epochs - 1):
+        last = trainer.train_epoch()
+
+    assert last["train_loss"].mean() < first["train_loss"].mean()
+    accs = last["test_acc"]  # computed by train_epoch's own eval
+    # The cycle is deterministic: after gossip every node must know it,
+    # including on phases it never saw (the non-IID point).
+    assert accs.mean() > 0.95, accs
+    assert accs.std() < 0.05, accs
