@@ -1,0 +1,10 @@
+"""Run only the flash-attention benchmark (fwd + bwd TFLOP/s).
+
+Split out of ``run_all`` so the recovery session can put the kernels'
+first on-chip validation ahead of the longer stages.
+"""
+
+from benchmarks import bench_attention
+
+if __name__ == "__main__":
+    bench_attention.run()

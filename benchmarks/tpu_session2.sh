@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Round-3 recovery session: the measurements still pending after the
+# first session's tunnel wedge, highest-value first so a short healthy
+# window still captures the top of the list.  Serialized (the tunneled
+# chip is single-process); every stage runs under `timeout` so one wedge
+# cannot eat the window.
+#
+#   bash benchmarks/tpu_session2.sh [outdir]
+#
+# Stages:
+#   0. 60s liveness probe (tiny jit) — abort early on a dead tunnel
+#   1. flash-attention TFLOP/s, fwd + bwd (validates the Pallas kernels'
+#      first on-chip compile after the layout fix)
+#   2. WRN profile ablations (+ a profiler trace with top-ops summary)
+#   3. WRN accuracy stage (synthetic stand-in unless DLT_CIFAR_DIR)
+#   4. compression rounds/bytes at the TPU-sized dim (incl. atopk)
+#   5. publish everything captured into BASELINE.json
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+OUT="${1:-benchmarks/results}"
+mkdir -p "$OUT"
+STAMP=$(date +%Y%m%d_%H%M%S)
+CAPTURE="$OUT/session2_$STAMP.jsonl"
+
+echo "== stage 0: liveness probe" >&2
+if ! timeout 60 python -u -c \
+  "import jax, jax.numpy as j; jax.jit(lambda a: a.sum())(j.ones((8,8))).block_until_ready(); print('alive')"; then
+  echo "tunnel not alive; aborting session2" >&2
+  exit 3
+fi
+
+echo "== stage 1: flash attention fwd+bwd TFLOP/s" >&2
+BENCH_OUT="$CAPTURE" timeout 1800 python -m benchmarks.run_attention_only \
+  2>"$OUT/attention_$STAMP.err" || echo "stage 1 rc=$?" >&2
+
+echo "== stage 2: WRN profile ablations" >&2
+timeout 3600 python -m benchmarks.profile_wrn \
+  2>"$OUT/profile_$STAMP.err" | tee -a "$OUT/profile_$STAMP.out" \
+  || echo "stage 2 rc=$?" >&2
+echo "== stage 2b: profiler trace + top-ops summary" >&2
+timeout 1200 python -m benchmarks.profile_wrn --trace \
+  2>>"$OUT/profile_$STAMP.err" | tee -a "$OUT/profile_$STAMP.out" \
+  || echo "stage 2b rc=$?" >&2
+
+echo "== stage 3: WRN accuracy" >&2
+ACC_JSON="$OUT/wrn_accuracy_$STAMP.json"
+if timeout 4500 python -m benchmarks.train_wrn_accuracy --out "$ACC_JSON" \
+  2>"$OUT/wrn_accuracy_$STAMP.err"; then
+  python - "$ACC_JSON" >>"$CAPTURE" <<'EOF'
+import json, sys
+print(json.dumps(json.load(open(sys.argv[1]))["summary"]))
+EOF
+else
+  echo "stage 3 rc=$?" >&2
+fi
+
+echo "== stage 4: compression (TPU-sized, incl. atopk)" >&2
+BENCH_OUT="$CAPTURE" timeout 1800 python -c \
+  "from benchmarks import bench_compression; bench_compression.run()" \
+  2>"$OUT/compression_$STAMP.err" || echo "stage 4 rc=$?" >&2
+
+echo "== stage 5: publish" >&2
+[ -s "$CAPTURE" ] && python -m benchmarks.publish "$CAPTURE"
+echo "session2 artifacts in $OUT (stamp $STAMP)" >&2
