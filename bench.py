@@ -51,13 +51,18 @@ BASELINE_SAMPLES_PER_SEC = 100 * 50_000 / 29_887.0  # T4, BASELINE.md
 
 
 def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
-                mix=True):
+                mix=True, pregather=False):
     """One jitted, donated epoch: scan of vmapped train steps + one gossip
     round (the trainer's per-epoch mixing cadence).
 
     ``unroll``/``remat`` default to the ``BENCH_UNROLL``/``BENCH_REMAT``
     env knobs; ``benchmarks/profile_wrn.py`` passes them (and ``mix``)
     explicitly so its ablations measure this exact program.
+    ``pregather`` is an ablation-only variant: materialize every batch
+    with one big device-side gather before the scan instead of a
+    ``take`` per step — attributing the in-scan gather's cost (the
+    trainer uses in-scan gathers to avoid materializing the permuted
+    epoch tensor; this measures what that choice pays).
     """
     if unroll is None:
         unroll = int(os.environ.get("BENCH_UNROLL", 2))
@@ -91,17 +96,23 @@ def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
     take = jax.vmap(lambda X, i: jnp.take(X, i, axis=0))
 
     def epoch(state, Xs, ys, idx):
-        def body(carry, idx_t):
+        def step(carry, x, y):
             params, bs, opt, rng = carry
-            x = take(Xs, idx_t)
-            y = take(ys, idx_t)
             rng, *subs = jax.random.split(rng, n_agents + 1)
             params, bs, opt, loss = vstep(params, bs, opt, x, y, jnp.stack(subs))
             return (params, bs, opt, rng), loss
 
-        (params, bs, opt, rng), losses = jax.lax.scan(
-            body, state, idx, unroll=unroll
-        )
+        if pregather:
+            Xb = jax.vmap(lambda it: take(Xs, it))(idx)  # (steps, n, B, ...)
+            yb = jax.vmap(lambda it: take(ys, it))(idx)
+            (params, bs, opt, rng), losses = jax.lax.scan(
+                lambda c, xy: step(c, *xy), state, (Xb, yb), unroll=unroll
+            )
+        else:
+            (params, bs, opt, rng), losses = jax.lax.scan(
+                lambda c, it: step(c, take(Xs, it), take(ys, it)),
+                state, idx, unroll=unroll,
+            )
         if mix:
             params = engine._dense_mix_once(params)
         return (params, bs, opt, rng), losses
@@ -112,7 +123,7 @@ def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
 
 def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
                        pool=None, unroll=None, remat=None, mix=True,
-                       trace_dir=None, on_first_op=None):
+                       pregather=False, trace_dir=None, on_first_op=None):
     """Steady-state samples/sec of :func:`build_epoch` on random resident
     data — the shared harness behind ``bench.py`` and
     ``benchmarks/profile_wrn.py``.
@@ -127,7 +138,7 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
     if pool is None:
         pool = steps * batch
     run_epoch = build_epoch(model, tx, engine, n_agents, unroll=unroll,
-                            remat=remat, mix=mix)
+                            remat=remat, mix=mix, pregather=pregather)
 
     rng = jax.random.key(0)
     x0 = jnp.ones((batch, 32, 32, 3), jnp.float32)

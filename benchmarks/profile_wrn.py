@@ -18,6 +18,7 @@ shipped epoch program:
    - ``no_weight_decay`` drop the decoupled weight-decay chain link
    - ``unroll1/4``     scan unroll factor (shipped: 2)
    - ``remat``         rematerialized backward (HBM for FLOPs trade)
+   - ``pregather``     one big batch gather before the scan (vs per-step)
    - ``f32_conv``      params/compute in f32 (quantifies the bf16 win)
 
 Usage (serialized on the tunneled chip — never concurrently with other
@@ -66,6 +67,7 @@ def _measure_config(
     weight_decay: bool = True,
     unroll: int = 2,
     remat: bool = False,
+    pregather: bool = False,
     dtype=jnp.bfloat16,
     trace_dir: str | None = None,
 ) -> float:
@@ -81,7 +83,7 @@ def _measure_config(
     return bench.measure_throughput(
         model, tx, engine, n_agents=n_agents, batch=batch, steps=steps,
         epochs=epochs, unroll=unroll, remat=remat, mix=mix,
-        trace_dir=trace_dir,
+        pregather=pregather, trace_dir=trace_dir,
     )
 
 
@@ -138,6 +140,7 @@ def main() -> None:
         "unroll1": {"unroll": 1},
         "unroll4": {"unroll": 4},
         "remat": {"remat": True},
+        "pregather": {"pregather": True},
         "f32_conv": {"dtype": jnp.float32},
     }
     if args.only:
