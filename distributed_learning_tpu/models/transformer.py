@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from distributed_learning_tpu.ops.ring_attention import (
     attention_reference,
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
 )
 
@@ -48,6 +49,10 @@ class _Attention(nn.Module):
             out = flash_attention(q, k, v, causal=True)
         elif self.attn_impl == "ring":
             out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        elif self.attn_impl == "ring_flash":
+            out = ring_flash_attention(
+                q, k, v, axis_name=self.seq_axis, causal=True
+            )
         elif self.attn_impl == "ulysses":
             out = ulysses_attention(q, k, v, axis_name=self.seq_axis, causal=True)
         else:

@@ -56,10 +56,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 from distributed_learning_tpu.ops.ring_attention import attention_reference
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30  # large-but-finite: exp(-1e30 - m) underflows to 0 cleanly
 _LANES = 128  # native tile width: scratch vectors and lse are lane-replicated
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct matching ``like``'s varying-manual-axes: under
+    ``shard_map`` (ring flash attention) pallas outputs must declare
+    their vma or the shard_map vma check rejects the call; under plain
+    jit the vma set is empty and this is an ordinary SDS."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _causal_live(qi, kj, block_q, block_k):
@@ -142,10 +153,17 @@ def _flash_kernel(
 
 
 def _flash_dq_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc,
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dadj_ref, dq_ref, dq_acc,
     *, sm_scale, causal,
-):
-    """dQ for one Q block: sequential accumulation over K/V blocks."""
+):  # dadj_ref is None on the plain path (no lse consumer): zero term.
+    """dQ for one Q block: sequential accumulation over K/V blocks.
+
+    ``dadj`` is a per-row additive adjustment to the softmax backward:
+    ``dS = P * (dP - delta + dadj)``.  Zero for plain attention; the lse
+    cotangent when the caller consumes the logsumexp output too (ring
+    flash attention combines blocks through their lse, so d loss/d lse
+    is generally nonzero — the math folds it into exactly this term).
+    """
     qi, kj = pl.program_id(1), pl.program_id(2)
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
@@ -173,7 +191,8 @@ def _flash_dq_kernel(
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
+        adj = 0.0 if dadj_ref is None else dadj_ref[0][:, :1]
+        ds = p * (dp - delta + adj)
         dq_acc[...] += sm_scale * jax.lax.dot_general(  # dS @ K -> (bq, D)
             ds, k_ref[0].astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -186,10 +205,11 @@ def _flash_dq_kernel(
 
 
 def _flash_dkv_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dadj_ref, dk_ref, dv_ref,
     dk_acc, dv_acc, *, sm_scale, causal,
 ):
-    """dK and dV for one K/V block: sequential accumulation over Q blocks."""
+    """dK and dV for one K/V block: sequential accumulation over Q blocks.
+    ``dadj`` as in :func:`_flash_dq_kernel`."""
     kj, qi = pl.program_id(1), pl.program_id(2)
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
@@ -222,7 +242,8 @@ def _flash_dkv_kernel(
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
+        adj = 0.0 if dadj_ref is None else dadj_ref[0][:, :1]
+        ds = p * (dp - delta + adj)
         dk_acc[...] += sm_scale * jax.lax.dot_general(  # dS^T @ Q -> (bk, D)
             ds, q_blk.astype(jnp.float32),
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -253,8 +274,8 @@ def _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
     lse_spec = pl.BlockSpec(
         (1, block_q, _LANES), lambda bh, qi, kj: (bh, qi, 0)
     )
-    o_shape = jax.ShapeDtypeStruct((BH, T, D), qb.dtype)
-    lse_shape = jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32)
+    o_shape = _sds((BH, T, D), qb.dtype, qb)
+    lse_shape = _sds((BH, T, _LANES), jnp.float32, qb)
     return pl.pallas_call(
         kernel,
         grid=(BH, T // block_q, T // block_k),
@@ -277,6 +298,88 @@ def _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
     )(qb, kb, vb)
 
 
+def _bwd_call(qb, kb, vb, out, do, lse, dadj, sm_scale, causal, block_q,
+              block_k, interpret):
+    """The two backward pallas_calls, shared by both custom VJPs.
+
+    ``dadj=None`` (the plain path — no lse consumer) omits the extra
+    kernel input entirely instead of streaming a known-zero tensor
+    through both kernels' grids."""
+    BH, T, D = qb.shape
+    lse_spec_q = pl.BlockSpec(
+        (1, block_q, _LANES), lambda bh, qi, kj: (bh, qi, 0)
+    )
+    lse_spec_kv = pl.BlockSpec(
+        (1, block_q, _LANES), lambda bh, kj, qi: (bh, qi, 0)
+    )
+    extra = [] if dadj is None else [dadj]
+
+    dq_kernel = functools.partial(
+        _flash_dq_kernel, sm_scale=sm_scale, causal=causal
+    )
+    if dadj is None:
+        def dq_kernel(q, k, v, o, do_, lse_, dq_, acc):
+            _flash_dq_kernel(q, k, v, o, do_, lse_, None, dq_, acc,
+                             sm_scale=sm_scale, causal=causal)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, T // block_q, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            lse_spec_q,
+        ] + ([] if dadj is None else [lse_spec_q]),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=_sds((BH, T, D), qb.dtype, qb),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb, out, do, lse, *extra)
+
+    dkv_kernel = functools.partial(
+        _flash_dkv_kernel, sm_scale=sm_scale, causal=causal
+    )
+    if dadj is None:
+        def dkv_kernel(q, k, v, o, do_, lse_, dk_, dv_, ka, va):
+            _flash_dkv_kernel(q, k, v, o, do_, lse_, None, dk_, dv_, ka, va,
+                              sm_scale=sm_scale, causal=causal)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, T // block_k, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
+            lse_spec_kv,
+        ] + ([] if dadj is None else [lse_spec_kv]),
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+        ],
+        out_shape=[
+            _sds((BH, T, D), kb.dtype, qb),
+            _sds((BH, T, D), vb.dtype, qb),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb, out, do, lse, *extra)
+
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
     return _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k,
@@ -291,61 +394,46 @@ def _flash_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     qb, kb, vb, out, lse = res
-    BH, T, D = qb.shape
-
-    dq = pl.pallas_call(
-        functools.partial(_flash_dq_kernel, sm_scale=sm_scale, causal=causal),
-        grid=(BH, T // block_q, T // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kj: (bh, qi, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), qb.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(qb, kb, vb, out, do, lse)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_dkv_kernel, sm_scale=sm_scale, causal=causal),
-        grid=(BH, T // block_k, T // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda bh, kj, qi: (bh, qi, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), kb.dtype),
-            jax.ShapeDtypeStruct((BH, T, D), vb.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(qb, kb, vb, out, do, lse)
-
-    return dq, dk, dv
+    dadj = jnp.zeros_like(lse)  # no lse consumer -> no adjustment
+    return _bwd_call(qb, kb, vb, out, do, lse, dadj, sm_scale, causal,
+                     block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
+    """Like :func:`_flash` but also returns the per-row logsumexp
+    (lane-replicated (BH, T, 128) f32) — the building block for ring
+    flash attention, whose cross-block combine differentiates through
+    lse.  d lse/d s_rc = p_rc, which folds into the shared backward as
+    the ``dadj`` row term."""
+    return _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                     interpret, with_lse=True)
+
+
+def _flash_lse_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                         interpret, with_lse=True)
+    return (out, lse), (qb, kb, vb, out, lse)
+
+
+def _flash_lse_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
+    qb, kb, vb, out, lse = res
+    do, dlse = cts
+    # The primal lse is lane-replicated: the true per-row cotangent is the
+    # SUM over lanes of the replicated output's cotangents (a consumer
+    # that only read lane 0 leaves the rest zero — summing is exact
+    # either way).  Re-broadcast so the kernel can read any lane.
+    dadj = jnp.broadcast_to(
+        jnp.sum(dlse, axis=-1, keepdims=True), dlse.shape
+    )
+    return _bwd_call(qb, kb, vb, out, do, lse, dadj, sm_scale, causal,
+                     block_q, block_k, interpret)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 @functools.partial(
@@ -398,3 +486,63 @@ def flash_attention(
     out = _flash(qb, kb, vb, scale, causal, block_q, block_k, interpret)
     out = out.reshape(B, H, T, Dp).transpose(0, 2, 1, 3)
     return out[..., :D] if Dp != D else out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret")
+)
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp of the scaled scores, shape (B, H, T) f32 — the quantity
+    that lets independent attention pieces be combined exactly
+    (``ops.ring_attention.ring_flash_attention`` merges per-device block
+    results through it).  Fully differentiable: the lse cotangent folds
+    into the backward kernels' ``dadj`` row term.
+
+    Off-TPU without ``interpret`` this computes the reference path plus a
+    JAX logsumexp — same semantics, XLA-fused, differentiable.
+    """
+    B, T, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(D))
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu and not interpret:
+        # One O(T^2) score tensor feeds both outputs (attention_reference
+        # would compute the same scores a second time).
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        probs = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B, H, T)
+        return out, lse
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(
+            f"sequence length {T} must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    Dp = max(_LANES, -(-D // _LANES) * _LANES)
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, Dp)
+    out, lse = _flash_lse(
+        to_bh(q), to_bh(k), to_bh(v), scale, causal, block_q, block_k,
+        interpret,
+    )
+    out = out.reshape(B, H, T, Dp).transpose(0, 2, 1, 3)
+    if Dp != D:
+        out = out[..., :D]
+    return out, lse[:, :, 0].reshape(B, H, T)

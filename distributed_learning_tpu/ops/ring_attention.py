@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["attention_reference", "ring_attention", "ulysses_attention", "make_ring_attention"]
+__all__ = ["attention_reference", "ring_attention", "ulysses_attention", "ring_flash_attention", "make_ring_attention"]
 
 
 def attention_reference(
@@ -165,27 +165,161 @@ def ulysses_attention(
     return heads_to_seq(out)
 
 
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the per-block
+    compute: the long-context composition where the ppermute ring moves
+    K/V between devices and each local (Q-shard x K/V-block) attention
+    runs fused on the MXU instead of as XLA einsums.
+
+    Exactness: each block call returns (out_i, lse_i); blocks combine by
+    the same max-shifted recurrence flash uses internally —
+    ``out = sum_i out_i * exp(lse_i - lse_total) ``, which is the full
+    softmax over all keys.  Gradients flow end-to-end: the lse consumer
+    makes d loss/d lse nonzero, which the kernel backward folds in as
+    the ``dadj`` row term (``ops/flash_attention.py``).
+
+    Block structure under causality: a rotating K/V block is entirely in
+    this shard's past (full attention), entirely in its future (skipped
+    — no FLOPs, via ``lax.cond``), or the resident diagonal (causal
+    kernel).  Off-TPU without ``interpret`` the block calls fall back to
+    the reference path, so this stays runnable (and differentiable) on
+    the CPU mesh.
+    """
+    from distributed_learning_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, t_local, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(D))
+
+    def fit_block(request: int) -> int:
+        # Largest divisor of the shard length <= the requested block, so
+        # any t_local the einsum ring accepts also lowers here (the
+        # kernel requires T % block == 0; CPU fallback never checks, so
+        # this must not be left to hardware to discover).
+        b = min(request, t_local)
+        while t_local % b:
+            b -= 1
+        return b
+
+    kernel = functools.partial(
+        flash_attention_with_lse, sm_scale=scale,
+        block_q=fit_block(block_q), block_k=fit_block(block_k),
+        interpret=interpret,
+    )
+
+    def diag_block(q, k_blk, v_blk):
+        return kernel(q, k_blk, v_blk, causal=True)
+
+    def full_block(q, k_blk, v_blk):
+        return kernel(q, k_blk, v_blk, causal=False)
+
+    def dead_block(q, k_blk, v_blk):
+        # Fully-masked: contributes nothing.  lse = -inf zeroes its
+        # weight in the combine (guarded exp below).  pcast: the live
+        # branches consume the ppermuted (device-varying) K/V, so cond
+        # needs this branch's fresh constants marked varying too.
+        pv = lambda x: lax.pcast(x, axis_name, to="varying")
+        return (
+            pv(jnp.zeros((B, t_local, H, D), q.dtype)),
+            pv(jnp.full((B, H, t_local), -jnp.inf, jnp.float32)),
+        )
+
+    pvary = lambda x: lax.pcast(x, axis_name, to="varying")
+    acc0 = pvary(jnp.zeros((B, t_local, H, D), jnp.float32))
+    l0 = pvary(jnp.zeros((B, H, t_local), jnp.float32))
+    m0 = pvary(jnp.full((B, H, t_local), -jnp.inf, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry_kv):
+        (acc, l, m), (k_blk, v_blk, src) = carry_kv
+        if causal:
+            out_i, lse_i = lax.cond(
+                src > idx,
+                dead_block,
+                lambda q, kb, vb: lax.cond(
+                    src == idx, diag_block, full_block, q, kb, vb
+                ),
+                q, k_blk, v_blk,
+            )
+        else:
+            out_i, lse_i = full_block(q, k_blk, v_blk)
+
+        # Max-shifted combine; guards mirror _block_accumulate's so
+        # -inf - -inf never produces a NaN.
+        m_new = jnp.maximum(m, lse_i)
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        beta = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - safe_m), 0.0)
+        to_t = lambda x: x.transpose(0, 2, 1)[..., None]  # (B,H,t)->(B,t,H,1)
+        acc = acc * to_t(alpha) + out_i.astype(jnp.float32) * to_t(beta)
+        l = l * alpha + beta
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (acc, l, m_new), (k_blk, v_blk, src)
+
+    carry = ((acc0, l0, m0), (k, v, idx))
+    carry = lax.fori_loop(0, n, lambda i, c: step(c), carry)
+    (acc, l, _m), _ = carry
+    l = jnp.maximum(l, 1e-30)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
 def make_ring_attention(
     mesh: Mesh,
     *,
     axis_name: str = "seq",
     strategy: str = "ring",
     causal: bool = True,
+    interpret: bool = False,
 ):
     """Jitted sequence-parallel attention over globally-shaped arrays.
 
     Returns ``fn(q, k, v) -> out`` taking full (B, T, H, D) arrays with T
     sharded over ``axis_name``; internally a ``shard_map`` of
-    :func:`ring_attention` (or :func:`ulysses_attention`).
+    :func:`ring_attention`, :func:`ulysses_attention`, or
+    :func:`ring_flash_attention` (``strategy="ring_flash"`` — the Pallas
+    per-block kernel; ``interpret`` reaches its block calls for CPU
+    testing).
     """
-    impl = {"ring": ring_attention, "ulysses": ulysses_attention}[strategy]
+    impl = {
+        "ring": ring_attention,
+        "ulysses": ulysses_attention,
+        "ring_flash": ring_flash_attention,
+    }[strategy]
     spec = P(None, axis_name, None, None)
+
+    # Pallas INTERPRET mode evaluates the kernel jaxpr with its own
+    # dynamic_slice block indexing, which mixes varying and unvarying
+    # operands in a way the shard_map vma checker rejects inside its
+    # machinery (JAX's error text prescribes check_vma=False as the
+    # workaround).  Scoped to exactly that combination: the compiled TPU
+    # path and the einsum strategies keep the check.
+    check_vma = not (strategy == "ring_flash" and interpret)
 
     @jax.jit
     def fn(q, k, v):
         local = functools.partial(impl, axis_name=axis_name, causal=causal)
+        if strategy == "ring_flash":
+            local = functools.partial(local, interpret=interpret)
         sharded = jax.shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=check_vma,
         )
         sharding = NamedSharding(mesh, spec)
         q_, k_, v_ = (jax.lax.with_sharding_constraint(x, sharding) for x in (q, k, v))
