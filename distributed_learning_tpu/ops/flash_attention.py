@@ -394,8 +394,9 @@ def _flash_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     qb, kb, vb, out, lse = res
-    dadj = jnp.zeros_like(lse)  # no lse consumer -> no adjustment
-    return _bwd_call(qb, kb, vb, out, do, lse, dadj, sm_scale, causal,
+    # dadj=None: no lse consumer, so the kernels omit the input entirely
+    # instead of streaming a known-zero tensor through both grids.
+    return _bwd_call(qb, kb, vb, out, do, lse, None, sm_scale, causal,
                      block_q, block_k, interpret)
 
 
@@ -436,6 +437,36 @@ def _flash_lse_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+
+def _prep_blocks(q, k, v, block_q, block_k):
+    """Shared wrapper preprocessing: validate block divisibility, pad the
+    head dim to the 128-lane grid, and flatten (B, T, H, D) ->
+    (B*H, T, Dp).  Returns (qb, kb, vb, Dp, unpack) where ``unpack``
+    restores a (B*H, T, Dp) result to (B, T, H, D)."""
+    B, T, H, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(
+            f"sequence length {T} must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    # The TPU lowering tiles the last two block dims to (8, 128): pad the
+    # head dim up to a lane multiple.  Zero K/Q columns leave every score
+    # unchanged; zero V columns produce zero output columns, sliced off.
+    Dp = max(_LANES, -(-D // _LANES) * _LANES)
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, Dp)
+
+    def unpack(out):
+        out = out.reshape(B, H, T, Dp).transpose(0, 2, 1, 3)
+        return out[..., :D] if Dp != D else out
+
+    return to_bh(q), to_bh(k), to_bh(v), block_q, block_k, unpack
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret")
 )
@@ -459,33 +490,17 @@ def flash_attention(
     this falls back to the reference einsum/softmax path (XLA fuses it
     well enough on CPU; the kernel is the TPU fast path).
     """
-    B, T, H, D = q.shape
+    D = q.shape[-1]
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(D))
     on_tpu = jax.devices()[0].platform == "tpu"
     if not on_tpu and not interpret:
         return attention_reference(q, k, v, causal=causal, sm_scale=scale)
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(
-            f"sequence length {T} must be divisible by block sizes "
-            f"({block_q}, {block_k})"
-        )
-
-    # The TPU lowering tiles the last two block dims to (8, 128): pad the
-    # head dim up to a lane multiple.  Zero K/Q columns leave every score
-    # unchanged; zero V columns produce zero output columns, sliced off.
-    Dp = max(_LANES, -(-D // _LANES) * _LANES)
-    if Dp != D:
-        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
-        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
-
-    # (B, T, H, D) -> (B*H, T, D): one grid row per (batch, head).
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, Dp)
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
-    out = _flash(qb, kb, vb, scale, causal, block_q, block_k, interpret)
-    out = out.reshape(B, H, T, Dp).transpose(0, 2, 1, 3)
-    return out[..., :D] if Dp != D else out
+    qb, kb, vb, block_q, block_k, unpack = _prep_blocks(
+        q, k, v, block_q, block_k
+    )
+    return unpack(
+        _flash(qb, kb, vb, scale, causal, block_q, block_k, interpret)
+    )
 
 
 @functools.partial(
@@ -526,23 +541,10 @@ def flash_attention_with_lse(
         out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
         lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B, H, T)
         return out, lse
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(
-            f"sequence length {T} must be divisible by block sizes "
-            f"({block_q}, {block_k})"
-        )
-    Dp = max(_LANES, -(-D // _LANES) * _LANES)
-    if Dp != D:
-        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
-        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, Dp)
-    out, lse = _flash_lse(
-        to_bh(q), to_bh(k), to_bh(v), scale, causal, block_q, block_k,
-        interpret,
+    qb, kb, vb, block_q, block_k, unpack = _prep_blocks(
+        q, k, v, block_q, block_k
     )
-    out = out.reshape(B, H, T, Dp).transpose(0, 2, 1, 3)
-    if Dp != D:
-        out = out[..., :D]
-    return out, lse[:, :, 0].reshape(B, H, T)
+    out, lse = _flash_lse(
+        qb, kb, vb, scale, causal, block_q, block_k, interpret
+    )
+    return unpack(out), lse[:, :, 0].reshape(B, H, T)

@@ -108,8 +108,12 @@ def ring_attention(
     q_pos = idx * t_local + jnp.arange(t_local)
     # The loop body makes every carry component device-varying (it mixes in
     # ppermuted data), so the initial accumulators must be marked varying
-    # too (shard_map's vma check rejects unvarying->varying carries).
-    pvary = lambda x: lax.pcast(x, axis_name, to="varying")
+    # too (shard_map's vma check rejects unvarying->varying carries).  On a
+    # multi-axis mesh (e.g. the 2D agents x seq step) the inputs vary over
+    # EVERY sharded axis, so the carries must match q's full vma, not just
+    # the ring axis.
+    vary_axes = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
+    pvary = lambda x: lax.pcast(x, vary_axes, to="varying")
     acc0 = pvary(jnp.zeros((B, t_local, H, D), jnp.float32))
     l0 = pvary(jnp.zeros((B, H, t_local), jnp.float32))
     m0 = pvary(jnp.full((B, H, t_local), -jnp.inf, jnp.float32))
@@ -206,14 +210,29 @@ def ring_flash_attention(
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(D))
 
     def fit_block(request: int) -> int:
-        # Largest divisor of the shard length <= the requested block, so
-        # any t_local the einsum ring accepts also lowers here (the
-        # kernel requires T % block == 0; CPU fallback never checks, so
-        # this must not be left to hardware to discover).
+        # Largest divisor of the shard length <= the requested block,
+        # preferring multiples of 8 (the TPU lowering also needs the
+        # second-to-last block dim sublane-aligned).  A shard length not
+        # divisible by 8 has no aligned divisor; the best unaligned one
+        # still serves CPU/interpret, and the TPU guard below rejects it
+        # with a clear message instead of a Mosaic lowering error.
         b = min(request, t_local)
+        aligned = next(
+            (c for c in range(b, 7, -1) if t_local % c == 0 and c % 8 == 0),
+            None,
+        )
+        if aligned is not None:
+            return aligned
         while t_local % b:
             b -= 1
         return b
+
+    if jax.devices()[0].platform == "tpu" and t_local % 8:
+        raise ValueError(
+            f"ring_flash_attention on TPU needs the per-device shard "
+            f"length divisible by 8, got {t_local}; use the einsum ring "
+            "(strategy='ring') or repad the sequence"
+        )
 
     kernel = functools.partial(
         flash_attention_with_lse, sm_scale=scale,
@@ -231,14 +250,16 @@ def ring_flash_attention(
         # Fully-masked: contributes nothing.  lse = -inf zeroes its
         # weight in the combine (guarded exp below).  pcast: the live
         # branches consume the ppermuted (device-varying) K/V, so cond
-        # needs this branch's fresh constants marked varying too.
-        pv = lambda x: lax.pcast(x, axis_name, to="varying")
+        # needs this branch's fresh constants marked varying too (over
+        # q's full vma — multi-axis meshes vary over more than the ring).
+        pv = lambda x: lax.pcast(x, vary_axes, to="varying")
         return (
             pv(jnp.zeros((B, t_local, H, D), q.dtype)),
             pv(jnp.full((B, H, t_local), -jnp.inf, jnp.float32)),
         )
 
-    pvary = lambda x: lax.pcast(x, axis_name, to="varying")
+    vary_axes = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
+    pvary = lambda x: lax.pcast(x, vary_axes, to="varying")
     acc0 = pvary(jnp.zeros((B, t_local, H, D), jnp.float32))
     l0 = pvary(jnp.zeros((B, H, t_local), jnp.float32))
     m0 = pvary(jnp.full((B, H, t_local), -jnp.inf, jnp.float32))

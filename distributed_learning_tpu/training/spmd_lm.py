@@ -1,0 +1,156 @@
+"""2D-mesh decentralized LM training: gossip (agents) x sequence parallel.
+
+The composition the single-axis paths build toward: a ``(agents, seq)``
+device mesh where each *row* of devices holds one gossip agent — its
+model replica replicated along the row, its token batch sequence-sharded
+across it — and one jitted step does
+
+1. local forward/backward with ring(-flash) attention rotating K/V
+   blocks along the ``seq`` axis (``ops/ring_attention.py``),
+2. gradient reduction along ``seq`` (the replicas of one agent must step
+   identically — a ``psum`` over the row),
+3. the optimizer update, and
+4. one Metropolis gossip round along the ``agents`` axis (ppermute ring,
+   the consensus engine's mixing math inlined on the already-open mesh).
+
+The reference has nothing remotely like this (its workers are asyncio
+tasks passing pickles); this is what its decentralized-learning design
+becomes when the cluster is a TPU pod: DP x SP as one SPMD program, all
+collectives on ICI.
+
+Scale note: agents map to the mesh's outer axis and sequence to the
+inner one so K/V rotation (n_seq hops per step) rides the fastest links
+while gossip (one hop per epoch) crosses the slower dimension.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_gossip_lm_step"]
+
+
+def make_gossip_lm_step(
+    mesh: Mesh,
+    model: Any,
+    tx: Any,
+    *,
+    agents_axis: str = "agents",
+    seq_axis: str = "seq",
+    self_weight: float | None = None,
+) -> Callable[..., Tuple[Any, Any, jax.Array]]:
+    """Build the jitted 2D train step.
+
+    ``model`` must be a sequence model taking ``(tokens, train=...)`` with
+    a sequence-parallel ``attn_impl`` bound to ``seq_axis`` (e.g.
+    ``TransformerLM(attn_impl="ring" | "ring_flash", seq_axis=...)``).
+    ``tx`` is an optax transform.  Mixing is one Metropolis round on the
+    agents ring: ``x <- (1-2w) x + w left + w right`` with
+    ``w = self_weight or 1/3`` (the Metropolis weight of a ring, every
+    degree = 2).
+
+    Returns ``step(params, opt_state, x_tok, y_tok) -> (params,
+    opt_state, mean_loss)`` over global arrays laid out as:
+
+    * ``params``/``opt_state``: stacked per-agent pytrees, leading axis
+      ``n_agents`` sharded over ``agents_axis`` (each row replicates its
+      agent's replica across the ``seq`` devices);
+    * ``x_tok``/``y_tok``: ``(n_agents, B, T)`` int32, sharded
+      ``(agents_axis, None, seq_axis)`` — targets are pre-shifted by the
+    caller (the shift crosses shard boundaries, so it must happen on the
+    global array).
+    """
+    n_agents = mesh.shape[agents_axis]
+    w = float(self_weight) if self_weight is not None else 1.0 / 3.0
+    perm_fwd = [(i, (i + 1) % n_agents) for i in range(n_agents)]
+    perm_bwd = [(i, (i - 1) % n_agents) for i in range(n_agents)]
+
+    import optax
+
+    def local_step(params, opt_state, x_tok, y_tok):
+        # Local shapes: params (1, ...) — this agent's replica; tokens
+        # (1, B, T_local).  Drop the unit agent axis for compute.
+        p = jax.tree.map(lambda a: a[0], params)
+        x = x_tok[0]
+        y = y_tok[0]
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            # Sum locally; normalize by the GLOBAL token count so the
+            # psum'd gradient is the gradient of the global mean.
+            n_total = y.size * lax.axis_size(seq_axis)
+            return jnp.sum(ce) / n_total
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        # One agent's seq-replicas each saw a different token shard: sum
+        # both the loss and the gradient along the row.
+        loss = lax.psum(loss, seq_axis)
+        grads = lax.psum(grads, seq_axis)
+
+        updates, opt_state0 = tx.update(
+            grads, jax.tree.map(lambda a: a[0], opt_state), p
+        )
+        p = optax.apply_updates(p, updates)
+
+        # Metropolis gossip round on the agents ring.  K/V rotation rode
+        # seq_axis inside the forward; this is the only agents-axis
+        # collective — one ppermute pair per round.
+        left = jax.tree.map(
+            lambda a: lax.ppermute(a, agents_axis, perm_fwd), p
+        )
+        right = jax.tree.map(
+            lambda a: lax.ppermute(a, agents_axis, perm_bwd), p
+        )
+        p = jax.tree.map(
+            lambda c, lft, r: (1.0 - 2.0 * w) * c + w * lft + w * r,
+            p, left, right,
+        )
+
+        expand = lambda t: jax.tree.map(lambda a: a[None], t)
+        return expand(p), expand(opt_state0), loss[None]
+
+    pspec = P(agents_axis)
+    tspec = P(agents_axis, None, seq_axis)
+    lspec = P(agents_axis)
+
+    @jax.jit
+    def step(params, opt_state, x_tok, y_tok):
+        sharded = jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(pspec, pspec, tspec, tspec),
+            out_specs=(pspec, pspec, lspec),
+        )
+        constrain = lambda t, spec: jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, spec)
+            ),
+            t,
+        )
+        params = constrain(params, pspec)
+        opt_state = constrain(opt_state, pspec)
+        x = jax.lax.with_sharding_constraint(x_tok, NamedSharding(mesh, tspec))
+        y = jax.lax.with_sharding_constraint(y_tok, NamedSharding(mesh, tspec))
+        new_params, new_opt, losses = sharded(params, opt_state, x, y)
+        return new_params, new_opt, jnp.mean(losses)
+
+    return step
+
+
+def stack_agent_states(model, tx, rng, sample_tokens, n_agents):
+    """Convenience: init one replica and stack it ``n_agents`` times
+    (the trainer's broadcast-init pattern) plus matching opt states."""
+    variables = model.init(rng, sample_tokens)
+    params = variables["params"]
+    stack = lambda t: jax.tree.map(
+        lambda v: jnp.broadcast_to(v[None], (n_agents,) + v.shape), t
+    )
+    sp = stack(params)
+    opt = jax.vmap(tx.init)(sp)
+    return sp, opt
