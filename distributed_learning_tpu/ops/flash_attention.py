@@ -439,10 +439,12 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _prep_blocks(q, k, v, block_q, block_k):
-    """Shared wrapper preprocessing: validate block divisibility, pad the
-    head dim to the 128-lane grid, and flatten (B, T, H, D) ->
-    (B*H, T, Dp).  Returns (qb, kb, vb, Dp, unpack) where ``unpack``
-    restores a (B*H, T, Dp) result to (B, T, H, D)."""
+    """Shared wrapper preprocessing: clamp block sizes to T (callers
+    must forward the returned sizes to the kernel), validate
+    divisibility, pad the head dim to the 128-lane grid, and flatten
+    (B, T, H, D) -> (B*H, T, Dp).  Returns (qb, kb, vb, block_q,
+    block_k, unpack) where ``unpack`` restores a (B*H, T, Dp) result to
+    (B, T, H, D) and slices off the head-dim padding."""
     B, T, H, D = q.shape
     block_q = min(block_q, T)
     block_k = min(block_k, T)

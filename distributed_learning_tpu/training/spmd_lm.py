@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_gossip_lm_step"]
+__all__ = ["make_gossip_lm_step", "stack_agent_states"]
 
 
 def make_gossip_lm_step(

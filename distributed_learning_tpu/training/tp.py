@@ -1,0 +1,149 @@
+"""Tensor parallelism for the transformer via GSPMD sharding annotations.
+
+The idiomatic-JAX half of the parallelism matrix: where ``spmd_lm.py``
+writes the collectives by hand (shard_map + ppermute/psum), this module
+only *annotates* — megatron-style shardings on the transformer's weight
+matrices over a ``model`` mesh axis — and lets XLA's SPMD partitioner
+insert the all-gathers/reduce-scatters.  The recipe the scaling
+playbook prescribes: pick a mesh, place shardings, compile, profile.
+
+Rules (the Megatron-LM split, arXiv:1909.08053):
+
+* QKV projection kernel  (d_model, 3*H*Dh) -> shard the OUTPUT columns
+  (heads split across devices; attention is head-local so no collective
+  is needed inside it),
+* attention out-projection (H*Dh, d_model) -> shard the INPUT rows (its
+  matmul contracts the sharded axis; XLA places one psum),
+* MLP up kernel (d, 4d) -> columns; MLP down kernel (4d, d) -> rows
+  (same column-then-row pairing, one psum per block),
+* embeddings and LayerNorms replicated.
+
+``shard_transformer_params`` maps a TransformerLM param tree to these
+shardings; ``make_tp_train_step`` builds a jitted data x tensor
+parallel LM step over a ``(data, model)`` mesh: batch sharded over
+``data``, weights over ``model``, XLA inserting every collective.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["transformer_tp_rules", "shard_transformer_params",
+           "make_tp_train_step"]
+
+
+def transformer_tp_rules(path: tuple, leaf, model_axis: str) -> P:
+    """PartitionSpec for one TransformerLM parameter.
+
+    Path keys follow flax's module naming: ``_Attention`` holds two
+    Dense kernels (``Dense_0`` = QKV, ``Dense_1`` = out-projection);
+    ``_Block`` additionally holds the MLP pair (``Dense_0`` up,
+    ``Dense_1`` down) at its own level.
+    """
+    names = [getattr(k, "key", str(k)) for k in path]
+    if leaf.ndim != 2 or len(names) < 2:
+        return P()  # biases, LayerNorm scales: replicated
+    dense = names[-2]  # the Dense module owning this kernel
+    if any(n.startswith("_Attention") for n in names):
+        # Dense_0 = QKV (columns = heads): shard outputs.
+        # Dense_1 = out-projection: shard inputs (contraction -> psum).
+        return P(None, model_axis) if dense == "Dense_0" else P(model_axis, None)
+    if any(n.startswith("_Block") for n in names):
+        # The block's own Dense pair is the MLP: up = columns, down = rows.
+        if dense == "Dense_0":
+            return P(None, model_axis)
+        if dense == "Dense_1":
+            return P(model_axis, None)
+    # Embeddings, the final vocab head, anything unrecognized: replicated
+    # (always correct; sharding them is a later perf choice).
+    return P()
+
+
+def shard_transformer_params(params: Any, mesh: Mesh,
+                             model_axis: str = "model") -> Any:
+    """Device-put a TransformerLM param tree with megatron-style specs."""
+    def place(path, leaf):
+        spec = transformer_tp_rules(path, leaf, model_axis)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def make_tp_train_step(
+    mesh: Mesh,
+    model: Any,
+    tx: Any,
+    *,
+    data_axis: str = "data",
+    model_axis: str = "model",
+) -> Callable[..., Tuple[Any, Any, jax.Array]]:
+    """Jitted DP x TP step: batch over ``data_axis``, weights over
+    ``model_axis``, all collectives inserted by the XLA partitioner.
+
+    ``step(params, opt_state, x_tok, y_tok) -> (params, opt_state,
+    loss)`` with ``x_tok``/``y_tok`` of shape (B, T) int32 (B divisible
+    by the data-axis size).  Params may come from
+    :func:`shard_transformer_params`; the step re-constrains them every
+    call so the layout survives the optimizer update.
+    """
+    import optax
+
+    def constrain_params(params):
+        def place(path, leaf):
+            spec = transformer_tp_rules(path, leaf, model_axis)
+            return jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec)
+            )
+
+        return jax.tree_util.tree_map_with_path(place, params)
+
+    def constrain_opt(opt_state, params):
+        # Optimizer moments are param-shaped but live under optax's own
+        # tree structure, so the path rules don't apply directly.  Match
+        # by shape against the params' sharded kernels: Adam's mu/nu for
+        # a column-split QKV kernel must be column-split too, or each
+        # device replicates moments for weights it doesn't own — the
+        # memory TP exists to save.  (Shapes shared between a sharded
+        # and an unsharded param would be ambiguous; the megatron rules
+        # shard distinct (in, out) kernel shapes only.)
+        shape_spec = {}
+        def record(path, leaf):
+            spec = transformer_tp_rules(path, leaf, model_axis)
+            if spec != P():
+                shape_spec.setdefault(leaf.shape, spec)
+            return leaf
+        jax.tree_util.tree_map_with_path(record, params)
+
+        def place(leaf):
+            spec = shape_spec.get(getattr(leaf, "shape", None), P())
+            return jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec)
+            )
+
+        return jax.tree.map(place, opt_state)
+
+    data_sharding = NamedSharding(mesh, P(data_axis, None))
+
+    @jax.jit
+    def step(params, opt_state, x_tok, y_tok):
+        params = constrain_params(params)
+        opt_state = constrain_opt(opt_state, params)
+        x = jax.lax.with_sharding_constraint(x_tok, data_sharding)
+        y = jax.lax.with_sharding_constraint(y_tok, data_sharding)
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return constrain_params(params), constrain_opt(opt_state, params), loss
+
+    return step

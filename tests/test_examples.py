@@ -191,3 +191,16 @@ def test_lm_gossip_example():
     assert m, out
     accs = [float(v) for v in m.group(1).split(",")]
     assert len(accs) == 4 and min(accs) > 0.12, out
+
+
+def test_lm_2d_mesh_example():
+    out = _run(
+        "lm_2d_mesh",
+        env_extra={
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "LM2D_STEPS": "5",
+        },
+    )
+    m = re.search(r"loss (\d+\.\d+) -> (\d+\.\d+)", out)
+    assert m, out
+    assert float(m.group(2)) < float(m.group(1)), out

@@ -61,16 +61,27 @@ def test_2d_mesh_gossip_lm_step(attn):
     assert np.isfinite(float(loss))
     assert float(loss) < float(l0), (l0, loss)
 
-    # Gossip must be pulling the replicas together: the per-agent spread
-    # after several mixed steps on shared-structure data stays bounded
-    # and the mean parameter is preserved by each Metropolis round
-    # (doubly stochastic W) up to the optimizer's local updates.
-    flat = np.concatenate([
-        np.asarray(leaf).reshape(N_AGENTS, -1)
-        for leaf in jax.tree.leaves(params)
-    ], axis=1)
-    spread = np.abs(flat - flat.mean(0, keepdims=True)).max()
-    assert np.isfinite(spread)
+    # Gossip must be pulling the replicas together: rerun the identical
+    # schedule with mixing disabled (self_weight=0 keeps each agent's
+    # params untouched by the round) and require the mixed run's
+    # per-agent spread to be decisively smaller.
+    def param_spread(p):
+        flat = np.concatenate([
+            np.asarray(leaf).reshape(N_AGENTS, -1)
+            for leaf in jax.tree.leaves(p)
+        ], axis=1)
+        return float(np.abs(flat - flat.mean(0, keepdims=True)).max())
+
+    params_ng, opt_ng = stack_agent_states(
+        init_twin, tx, jax.random.key(0), x[0], N_AGENTS
+    )
+    step_ng = make_gossip_lm_step(mesh, model, tx, self_weight=0.0)
+    with mesh:
+        for _ in range(9):
+            params_ng, opt_ng, _ = step_ng(params_ng, opt_ng, x, y)
+    assert param_spread(params) < 0.5 * param_spread(params_ng), (
+        param_spread(params), param_spread(params_ng)
+    )
 
     # Cross-check the 2D program against a single-device reference: same
     # model, same data, one agent's equivalent step (full attention over

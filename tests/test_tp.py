@@ -1,0 +1,112 @@
+"""Tensor parallelism by GSPMD annotation (training/tp.py).
+
+A (data=2, model=4) mesh on the 8 virtual CPU devices: megatron-style
+weight shardings on the TransformerLM, batch sharded over data, and the
+XLA partitioner inserting every collective.  Correctness bar: the
+sharded program computes exactly what the unsharded model computes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_learning_tpu.models.transformer import TransformerLM
+from distributed_learning_tpu.training.tp import (
+    make_tp_train_step,
+    shard_transformer_params,
+    transformer_tp_rules,
+)
+
+VOCAB, T, B = 16, 16, 8
+
+
+def _mesh():
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, ("data", "model"))
+
+
+def _model():
+    # 4 heads over model=4 -> one head per device under the QKV split.
+    return TransformerLM(vocab_size=VOCAB, num_layers=2, num_heads=4,
+                         head_dim=8, max_len=T)
+
+
+def _data(seed):
+    rng = np.random.default_rng(seed)
+    seq = (rng.integers(0, VOCAB, size=(B, 1)) + np.arange(T + 1)) % VOCAB
+    return (jnp.asarray(seq[:, :-1], jnp.int32),
+            jnp.asarray(seq[:, 1:], jnp.int32))
+
+
+def test_tp_rules_place_expected_axes():
+    model = _model()
+    x, _ = _data(0)
+    params = model.init(jax.random.key(0), x)["params"]
+
+    seen = {"qkv": 0, "attn_out": 0, "mlp_up": 0, "mlp_down": 0, "rep": 0}
+
+    def visit(path, leaf):
+        spec = transformer_tp_rules(path, leaf, "model")
+        names = [getattr(k, "key", str(k)) for k in path]
+        if any(n.startswith("_Attention") for n in names) and leaf.ndim == 2:
+            if names[-2] == "Dense_0":
+                assert spec == P(None, "model"); seen["qkv"] += 1
+            else:
+                assert spec == P("model", None); seen["attn_out"] += 1
+        elif any(n.startswith("_Block") for n in names) and leaf.ndim == 2 \
+                and names[-2] in ("Dense_0", "Dense_1"):
+            key = "mlp_up" if names[-2] == "Dense_0" else "mlp_down"
+            assert spec == (P(None, "model") if key == "mlp_up"
+                            else P("model", None)); seen[key] += 1
+        else:
+            assert spec == P(); seen["rep"] += 1
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    # 2 layers: one of each sharded kind per layer, plus replicated rest.
+    assert seen["qkv"] == seen["attn_out"] == 2
+    assert seen["mlp_up"] == seen["mlp_down"] == 2
+    assert seen["rep"] > 0
+
+
+def test_tp_sharded_forward_matches_unsharded():
+    mesh = _mesh()
+    model = _model()
+    x, y = _data(1)
+    params = model.init(jax.random.key(1), x)["params"]
+    ref_logits = model.apply({"params": params}, x)
+
+    sharded = shard_transformer_params(params, mesh, "model")
+    # A sharded QKV kernel really is split over the model axis.
+    qkv = sharded["_Block_0"]["_Attention_0"]["Dense_0"]["kernel"]
+    assert qkv.sharding.spec == P(None, "model")
+
+    with mesh:
+        logits = jax.jit(lambda p, t: model.apply({"params": p}, t))(
+            sharded, x
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), atol=2e-5
+    )
+
+
+def test_tp_train_step_trains_and_keeps_layout():
+    mesh = _mesh()
+    model = _model()
+    tx = optax.adam(3e-3)
+    x, y = _data(2)
+    params = model.init(jax.random.key(2), x)["params"]
+    params = shard_transformer_params(params, mesh, "model")
+    opt = tx.init(params)
+    step = make_tp_train_step(mesh, model, tx)
+
+    with mesh:
+        _, _, l0 = step(params, opt, x, y)
+        for _ in range(6):
+            params, opt, loss = step(params, opt, x, y)
+    assert np.isfinite(float(loss))
+    assert float(loss) < float(l0)
+    qkv = params["_Block_0"]["_Attention_0"]["Dense_0"]["kernel"]
+    assert qkv.sharding.spec == P(None, "model"), qkv.sharding
