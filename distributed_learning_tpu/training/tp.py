@@ -9,9 +9,11 @@ playbook prescribes: pick a mesh, place shardings, compile, profile.
 
 Rules (the Megatron-LM split, arXiv:1909.08053):
 
-* QKV projection kernel  (d_model, 3*H*Dh) -> shard the OUTPUT columns
-  (heads split across devices; attention is head-local so no collective
-  is needed inside it),
+* QKV projection kernel  (d_model, 3*H*Dh) -> shard the OUTPUT columns.
+  NOTE: the column axis is the CONCATENATED [Q|K|V] layout, so this is
+  not the head-local Megatron split — XLA reshards activations inside
+  attention as needed (results exact; per-head interleaving that makes
+  attention collective-free is a perf follow-up),
 * attention out-projection (H*Dh, d_model) -> shard the INPUT rows (its
   matmul contracts the sharded axis; XLA places one psum),
 * MLP up kernel (d, 4d) -> columns; MLP down kernel (4d, d) -> rows
@@ -107,14 +109,18 @@ def make_tp_train_step(
         # by shape against the params' sharded kernels: Adam's mu/nu for
         # a column-split QKV kernel must be column-split too, or each
         # device replicates moments for weights it doesn't own — the
-        # memory TP exists to save.  (Shapes shared between a sharded
-        # and an unsharded param would be ambiguous; the megatron rules
-        # shard distinct (in, out) kernel shapes only.)
-        shape_spec = {}
+        # memory TP exists to save.  A shape carried by params with
+        # DIFFERENT specs (e.g. a replicated (32, 32) embedding next to
+        # a (32, 32) out-projection) is ambiguous: fall back to
+        # replicated for it rather than mis-shard some moments.
+        shape_spec: dict = {}
         def record(path, leaf):
             spec = transformer_tp_rules(path, leaf, model_axis)
-            if spec != P():
-                shape_spec.setdefault(leaf.shape, spec)
+            prev = shape_spec.get(leaf.shape)
+            if prev is not None and prev != spec:
+                shape_spec[leaf.shape] = P()  # collision: stay safe
+            else:
+                shape_spec[leaf.shape] = spec
             return leaf
         jax.tree_util.tree_map_with_path(record, params)
 

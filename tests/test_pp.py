@@ -1,0 +1,83 @@
+"""Pipeline parallelism (training/pp.py): GPipe microbatching on the
+8-stage virtual mesh — sharded pipeline output equals the unsharded
+layer stack exactly, gradients included."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from distributed_learning_tpu.training.pp import make_pipeline_apply
+
+S, L, D = 8, 2, 16   # stages x layers-per-stage, width
+M, MB = 4, 4         # microbatches x microbatch size
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:S]), ("stage",))
+
+
+def _params(seed):
+    rng = np.random.default_rng(seed)
+    # (S, L, D, D) kernels + (S, L, D) biases, scaled for stable depth.
+    W = jnp.asarray(
+        rng.normal(size=(S, L, D, D)).astype(np.float32) / np.sqrt(D)
+    )
+    b = jnp.asarray(rng.normal(size=(S, L, D)).astype(np.float32) * 0.1)
+    return {"W": W, "b": b}
+
+
+def _stage_fn(p, act):
+    def layer(act, wb):
+        W, b = wb
+        return jnp.tanh(act @ W + b), None
+
+    act, _ = jax.lax.scan(layer, act, (p["W"], p["b"]))
+    return act
+
+
+def _reference(params, x):
+    out, _ = jax.lax.scan(lambda a, p: (_stage_fn(p, a), None), x, params)
+    return out
+
+
+def test_pipeline_matches_unsharded_stack():
+    mesh = _mesh()
+    params = _params(0)
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(M, MB, D)).astype(np.float32)
+    )
+    apply = make_pipeline_apply(mesh, _stage_fn)
+    with mesh:
+        got = apply(params, x)
+    expect = jax.vmap(lambda mb: _reference(params, mb))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               atol=2e-5)
+
+
+def test_pipeline_gradients_match():
+    """Reverse-mode through the scan + ppermute transposes is the reverse
+    pipeline; parameter and input grads must equal the unsharded ones."""
+    mesh = _mesh()
+    params = _params(2)
+    x = jnp.asarray(
+        np.random.default_rng(3).normal(size=(M, MB, D)).astype(np.float32)
+    )
+    co = jnp.asarray(
+        np.random.default_rng(4).normal(size=(M, MB, D)).astype(np.float32)
+    )
+    apply = make_pipeline_apply(mesh, _stage_fn)
+
+    def loss_pp(params, x):
+        with mesh:
+            return jnp.sum(apply(params, x) * co)
+
+    def loss_ref(params, x):
+        return jnp.sum(jax.vmap(lambda mb: _reference(params, mb))(x) * co)
+
+    gp, gx = jax.grad(loss_pp, argnums=(0, 1))(params, x)
+    rp, rx = jax.grad(loss_ref, argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=2e-5)
+    for k in gp:
+        np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(rp[k]),
+                                   atol=2e-5)
