@@ -26,7 +26,7 @@ Interleaved/1F1B schedules are perf work on top of the same structure.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -62,7 +62,6 @@ def make_pipeline_apply(
                     f"{jax.tree_util.keystr(path)} != {S} mesh stages — a "
                     "mismatch would silently drop stages after sharding"
                 )
-            break  # one leaf suffices; trees are homogeneous here
 
     def local(stage_params, mbs):
         p = jax.tree.map(lambda a: a[0], stage_params)  # this device's stage
