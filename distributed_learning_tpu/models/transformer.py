@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distributed_learning_tpu.models.moe import MoEMLP
 from distributed_learning_tpu.ops.ring_attention import (
     attention_reference,
     ring_attention,
@@ -68,6 +69,8 @@ class _Block(nn.Module):
     attn_impl: str = "full"
     seq_axis: str = "seq"
     dtype: jnp.dtype = jnp.float32
+    mlp: str = "dense"
+    num_experts: int = 4
 
     @nn.compact
     def __call__(self, x):
@@ -77,6 +80,15 @@ class _Block(nn.Module):
             self.dtype,
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.mlp == "moe":
+            # Expert-parallel feed-forward (models/moe.py): params become
+            # stacked (E, ...) kernels shardable over an expert mesh axis.
+            return x + MoEMLP(
+                num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+            )(h)
+        if self.mlp != "dense":
+            raise ValueError(f"unknown mlp {self.mlp!r} (want dense|moe)")
         d = x.shape[-1]
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
         h = nn.gelu(h)
@@ -101,6 +113,8 @@ class TransformerLM(nn.Module):
     attn_impl: str = "full"
     seq_axis: str = "seq"
     dtype: jnp.dtype = jnp.float32
+    mlp: str = "dense"       # "dense" | "moe" (expert-parallel blocks)
+    num_experts: int = 4
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -133,6 +147,7 @@ class TransformerLM(nn.Module):
             x = _Block(
                 self.num_heads, self.head_dim, self.mlp_ratio,
                 self.attn_impl, self.seq_axis, self.dtype,
+                self.mlp, self.num_experts,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)

@@ -121,3 +121,46 @@ def test_moe_trains_under_expert_sharding():
         for _ in range(10):
             params, opt, loss = step(params, opt)
     assert np.isfinite(float(loss)) and float(loss) < float(l0)
+
+
+def test_transformer_lm_moe_variant_trains_and_shards():
+    """TransformerLM(mlp="moe") gives ep a full-model consumer: it trains,
+    and its stacked expert kernels shard over an expert mesh axis with
+    the sharded forward equal to the unsharded one."""
+    from distributed_learning_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=16, num_layers=1, num_heads=2,
+                          head_dim=8, max_len=8, mlp="moe", num_experts=4,
+                          mlp_ratio=2)
+    tok = jnp.asarray(
+        np.random.default_rng(5).integers(0, 16, (2, 8)), jnp.int32
+    )
+    params = model.init(jax.random.key(5), tok)["params"]
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, tok)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tok[:, 1:]
+        ).mean()
+
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+    l0 = float(loss_fn(params))
+    for _ in range(8):
+        g = jax.grad(loss_fn)(params)
+        up, opt = tx.update(g, opt, params)
+        params = optax.apply_updates(params, up)
+    assert float(loss_fn(params)) < l0
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "expert"))
+    sharded = shard_moe_params(params, mesh, "expert")
+    w_up = sharded["_Block_0"]["MoEMLP_0"]["w_up"]
+    assert w_up.sharding.spec == P("expert", None, None)
+    expect = model.apply({"params": params}, tok)
+    with mesh:
+        got = jax.jit(lambda p, t: model.apply({"params": p}, t))(
+            sharded, tok
+        )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               atol=2e-5)
