@@ -16,6 +16,7 @@ from distributed_learning_tpu.models.logreg import (
     loss_fn as logreg_loss,
 )
 from distributed_learning_tpu.models.mlp import ANNModel
+from distributed_learning_tpu.models.moe import MoEMLP
 from distributed_learning_tpu.models.transformer import TransformerLM
 from distributed_learning_tpu.models.vision import LeNet, ResNet, VGG, WideResNet
 
@@ -68,6 +69,7 @@ def get_model(name: str, *args: Any, **kwargs: Any):
 __all__ = [
     "ANNModel",
     "TransformerLM",
+    "MoEMLP",
     "LeNet",
     "VGG",
     "ResNet",
