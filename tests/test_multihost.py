@@ -77,6 +77,46 @@ gstate, _ = gt.run(gt.init(jnp.zeros((4, 8), jnp.float32)), 1500)
 gerr = float(jnp.max(jnp.abs(jnp.asarray(gstate.x) - x_star[None])))
 assert gerr < 1e-3, gerr
 
+# The 2D dp x sp LM step across the SAME process boundary: agents split
+# across processes (the gossip ppermute is a cross-host transfer), each
+# agent's sequence shards within one process (K/V rotation stays local).
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from distributed_learning_tpu.models.transformer import TransformerLM
+from distributed_learning_tpu.training.spmd_lm import (
+    make_gossip_lm_step,
+    stack_agent_states,
+)
+
+mesh2d = Mesh(np.asarray(mesh.devices).reshape(2, 2), ("agents", "seq"))
+kw = dict(vocab_size=8, num_layers=1, num_heads=2, head_dim=4, max_len=8)
+lm = TransformerLM(**kw, attn_impl="ring", seq_axis="seq")
+twin = TransformerLM(**kw, attn_impl="full")
+tx2 = optax.adam(3e-3)
+seqs = (
+    np.random.default_rng(2).integers(0, 8, size=(2, 2, 1)) + np.arange(9)
+) % 8
+xt = jnp.asarray(seqs[..., :-1], jnp.int32)
+yt = jnp.asarray(seqs[..., 1:], jnp.int32)
+p2, o2 = stack_agent_states(twin, tx2, jax.random.key(4), xt[0], 2)
+# Same host values on both processes -> device_put with global shardings
+# produces the global arrays the jitted step consumes.
+put = lambda t, spec: jax.tree.map(
+    lambda a: jax.device_put(a, NamedSharding(mesh2d, spec)), t
+)
+p2 = put(p2, P("agents"))
+o2 = put(o2, P("agents"))
+xt = jax.device_put(xt, NamedSharding(mesh2d, P("agents", None, "seq")))
+yt = jax.device_put(yt, NamedSharding(mesh2d, P("agents", None, "seq")))
+step2 = make_gossip_lm_step(mesh2d, lm, tx2)
+losses = []
+with mesh2d:
+    for _ in range(3):
+        p2, o2, l2 = step2(p2, o2, xt, yt)
+        losses.append(float(l2))
+assert np.isfinite(losses[-1]), losses
+assert losses[-1] < losses[0], losses
+
 print(f"OK-MH {pid}", flush=True)
 """
 
