@@ -14,6 +14,7 @@ accuracy on the all-phase test set; after gossip every node must answer
 the transitions it never saw.
 """
 
+import numpy as np
 import pytest
 
 from distributed_learning_tpu.models.transformer import TransformerLM
@@ -62,3 +63,40 @@ def test_gossip_trainer_trains_transformer_lm():
     # including on phases it never saw (the non-IID point).
     assert accs.mean() > 0.95, accs
     assert accs.std() < 0.05, accs
+
+
+@pytest.mark.slow
+def test_gossip_trainer_trains_moe_transformer():
+    """dp (gossip) x ep (expert weights) through the MasterNode-surface
+    trainer: the MoE LM variant drops into GossipTrainer unchanged."""
+    nodes = list(range(4))
+    train = {a: pattern_batch(32, node_phases(a, 4)) for a in nodes}
+    X_test, y_test = pattern_batch(16, range(VOCAB))
+
+    trainer = GossipTrainer(
+        node_names=nodes,
+        model=TransformerLM(
+            vocab_size=VOCAB, num_layers=1, num_heads=2, head_dim=8,
+            max_len=T, mlp="moe", num_experts=4, mlp_ratio=2,
+        ),
+        optimizer="adam",
+        learning_rate=3e-3,
+        error="cross_entropy",
+        weights=Topology.ring(4),
+        train_data=train,
+        test_data=(X_test, y_test),
+        epoch=6,
+        mix_times=4,
+        batch_size=16,
+        stat_step=1000,
+        dropout=False,
+        eval_batch_size=16,
+        seed=0,
+    )
+    trainer.initialize_nodes()
+    first = trainer.train_epoch()
+    for _ in range(trainer.num_epochs - 1):
+        last = trainer.train_epoch()
+    assert last["train_loss"].mean() < first["train_loss"].mean()
+    assert np.isfinite(np.asarray(last["test_acc"])).all()
+    assert last["deviation"] < 0.1  # gossip really mixed the expert stacks
