@@ -98,6 +98,12 @@ def run() -> None:
         for bq, bk in blocks:
             if T % bq or T % bk:
                 continue
+            if T >= 131072 and min(bq, bk) < 256:
+                # O(T^2) at 131k: the small-block points are minutes of
+                # chip time each and have never won any sweep (block
+                # 512/512 won at every measured T) — spend the window on
+                # configurations that can.
+                continue
             try:
                 tflops, dt = _measure(T, bq, bk, iters=iters,
                                       interpret=interpret)

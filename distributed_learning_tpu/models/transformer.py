@@ -36,12 +36,18 @@ class _Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        B, T, _ = x.shape
-        features = self.num_heads * self.head_dim
-        qkv = nn.Dense(3 * features, use_bias=False, dtype=self.dtype)(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (B, T, self.num_heads, self.head_dim)
-        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        # QKV as ONE DenseGeneral with structured (3, H, Dh) output
+        # features — the kernel is (d_model, 3, H, Dh), so tensor
+        # parallelism shards it on the HEAD axis (training/tp.py) and
+        # every downstream attention op is head-local: no activation
+        # resharding inside the block.  A flat Dense(3*H*Dh) kernel can
+        # only be split contiguously over the concatenated [Q|K|V]
+        # columns, which straddles heads and forces XLA to re-gather.
+        qkv = nn.DenseGeneral(
+            features=(3, self.num_heads, self.head_dim),
+            use_bias=False, dtype=self.dtype,
+        )(x)  # (B, T, 3, H, Dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.attn_impl == "full":
             out = attention_reference(q, k, v, causal=True)
         elif self.attn_impl == "flash":
@@ -58,8 +64,12 @@ class _Attention(nn.Module):
             out = ulysses_attention(q, k, v, axis_name=self.seq_axis, causal=True)
         else:
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
-        out = out.reshape(B, T, features)
-        return nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype)(out)
+        # Out-projection contracts (H, Dh) directly — kernel (H, Dh, d),
+        # head-sharded under TP with one psum placed by the partitioner.
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1),
+            use_bias=False, dtype=self.dtype,
+        )(out)
 
 
 class _Block(nn.Module):

@@ -19,9 +19,20 @@ the ``S - 1`` fill/drain ticks, amortized by larger ``M``.
 Backward needs no schedule of its own: reverse-mode through the scan
 and the ppermute transposes is exactly the reverse pipeline.
 
-This is the correctness-grade schedule (the dryrun/test bar: sharded
-output equals the unsharded stack exactly, gradients included).
-Interleaved/1F1B schedules are perf work on top of the same structure.
+Two schedules:
+
+* :func:`make_pipeline_apply` — GPipe (forward here, backward by
+  autodiff).  Simple, but reverse-mode saves every microbatch's
+  activations across the whole forward scan: live residuals grow O(M).
+* :func:`make_1f1b_train_step` — one-forward-one-backward
+  (PipeDream-flush, arXiv:2006.09503 §2.2): each tick runs one forward
+  AND one backward micro-step, so a stage holds at most ``2(S-1)+1``
+  in-flight activations regardless of M — the stash is a circular
+  buffer of static depth ``min(M, 2S-1)``, and the backward
+  re-derives each stage's vjp from the stashed INPUT (recompute-style,
+  the usual memory/FLOPs trade).  Same bubble as GPipe; the win is
+  peak activation memory O(S) instead of O(M), which is what unlocks
+  large microbatch counts.
 """
 
 from __future__ import annotations
@@ -33,7 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_pipeline_apply"]
+__all__ = ["make_pipeline_apply", "make_1f1b_train_step"]
 
 def make_pipeline_apply(
     mesh: Mesh,
@@ -114,3 +125,139 @@ def make_pipeline_apply(
         return sharded(stage_params, microbatches)
 
     return apply
+
+
+def make_1f1b_train_step(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    *,
+    stage_axis: str = "stage",
+) -> Callable[[Any, jax.Array, jax.Array], tuple]:
+    """Build ``step(stage_params, microbatches, labels) -> (grads, loss)``
+    under the 1F1B schedule.
+
+    ``loss_fn(last_stage_out, labels_mb) -> scalar`` is the per-microbatch
+    loss; the step returns the gradient of ``mean_m loss_fn(out_m, y_m)``
+    with respect to ``stage_params`` (same stacked ``(S, ...)`` layout,
+    sharded over ``stage_axis``) plus that mean loss.  The caller owns the
+    optimizer — this composes with any optax chain exactly like a plain
+    ``jax.grad``.
+
+    Schedule (non-interleaved 1F1B): at tick ``t`` stage ``s`` runs the
+    forward of microbatch ``mf = t - s`` and the backward of microbatch
+    ``mb = t - (2S - 2 - s)`` (each when in ``[0, M)``); the last stage
+    seeds each microbatch's backward from the loss vjp the same tick its
+    forward completes.  Forward activations hop ``s -> s+1`` and
+    cotangents hop ``s -> s-1``, both via ``lax.ppermute``; ticks total
+    ``M + 2S - 2``.  A stage's backward recomputes its forward under
+    ``jax.vjp`` from the stashed input, so the stash holds inputs only.
+    """
+    S = mesh.shape[stage_axis]
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+    def local(stage_params, mbs, labels):
+        p = jax.tree.map(lambda a: a[0], stage_params)  # this device's stage
+        idx = lax.axis_index(stage_axis)
+        is_last = idx == S - 1
+        M = mbs.shape[0]
+        B = min(M, 2 * S - 1)  # max in-flight per stage is 2(S-1)+1
+
+        def var(x):
+            # Idempotent: grad-accumulator zeros derive from the (sharded,
+            # already-varying) params, while activation/stash zeros derive
+            # from the replicated microbatches and need the cast.
+            if stage_axis in getattr(jax.typeof(x), "vma", ()):
+                return x
+            return lax.pcast(x, (stage_axis,), to="varying")
+
+        zero_act = var(jnp.zeros_like(mbs[0]))
+        carry0 = (
+            zero_act,                                   # fwd activation in
+            zero_act,                                   # bwd cotangent in
+            var(jnp.zeros((B,) + mbs.shape[1:], mbs.dtype)),  # input stash
+            jax.tree.map(lambda a: var(jnp.zeros_like(a)), p),  # grad acc
+            var(jnp.zeros((), jnp.float32)),            # loss acc
+        )
+
+        def tick(carry, t):
+            fwd_in, bwd_in, stash, gacc, lacc = carry
+            mf = t - idx
+            mb = t - (2 * S - 2 - idx)
+            fwd_valid = (mf >= 0) & (mf < M)
+            bwd_valid = (mb >= 0) & (mb < M)
+
+            # --- forward micro-step ---
+            mb_t = lax.dynamic_index_in_dim(
+                mbs, jnp.clip(mf, 0, M - 1), axis=0, keepdims=False
+            )
+            act_in = jnp.where((idx == 0) & fwd_valid, mb_t, fwd_in)
+            fwd_out = stage_fn(p, act_in)
+            # Stash this tick's INPUT for the later backward; masked
+            # read-modify-write so drain-phase ticks cannot clobber a
+            # slot whose activation is still awaiting its backward.
+            slot = jnp.mod(mf, B)
+            old = lax.dynamic_index_in_dim(stash, slot, keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(fwd_valid, act_in, old), slot, axis=0
+            )
+
+            # --- backward micro-step (recompute vjp from stashed input) ---
+            # At the last stage mb == mf: its backward input is this very
+            # tick's activation, not yet in any other stage's stash.
+            bslot = jnp.mod(mb, B)
+            a_bwd = jnp.where(
+                is_last, act_in,
+                lax.dynamic_index_in_dim(stash, bslot, keepdims=False),
+            )
+            out, pb = jax.vjp(stage_fn, p, a_bwd)
+            y_mb = lax.dynamic_index_in_dim(
+                labels, jnp.clip(mb, 0, M - 1), axis=0, keepdims=False
+            )
+            lval, lpb = jax.vjp(lambda o: loss_fn(o, y_mb), out)
+            (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
+            cot = jnp.where(bwd_valid,
+                            jnp.where(is_last, seed, bwd_in),
+                            jnp.zeros_like(bwd_in))
+            dp, dact = pb(cot.astype(out.dtype))
+            gacc = jax.tree.map(
+                lambda g, d: g + jnp.where(bwd_valid, d, jnp.zeros_like(d)),
+                gacc, dp,
+            )
+            lacc = lacc + jnp.where(
+                bwd_valid & is_last, lval.astype(jnp.float32) / M, 0.0
+            )
+
+            fwd_next = lax.ppermute(
+                jnp.where(fwd_valid, fwd_out, jnp.zeros_like(fwd_out)),
+                stage_axis, perm_fwd,
+            )
+            bwd_next = lax.ppermute(dact, stage_axis, perm_bwd)
+            return (fwd_next, bwd_next, stash, gacc, lacc), None
+
+        ticks = jnp.arange(M + 2 * S - 2)
+        (_, _, _, gacc, lacc), _ = lax.scan(tick, carry0, ticks)
+        grads = jax.tree.map(lambda g: g[None], gacc)  # (1, ...) local slice
+        loss = lax.psum(lacc, stage_axis)  # only the last stage contributes
+        return grads, loss
+
+    pspec = P(stage_axis)
+
+    @jax.jit
+    def step(stage_params, microbatches, labels):
+        sharded = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(pspec, P(), P()),
+            out_specs=(pspec, P()),
+        )
+        stage_params = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, pspec)
+            ),
+            stage_params,
+        )
+        return sharded(stage_params, microbatches, labels)
+
+    return step

@@ -9,12 +9,14 @@ playbook prescribes: pick a mesh, place shardings, compile, profile.
 
 Rules (the Megatron-LM split, arXiv:1909.08053):
 
-* QKV projection kernel  (d_model, 3*H*Dh) -> shard the OUTPUT columns.
-  NOTE: the column axis is the CONCATENATED [Q|K|V] layout, so this is
-  not the head-local Megatron split — XLA reshards activations inside
-  attention as needed (results exact; per-head interleaving that makes
-  attention collective-free is a perf follow-up),
-* attention out-projection (H*Dh, d_model) -> shard the INPUT rows (its
+* QKV projection kernel (d_model, 3, H, Dh) -> shard the HEAD axis.
+  The model emits QKV through one DenseGeneral with structured
+  (3, H, Dh) features precisely so the kernel HAS a head axis: this is
+  the true head-local Megatron split, and Q/K/V activations plus the
+  whole attention computation stay on the head's device — no activation
+  resharding inside the block (asserted by the HLO collective-count
+  test in tests/test_tp.py),
+* attention out-projection (H, Dh, d_model) -> shard the head rows (its
   matmul contracts the sharded axis; XLA places one psum),
 * MLP up kernel (d, 4d) -> columns; MLP down kernel (4d, d) -> rows
   (same column-then-row pairing, one psum per block),
@@ -42,18 +44,28 @@ def transformer_tp_rules(path: tuple, leaf, model_axis: str) -> P:
     """PartitionSpec for one TransformerLM parameter.
 
     Path keys follow flax's module naming: ``_Attention`` holds two
-    Dense kernels (``Dense_0`` = QKV, ``Dense_1`` = out-projection);
-    ``_Block`` additionally holds the MLP pair (``Dense_0`` up,
-    ``Dense_1`` down) at its own level.
+    DenseGeneral kernels — QKV ``(d_model, 3, H, Dh)`` and
+    out-projection ``(H, Dh, d_model)``, both with an explicit head
+    axis; ``_Block`` additionally holds the MLP Dense pair
+    (``Dense_0`` up, ``Dense_1`` down) at its own level.
     """
     names = [getattr(k, "key", str(k)) for k in path]
-    if leaf.ndim != 2 or len(names) < 2:
+    if len(names) < 2:
+        return P()
+    if any(n.startswith("_Attention") for n in names):
+        # Head-axis sharding on both attention kernels: QKV outputs and
+        # out-projection inputs split per head, so Q/K/V activations,
+        # the attention math, and the contraction stay head-local — the
+        # partitioner places exactly one psum (out-projection) and never
+        # reshards activations inside the block.
+        if leaf.ndim == 4:  # QKV (d_model, 3, H, Dh)
+            return P(None, None, model_axis, None)
+        if leaf.ndim == 3:  # out-projection (H, Dh, d_model)
+            return P(model_axis, None, None)
+        return P()
+    if leaf.ndim != 2:
         return P()  # biases, LayerNorm scales: replicated
     dense = names[-2]  # the Dense module owning this kernel
-    if any(n.startswith("_Attention") for n in names):
-        # Dense_0 = QKV (columns = heads): shard outputs.
-        # Dense_1 = out-projection: shard inputs (contraction -> psum).
-        return P(None, model_axis) if dense == "Dense_0" else P(model_axis, None)
     if any(n.startswith("_Block") for n in names):
         # The block's own Dense pair is the MLP: up = columns, down = rows.
         if dense == "Dense_0":

@@ -81,3 +81,84 @@ def test_pipeline_gradients_match():
     for k in gp:
         np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(rp[k]),
                                    atol=2e-5)
+
+
+def _loss_fn(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def _make_xy(seed, m=None):
+    m = M if m is None else m
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(m, MB, D)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(m, MB, D)).astype(np.float32))
+    return x, y
+
+
+def _ref_loss(params, x, y):
+    out = jax.vmap(lambda mb: _reference(params, mb))(x)
+    return jnp.mean(jax.vmap(_loss_fn)(out, y))
+
+
+def test_1f1b_grads_and_loss_match_unsharded():
+    """The hand-scheduled 1F1B step computes exactly the gradients of the
+    mean microbatch loss through the unsharded layer stack."""
+    from distributed_learning_tpu.training.pp import make_1f1b_train_step
+
+    mesh = _mesh()
+    params = _params(5)
+    x, y = _make_xy(6, m=12)  # M > 2S-1 exercises stash slot reuse
+
+    step = make_1f1b_train_step(mesh, _stage_fn, _loss_fn)
+    with mesh:
+        grads, loss = step(params, x, y)
+
+    ref_loss = _ref_loss(params, x, y)
+    ref_grads = jax.grad(_ref_loss)(params, x, y)
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-6)
+    for k in grads:
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]), atol=2e-5
+        )
+
+
+def test_1f1b_fewer_microbatches_than_stages():
+    """M < S (bubble-dominated, stash depth M) still computes exact
+    gradients — the schedule degrades, not the math."""
+    from distributed_learning_tpu.training.pp import make_1f1b_train_step
+
+    mesh = _mesh()
+    params = _params(7)
+    x, y = _make_xy(8, m=3)
+
+    step = make_1f1b_train_step(mesh, _stage_fn, _loss_fn)
+    with mesh:
+        grads, loss = step(params, x, y)
+    np.testing.assert_allclose(float(loss), float(_ref_loss(params, x, y)),
+                               atol=1e-6)
+    ref_grads = jax.grad(_ref_loss)(params, x, y)
+    for k in grads:
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]), atol=2e-5
+        )
+
+
+def test_1f1b_trains_with_optax():
+    """The (grads, loss) contract composes with an optimizer: a few steps
+    reduce the loss."""
+    import optax
+    from distributed_learning_tpu.training.pp import make_1f1b_train_step
+
+    mesh = _mesh()
+    params = _params(9)
+    x, y = _make_xy(10)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = make_1f1b_train_step(mesh, _stage_fn, _loss_fn)
+    with mesh:
+        _, l0 = step(params, x, y)
+        for _ in range(8):
+            grads, loss = step(params, x, y)
+            updates, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+    assert float(loss) < float(l0)

@@ -50,11 +50,13 @@ def test_tp_rules_place_expected_axes():
     def visit(path, leaf):
         spec = transformer_tp_rules(path, leaf, "model")
         names = [getattr(k, "key", str(k)) for k in path]
-        if any(n.startswith("_Attention") for n in names) and leaf.ndim == 2:
-            if names[-2] == "Dense_0":
-                assert spec == P(None, "model"); seen["qkv"] += 1
-            else:
-                assert spec == P("model", None); seen["attn_out"] += 1
+        if any(n.startswith("_Attention") for n in names) and leaf.ndim > 2:
+            if leaf.ndim == 4:  # QKV (d, 3, H, Dh): head axis sharded
+                assert spec == P(None, None, "model", None)
+                seen["qkv"] += 1
+            else:  # out-projection (H, Dh, d): head axis sharded
+                assert spec == P("model", None, None)
+                seen["attn_out"] += 1
         elif any(n.startswith("_Block") for n in names) and leaf.ndim == 2 \
                 and names[-2] in ("Dense_0", "Dense_1"):
             key = "mlp_up" if names[-2] == "Dense_0" else "mlp_down"
@@ -79,9 +81,9 @@ def test_tp_sharded_forward_matches_unsharded():
     ref_logits = model.apply({"params": params}, x)
 
     sharded = shard_transformer_params(params, mesh, "model")
-    # A sharded QKV kernel really is split over the model axis.
-    qkv = sharded["_Block_0"]["_Attention_0"]["Dense_0"]["kernel"]
-    assert qkv.sharding.spec == P(None, "model")
+    # A sharded QKV kernel really is split over the model axis (heads).
+    qkv = sharded["_Block_0"]["_Attention_0"]["DenseGeneral_0"]["kernel"]
+    assert qkv.sharding.spec == P(None, None, "model", None)
 
     with mesh:
         logits = jax.jit(lambda p, t: model.apply({"params": p}, t))(
@@ -90,6 +92,29 @@ def test_tp_sharded_forward_matches_unsharded():
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(ref_logits), atol=2e-5
     )
+
+
+def test_tp_attention_is_collective_free_on_activations():
+    """The per-head QKV/out-projection layout keeps every activation
+    inside attention on its head's device: the compiled forward contains
+    NO all-gather / all-to-all — only the psums the Megatron split
+    prescribes (out-projection and MLP-down contractions)."""
+    mesh = _mesh()
+    model = _model()
+    x, _ = _data(3)
+    params = model.init(jax.random.key(3), x)["params"]
+    sharded = shard_transformer_params(params, mesh, "model")
+    with mesh:
+        lowered = jax.jit(lambda p, t: model.apply({"params": p}, t)).lower(
+            sharded,
+            jax.device_put(
+                x, jax.sharding.NamedSharding(mesh, P("data", None))
+            ),
+        )
+        txt = lowered.compile().as_text()
+    assert txt.count("all-gather") == 0, "activations were resharded"
+    assert txt.count("all-to-all") == 0
+    assert txt.count("all-reduce") > 0  # the contraction psums remain
 
 
 def test_tp_train_step_trains_and_keeps_layout():
@@ -108,5 +133,8 @@ def test_tp_train_step_trains_and_keeps_layout():
             params, opt, loss = step(params, opt, x, y)
     assert np.isfinite(float(loss))
     assert float(loss) < float(l0)
-    qkv = params["_Block_0"]["_Attention_0"]["Dense_0"]["kernel"]
-    assert qkv.sharding.spec == P(None, "model"), qkv.sharding
+    qkv = params["_Block_0"]["_Attention_0"]["DenseGeneral_0"]["kernel"]
+    # XLA may normalize away trailing Nones in the round-tripped spec.
+    assert qkv.sharding.spec in (
+        P(None, None, "model", None), P(None, None, "model")
+    ), qkv.sharding
