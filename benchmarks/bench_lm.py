@@ -1,0 +1,141 @@
+"""Language-model training throughput: tokens/sec, full vs flash attention.
+
+Beyond-parity evidence for the long-context path (the reference has no
+sequence models anywhere — SURVEY.md §5): steady-state causal-LM training
+throughput of :class:`TransformerLM` on one chip, with the O(T^2)
+materialized reference attention versus the Pallas flash kernels
+(``ops/flash_attention.py``, fwd + custom-vjp backward).  Same model, same
+data, same optimizer — the only variable is ``attn_impl``, so the delta is
+the kernel.
+
+Model at full scale: 8 layers, 8 heads x 128 head-dim (d_model=1024),
+vocab 8192, bf16 compute — ~117M params, the MXU-friendly shape class.
+Sequence lengths 4096 and 8192 (flash only at 8192; full attention's
+(B, H, T, T) f32 score tensor is already multi-GB there).
+
+Prints one JSON line per (impl, T); ``vs_baseline`` is null (no reference
+anchor exists for any sequence workload).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from benchmarks.common import emit, full_scale, platform, smoke, sync
+
+
+def _measure(
+    attn_impl: str,
+    T: int,
+    *,
+    B: int,
+    vocab: int,
+    num_layers: int,
+    num_heads: int,
+    head_dim: int,
+    steps: int,
+    warm: int = 2,
+) -> tuple[float, float]:
+    """Returns (tokens_per_sec, seconds_per_step) at steady state."""
+    from distributed_learning_tpu.models import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=vocab,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        head_dim=head_dim,
+        max_len=T,
+        attn_impl=attn_impl,
+        dtype=jnp.bfloat16,
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, vocab, size=(B, T)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, vocab, size=(B, T)), jnp.int32)
+
+    params = jax.jit(model.init)(jax.random.key(0), x)["params"]
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = jax.jit(tx.init)(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(warm):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    sync(loss)
+    dt = (time.perf_counter() - t0) / steps
+    return B * T / dt, dt
+
+
+def run() -> None:
+    full = full_scale()
+    if full:
+        cases = [
+            ("full", 4096), ("flash", 4096), ("flash", 8192),
+        ]
+        kw = dict(B=2, vocab=8192, num_layers=8, num_heads=8,
+                  head_dim=128, steps=8)
+    else:
+        cases = [("full", 128), ("flash", 128)]
+        kw = dict(B=2, vocab=64, num_layers=2, num_heads=2, head_dim=16,
+                  steps=1 if smoke() else 2)
+    results = {}
+    for impl, T in cases:
+        try:
+            toks, dt = _measure(impl, T, **kw)
+        except Exception as e:  # OOM at the quadratic sizes
+            emit({
+                "metric": f"lm_train_tokens_per_sec_{impl}_T{T}",
+                "value": None,
+                "unit": "tokens/sec",
+                "vs_baseline": None,
+                "error": f"{type(e).__name__}: {str(e)[:120]}",
+            })
+            continue
+        results[(impl, T)] = toks
+        emit({
+            "metric": f"lm_train_tokens_per_sec_{impl}_T{T}",
+            "value": round(toks, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "config": (
+                f"TransformerLM L{kw['num_layers']} H{kw['num_heads']}x"
+                f"{kw['head_dim']} vocab{kw['vocab']} B{kw['B']} bf16, "
+                f"attn={impl}, single chip"
+            ),
+            "seconds_per_step": round(dt, 4),
+            "platform": platform(),
+        })
+    # Headline ratio: the kernel's end-to-end training win at matched T.
+    for T in sorted({t for _, t in cases}):
+        fu, fl = results.get(("full", T)), results.get(("flash", T))
+        if fu and fl:
+            emit({
+                "metric": f"lm_train_flash_speedup_T{T}",
+                "value": round(fl / fu, 3),
+                "unit": "x vs full attention",
+                "vs_baseline": None,
+                "platform": platform(),
+            })
+
+
+if __name__ == "__main__":
+    run()

@@ -18,6 +18,7 @@ from benchmarks import (
     bench_timevarying,
     bench_attention,
     bench_compression,
+    bench_lm,
 )
 
 CONFIGS = [
@@ -28,6 +29,7 @@ CONFIGS = [
     ("5: CIFAR-100 WRN time-varying + Chebyshev", bench_timevarying.run),
     ("+: flash-attention kernel TFLOP/s (beyond-parity)", bench_attention.run),
     ("+: compressed gossip rounds/bytes (beyond-parity)", bench_compression.run),
+    ("+: LM training tokens/sec, full vs flash attention", bench_lm.run),
     ("+: label-skewed Titanic non-IID accuracy (real data)", bench_titanic_noniid.run),
 ]
 

@@ -5,9 +5,10 @@ The fifth axis of the parallelism matrix: expert weights are a stacked
 axis, and the layer is written as dense einsums against a one-hot
 dispatch tensor — the GShard formulation (arXiv:2006.16668) that keeps
 shapes static so the XLA partitioner can place the token all-to-alls
-itself.  No dynamic routing control flow anywhere: ``top-1`` gating
-becomes a ``(tokens, E, C)`` one-hot, dispatch and combine are its two
-einsum contractions.
+itself.  No dynamic routing control flow anywhere: top-k gating
+becomes k stacked ``(tokens, E, C)`` one-hots (k is a small static
+constant — 1 = Switch routing, 2 = the GShard default), dispatch and
+combine are einsum contractions against them.
 
 Capacity: each expert processes at most ``C = ceil(tokens/E * factor)``
 tokens; overflow tokens fall through the residual (their MoE
@@ -32,12 +33,23 @@ __all__ = ["MoEMLP", "shard_moe_params", "moe_param_spec"]
 
 
 class MoEMLP(nn.Module):
-    """Top-1 MoE feed-forward block: gate -> dispatch -> per-expert MLP
-    -> combine.  Input/output (B, T, d)."""
+    """Top-k MoE feed-forward block: gate -> dispatch -> per-expert MLP
+    -> combine.  Input/output (B, T, d).
+
+    ``top_k=1`` is the Switch-style router; ``top_k=2`` the GShard
+    default (second choice queues for capacity AFTER every first
+    choice, the standard priority rule).  Selected gates renormalize to
+    sum to one.  The router's load-balance auxiliary
+    (``aux = E * sum_e f_e * P_e`` — arXiv:2101.03961 eq. 4, where
+    ``f_e`` is the fraction of tokens first-routed to expert ``e`` and
+    ``P_e`` the mean router probability) is sown as
+    ``moe_stats/load_balance_loss`` for the training loss to pick up.
+    """
 
     num_experts: int
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
+    top_k: int = 1
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -45,28 +57,64 @@ class MoEMLP(nn.Module):
         B, T, d = x.shape
         E = self.num_experts
         S = B * T
+        if not 1 <= self.top_k <= E:
+            raise ValueError(f"top_k {self.top_k} not in [1, {E}]")
         C = max(1, math.ceil(S / E * self.capacity_factor))
         tokens = x.reshape(S, d)
 
         gate_logits = nn.Dense(E, use_bias=False, dtype=self.dtype,
                                name="gate")(tokens)  # (S, E)
         probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-        expert = jnp.argmax(probs, axis=-1)           # (S,)
-        gate = jnp.max(probs, axis=-1)                # (S,)
 
-        # Position of each token within its expert's capacity buffer:
-        # rank among same-expert tokens in sequence order (static shapes:
-        # a cumsum over the one-hot).
-        onehot_e = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (S, E)
-        pos = (jnp.cumsum(onehot_e, axis=0) - onehot_e) * onehot_e  # (S, E)
-        pos_in_e = jnp.sum(pos, axis=-1).astype(jnp.int32)  # (S,)
-        kept = pos_in_e < C
-        # (S, E, C) dispatch: one-hot over both expert and slot, zeroed
-        # for dropped tokens.
-        dispatch = (
-            onehot_e[:, :, None]
-            * jax.nn.one_hot(pos_in_e, C, dtype=jnp.float32)[:, None, :]
-            * kept[:, None, None]
+        # k routing choices, each a one-hot over experts; choice j+1 is
+        # the argmax with previous choices masked out (static shapes —
+        # this is a Python loop over a small constant k).
+        masked = probs
+        onehots, gates = [], []
+        for _ in range(self.top_k):
+            expert_j = jnp.argmax(masked, axis=-1)             # (S,)
+            oh = jax.nn.one_hot(expert_j, E, dtype=jnp.float32)
+            onehots.append(oh)
+            gates.append(jnp.sum(probs * oh, axis=-1))         # (S,)
+            masked = masked * (1.0 - oh)
+        if self.top_k > 1:
+            # Renormalize the selected gates (GShard): combine weights
+            # sum to 1 over the chosen experts.
+            gsum = sum(gates)
+            gates = [g / jnp.maximum(gsum, 1e-9) for g in gates]
+        # top_k == 1 keeps the RAW router probability as the combine
+        # weight (Switch-style) — renormalizing would make it constant
+        # 1.0 and cut the router out of the gradient entirely.
+
+        # Capacity slots with choice priority: choice j's tokens queue
+        # behind ALL tokens of choices < j for the same expert.
+        occupancy = jnp.zeros((E,), jnp.float32)
+        dispatches = []
+        for oh in onehots:
+            pos = (jnp.cumsum(oh, axis=0) - oh) * oh           # (S, E)
+            pos_in_e = (
+                jnp.sum(pos, axis=-1) + jnp.sum(oh * occupancy, axis=-1)
+            ).astype(jnp.int32)                                # (S,)
+            kept = pos_in_e < C
+            dispatches.append(
+                oh[:, :, None]
+                * jax.nn.one_hot(pos_in_e, C, dtype=jnp.float32)[:, None, :]
+                * kept[:, None, None]
+            )
+            occupancy = occupancy + jnp.sum(oh, axis=0)
+        # (S, E, C) combined dispatch, gate-weighted combine tensor.
+        dispatch = sum(dispatches)
+        combine_w = sum(
+            g[:, None, None] * dsp for g, dsp in zip(gates, dispatches)
+        )
+
+        # Load-balance aux on FIRST choices (Switch eq. 4).
+        f_e = jnp.mean(onehots[0], axis=0)                     # (E,)
+        p_e = jnp.mean(probs, axis=0)                          # (E,)
+        self.sow(
+            "moe_stats", "load_balance_loss",
+            E * jnp.sum(f_e * p_e),
+            reduce_fn=lambda a, b: b,
         )
 
         # Expert buffers: (E, C, d) — the all-to-all XLA inserts when
@@ -91,11 +139,12 @@ class MoEMLP(nn.Module):
         out_e = jnp.einsum("ech,ehd->ecd", act, w_dn.astype(jnp.float32))
         out_e = out_e + b_dn.astype(jnp.float32)[:, None, :]
 
-        combined = jnp.einsum("sec,ecd->sd", dispatch, out_e)
-        out = combined * gate[:, None]                 # top-1 scaling
+        # Combine with the gate-weighted tensor: out_s = sum over the
+        # token's kept choices of gate_j * expert_out.
+        out = jnp.einsum("sec,ecd->sd", combine_w, out_e)
         self.sow(
             "moe_stats", "dropped_fraction",
-            1.0 - jnp.sum(dispatch) / S,
+            1.0 - jnp.sum(dispatch) / (S * self.top_k),
             reduce_fn=lambda a, b: b,
         )
         return out.reshape(B, T, d).astype(x.dtype)

@@ -81,6 +81,7 @@ class _Block(nn.Module):
     dtype: jnp.dtype = jnp.float32
     mlp: str = "dense"
     num_experts: int = 4
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -95,7 +96,7 @@ class _Block(nn.Module):
             # stacked (E, ...) kernels shardable over an expert mesh axis.
             return x + MoEMLP(
                 num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
-                dtype=self.dtype,
+                top_k=self.moe_top_k, dtype=self.dtype,
             )(h)
         if self.mlp != "dense":
             raise ValueError(f"unknown mlp {self.mlp!r} (want dense|moe)")
@@ -125,6 +126,7 @@ class TransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     mlp: str = "dense"       # "dense" | "moe" (expert-parallel blocks)
     num_experts: int = 4
+    moe_top_k: int = 1       # router choices per token (1=Switch, 2=GShard)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -157,7 +159,7 @@ class TransformerLM(nn.Module):
             x = _Block(
                 self.num_heads, self.head_dim, self.mlp_ratio,
                 self.attn_impl, self.seq_axis, self.dtype,
-                self.mlp, self.num_experts,
+                self.mlp, self.num_experts, self.moe_top_k,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)

@@ -93,6 +93,26 @@ def test_bench_attention_smoke(capsys):
         assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
 
 
+def test_bench_lm_smoke(capsys, monkeypatch):
+    monkeypatch.setenv("BENCH_SMOKE", "1")
+    from benchmarks import bench_lm
+
+    bench_lm.run()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    toks = [
+        r for r in lines
+        if r["metric"].startswith("lm_train_tokens_per_sec")
+        and isinstance(r["value"], (int, float)) and "error" not in r
+    ]
+    # Both attention impls must produce a real tokens/sec number, plus
+    # the matched-T speedup ratio record.
+    assert len(toks) >= 2, lines
+    assert any(r["metric"].startswith("lm_train_flash_speedup")
+               for r in lines), lines
+    for r in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
+
+
 def test_publish_merges_jsonl_into_baseline(tmp_path):
     import json
 
