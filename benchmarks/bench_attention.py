@@ -26,7 +26,7 @@ V5E_BF16_PEAK_TFLOPS = 197.0
 
 def _measure(
     T: int, block_q: int, block_k: int, *, B=1, H=8, D=128, iters=8,
-    interpret=False, backward=False,
+    interpret=False, backward=False, window=None,
 ):
     from distributed_learning_tpu.ops.flash_attention import flash_attention
 
@@ -40,7 +40,7 @@ def _measure(
         grad_fn = jax.jit(jax.grad(
             lambda q, k, v: flash_attention(
                 q, k, v, causal=True, block_q=block_q, block_k=block_k,
-                interpret=interpret,
+                interpret=interpret, window=window,
             ).astype(jnp.float32).sum(),
             argnums=(0, 1, 2),
         ))
@@ -48,6 +48,7 @@ def _measure(
     else:
         fn = lambda: flash_attention(
             q, k, v, causal=True, block_q=block_q, block_k=block_k,
+            window=window,
             interpret=interpret,
         )
     out = fn()
@@ -59,7 +60,12 @@ def _measure(
         out = fn()
     sync(out)
     dt = (time.perf_counter() - t0) / iters
-    fwd_flops = 4 * B * H * T * T * D / 2  # causal
+    if window is None:
+        live_pairs = T * T / 2  # causal triangle
+    else:
+        W = min(window, T)
+        live_pairs = W * (W + 1) / 2 + (T - W) * W  # causal band
+    fwd_flops = 4 * B * H * D * live_pairs
     # USEFUL-FLOPs convention (the standard flash accounting): backward =
     # 2.5x forward (5 gradient matmuls vs 2), plus the lse-producing
     # forward, = 3.5x.  The kernels EXECUTE more than that — the split
@@ -160,6 +166,40 @@ def run() -> None:
                         tflops / V5E_BF16_PEAK_TFLOPS, 3
                     ),
                 })
+
+    # Sliding-window long context: the O(T * W) path that makes 131k+
+    # affordable.  One record (tiny interpreted sizes off-TPU, so the
+    # path stays rot-guarded by the smoke test).
+    if on_tpu and full_scale():
+        Tw, W, bq, bk = 131072, 4096, 256, 512
+    else:
+        Tw, W, bq, bk = 256, 64, 128, 128
+    try:
+        tflops, dt = _measure(Tw, bq, bk, iters=iters, window=W,
+                              interpret=interpret)
+    except Exception as e:
+        emit({
+            "metric": f"flash_attention_window{W}_T{Tw}",
+            "value": None,
+            "unit": "TFLOP/s",
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {str(e)[:120]}",
+        })
+    else:
+        emit({
+            "metric": f"flash_attention_window{W}_T{Tw}",
+            "value": round(tflops, 2),
+            "unit": "TFLOP/s",
+            "vs_baseline": None,
+            "config": (
+                f"B1 H8 D128 bf16, sliding window {W}, "
+                f"block_q={bq} block_k={bk}"
+            ),
+            "seconds_per_call": round(dt, 4),
+            "fraction_of_v5e_peak": round(
+                tflops / V5E_BF16_PEAK_TFLOPS, 3
+            ),
+        })
 
 
 if __name__ == "__main__":

@@ -12,6 +12,8 @@ sequence-parallel over the device ring.
 
 from __future__ import annotations
 
+import functools
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -24,7 +26,7 @@ from distributed_learning_tpu.ops.ring_attention import (
     ulysses_attention,
 )
 
-__all__ = ["TransformerLM"]
+__all__ = ["TransformerLM", "generate"]
 
 
 class _Attention(nn.Module):
@@ -33,6 +35,9 @@ class _Attention(nn.Module):
     attn_impl: str = "full"
     seq_axis: str = "seq"
     dtype: jnp.dtype = jnp.float32
+    window: int | None = None  # sliding window (full/flash paths only)
+    decode: bool = False       # autoregressive KV-cache mode
+    cache_len: int = 0         # static KV-cache length (decode mode)
 
     @nn.compact
     def __call__(self, x):
@@ -48,12 +53,20 @@ class _Attention(nn.Module):
             use_bias=False, dtype=self.dtype,
         )(x)  # (B, T, 3, H, Dh)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.window is not None and self.attn_impl not in ("full", "flash"):
+            raise ValueError(
+                f"window is only supported for full/flash attention, "
+                f"not {self.attn_impl!r}"
+            )
+        if self.decode:
+            return self._decode_step(q, k, v, x)
         if self.attn_impl == "full":
-            out = attention_reference(q, k, v, causal=True)
+            out = attention_reference(q, k, v, causal=True,
+                                      window=self.window)
         elif self.attn_impl == "flash":
             from distributed_learning_tpu.ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True, window=self.window)
         elif self.attn_impl == "ring":
             out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
         elif self.attn_impl == "ring_flash":
@@ -66,10 +79,64 @@ class _Attention(nn.Module):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         # Out-projection contracts (H, Dh) directly — kernel (H, Dh, d),
         # head-sharded under TP with one psum placed by the partitioner.
+        return self._out_proj(out, x.shape[-1])
+
+    def _out_proj(self, out, d):
         return nn.DenseGeneral(
-            features=x.shape[-1], axis=(-2, -1),
-            use_bias=False, dtype=self.dtype,
+            features=d, axis=(-2, -1),
+            use_bias=False, dtype=self.dtype, name="DenseGeneral_1",
         )(out)
+
+    def _decode_step(self, q, k, v, x):
+        """Autoregressive attention against a static KV cache.
+
+        One method covers prefill (T = prompt length at write index 0)
+        and stepping (T = 1): this call's K/V are written at positions
+        ``[i, i+T)`` of a fixed ``(B, cache_len, H, Dh)`` cache pair,
+        and each query row ``t`` attends to cached positions
+        ``<= i + t`` (inside ``window`` if set) — masking by position
+        instead of slicing keeps every shape static for jit.
+        """
+        B, T, H, Dh = q.shape
+        L = self.cache_len
+        if T > L:
+            raise ValueError(
+                f"prefill length {T} exceeds the cache ({L}); a longer "
+                "prompt would silently clamp the cache write"
+            )
+        ck = self.variable(
+            "cache", "key",
+            lambda: jnp.zeros((B, L, H, Dh), self.dtype),
+        )
+        cv = self.variable(
+            "cache", "value",
+            lambda: jnp.zeros((B, L, H, Dh), self.dtype),
+        )
+        idx = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = idx.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(self.dtype), (0, i, 0, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(self.dtype), (0, i, 0, 0)
+        )
+        idx.value = i + T
+        scale = 1.0 / (Dh ** 0.5)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, ck.value
+        ).astype(jnp.float32) * scale
+        qpos = i + jnp.arange(T)                      # (T,)
+        kpos = jnp.arange(L)                          # (L,)
+        live = kpos[None, :] <= qpos[:, None]         # (T, L)
+        if self.window is not None:
+            live &= kpos[None, :] > qpos[:, None] - self.window
+        s = jnp.where(live[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.value.dtype),
+                         cv.value)
+        return self._out_proj(out, x.shape[-1])
 
 
 class _Block(nn.Module):
@@ -82,13 +149,16 @@ class _Block(nn.Module):
     mlp: str = "dense"
     num_experts: int = 4
     moe_top_k: int = 1
+    attn_window: int | None = None
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + _Attention(
             self.num_heads, self.head_dim, self.attn_impl, self.seq_axis,
-            self.dtype,
+            self.dtype, self.attn_window, self.decode, self.cache_len,
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.mlp == "moe":
@@ -127,16 +197,37 @@ class TransformerLM(nn.Module):
     mlp: str = "dense"       # "dense" | "moe" (expert-parallel blocks)
     num_experts: int = 4
     moe_top_k: int = 1       # router choices per token (1=Switch, 2=GShard)
+    attn_window: int | None = None  # sliding-window attention (full/flash)
+    decode: bool = False     # KV-cache autoregressive mode (see generate).
+                             # Direct decode users must keep prompt+steps
+                             # <= max_len; past it the dynamic cache write
+                             # clamps (generate() enforces the bound).
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
+        if self.attn_window is not None and \
+                self.attn_impl not in ("full", "flash"):
+            # Checked here (not only in _Attention) so the error fires
+            # before the sequence-parallel paths touch their mesh axis.
+            raise ValueError(
+                f"attn_window is only supported for full/flash attention, "
+                f"not {self.attn_impl!r}"
+            )
         d_model = self.num_heads * self.head_dim
         T = tokens.shape[1]
         x = nn.Embed(self.vocab_size, d_model, dtype=self.dtype)(tokens)
         # Positions must be GLOBAL: under shard_map (ring/ulysses) each
         # shard sees only its local T, so offset by the shard index.
         # "full" and "flash" are single-device paths (no mesh axis bound).
-        if self.attn_impl in ("full", "flash"):
+        if self.decode:
+            if self.attn_impl not in ("full", "flash"):
+                raise ValueError("decode mode requires full/flash attention")
+            pos_v = self.variable(
+                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+            )
+            positions = pos_v.value + jnp.arange(T)
+            pos_v.value = pos_v.value + T
+        elif self.attn_impl in ("full", "flash"):
             if T > self.max_len:
                 raise ValueError(
                     f"sequence length {T} exceeds max_len {self.max_len}; "
@@ -160,7 +251,85 @@ class TransformerLM(nn.Module):
                 self.num_heads, self.head_dim, self.mlp_ratio,
                 self.attn_impl, self.seq_axis, self.dtype,
                 self.mlp, self.num_experts, self.moe_top_k,
+                self.attn_window, self.decode, self.max_len,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)
         return logits.astype(jnp.float32)
+
+
+def generate(
+    model: TransformerLM,
+    params,
+    prompt: jax.Array,
+    steps: int,
+    *,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+) -> jax.Array:
+    """Autoregressive generation with a KV cache: prefill the prompt in
+    one pass, then one jitted single-token step per new token under
+    ``lax.scan``.
+
+    ``prompt`` is (B, Tp) int32; returns (B, steps) generated tokens.
+    ``temperature=0`` is greedy argmax; otherwise tokens are sampled
+    from ``softmax(logits / temperature)`` (``key`` required).  The
+    decode-mode model reuses the TRAINING parameters unchanged — the
+    cache is a flax ``cache`` collection threaded through the scan, so
+    the whole loop compiles to one program with static shapes.
+    """
+    B, Tp = prompt.shape
+    if Tp + steps > model.max_len:
+        raise ValueError(
+            f"prompt ({Tp}) + steps ({steps}) exceeds max_len "
+            f"{model.max_len}"
+        )
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    run = _generate_runner(model.clone(decode=True), steps,
+                           float(temperature))
+    return run(params, prompt, key)
+
+
+@functools.lru_cache(maxsize=64)
+def _generate_runner(dec: TransformerLM, steps: int, temperature: float):
+    """The jitted prefill+scan program for one (model, steps,
+    temperature) configuration.  Cached by the module's (frozen,
+    hashable) dataclass identity so repeated :func:`generate` calls with
+    the same settings reuse the compile instead of re-tracing — jit
+    caches by function object, and a closure built inside ``generate``
+    would be fresh every call."""
+
+    def pick(logits, k, dtype):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(dtype)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1
+        ).astype(dtype)
+
+    @jax.jit
+    def _run(params, prompt, key):
+        logits, state = dec.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        key0 = key if key is not None else jax.random.key(0)
+        k_first, k_scan = jax.random.split(key0)
+        tok = pick(logits[:, -1], k_first, prompt.dtype)
+
+        def step(carry, k_t):
+            cache, tok = carry
+            logits, st = dec.apply(
+                {"params": params, "cache": cache["cache"]},
+                tok[:, None], mutable=["cache"],
+            )
+            nxt = pick(logits[:, -1], k_t, tok.dtype)
+            return (st, nxt), tok
+
+        keys = jax.random.split(k_scan, steps)
+        # Each iteration collects the token ENTERING it, so toks is
+        # exactly [t_1 .. t_steps]; the final carry (t_steps+1) is
+        # unneeded lookahead.
+        _, toks = jax.lax.scan(step, (state, tok), keys)
+        return toks.T
+
+    return _run

@@ -73,12 +73,21 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _causal_live(qi, kj, block_q, block_k):
-    """Whether block (qi, kj) holds any unmasked (row >= col) pair."""
-    return kj * block_k <= (qi + 1) * block_q - 1
+def _causal_live(qi, kj, block_q, block_k, window=None):
+    """Whether block (qi, kj) holds any unmasked (row >= col) pair —
+    and, with a sliding ``window``, any pair inside the band
+    ``col >= row - window + 1``.  Blocks entirely below the band are as
+    dead as blocks above the diagonal: skipping both is what turns the
+    windowed kernel's cost from O(T^2) into O(T * window)."""
+    live = kj * block_k <= (qi + 1) * block_q - 1
+    if window is not None:
+        # program ids are traced: combine with &, not `and`.
+        live = live & ((kj + 1) * block_k - 1 >= qi * block_q - (window - 1))
+    return live
 
 
-def _masked_scores(q, k_blk, qi, kj, block_q, block_k, sm_scale, causal):
+def _masked_scores(q, k_blk, qi, kj, block_q, block_k, sm_scale, causal,
+                   window=None):
     """Scaled (block_q, block_k) scores with causal masking applied.
 
     The Q@K^T matmul runs in the refs' native dtype (bf16 in the training
@@ -99,13 +108,16 @@ def _masked_scores(q, k_blk, qi, kj, block_q, block_k, sm_scale, causal):
         cols = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(cols <= rows, s, _NEG_INF)
+        keep = cols <= rows
+        if window is not None:
+            keep &= cols >= rows - (window - 1)
+        s = jnp.where(keep, s, _NEG_INF)
     return s
 
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, sm_scale, causal,
+    *, sm_scale, causal, window=None,
 ):
     """One (bh, qi, kj) grid step of the online-softmax recurrence."""
     qi, kj = pl.program_id(1), pl.program_id(2)
@@ -121,12 +133,14 @@ def _flash_kernel(
 
     # Causal: blocks whose first key is beyond this q block's last query
     # are fully masked — skip their FLOPs entirely.
-    live = _causal_live(qi, kj, block_q, block_k) if causal else True
+    live = (_causal_live(qi, kj, block_q, block_k, window)
+            if causal else True)
 
     @pl.when(live)
     def _step():
         s = _masked_scores(
-            q_ref[0], k_ref[0], qi, kj, block_q, block_k, sm_scale, causal
+            q_ref[0], k_ref[0], qi, kj, block_q, block_k, sm_scale, causal,
+            window,
         )
         m_prev = m_ref[:, :1]  # lane-replicated; any lane is the value
         l_prev = l_ref[:, :1]
@@ -163,7 +177,7 @@ def _flash_kernel(
 
 def _flash_dq_kernel(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dadj_ref, dq_ref, dq_acc,
-    *, sm_scale, causal,
+    *, sm_scale, causal, window=None,
 ):  # dadj_ref is None on the plain path (no lse consumer): zero term.
     """dQ for one Q block: sequential accumulation over K/V blocks.
 
@@ -182,12 +196,14 @@ def _flash_dq_kernel(
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    live = _causal_live(qi, kj, block_q, block_k) if causal else True
+    live = (_causal_live(qi, kj, block_q, block_k, window)
+            if causal else True)
 
     @pl.when(live)
     def _step():
         s = _masked_scores(
-            q_ref[0], k_ref[0], qi, kj, block_q, block_k, sm_scale, causal
+            q_ref[0], k_ref[0], qi, kj, block_q, block_k, sm_scale, causal,
+            window,
         )
         p = jnp.exp(s - lse_ref[0][:, :1])  # (bq, bk); masked entries -> 0
         # Matmuls run on native-dtype operands with f32 accumulation (see
@@ -217,7 +233,7 @@ def _flash_dq_kernel(
 
 def _flash_dkv_kernel(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dadj_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, sm_scale, causal,
+    dk_acc, dv_acc, *, sm_scale, causal, window=None,
 ):
     """dK and dV for one K/V block: sequential accumulation over Q blocks.
     ``dadj`` as in :func:`_flash_dq_kernel`."""
@@ -231,13 +247,15 @@ def _flash_dkv_kernel(
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    live = _causal_live(qi, kj, block_q, block_k) if causal else True
+    live = (_causal_live(qi, kj, block_q, block_k, window)
+            if causal else True)
 
     @pl.when(live)
     def _step():
         q_blk = q_ref[0]
         s = _masked_scores(
-            q_blk, k_ref[0], qi, kj, block_q, block_k, sm_scale, causal
+            q_blk, k_ref[0], qi, kj, block_q, block_k, sm_scale, causal,
+            window,
         )
         p = jnp.exp(s - lse_ref[0][:, :1])  # (bq, bk)
         delta = jnp.sum(
@@ -269,19 +287,20 @@ def _flash_dkv_kernel(
 
 
 def _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
-              *, with_lse):
+              *, with_lse, window=None):
     """Forward pallas_call; ``with_lse=False`` (the inference/primal path)
     omits the lse output entirely so forward-only callers don't pay a
     (BH, T, 128) f32 HBM write they would immediately discard."""
     BH, T, D = qb.shape
     if with_lse:
         kernel = functools.partial(
-            _flash_kernel, sm_scale=sm_scale, causal=causal
+            _flash_kernel, sm_scale=sm_scale, causal=causal, window=window
         )
     else:
         def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
             _flash_kernel(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref,
-                          l_ref, sm_scale=sm_scale, causal=causal)
+                          l_ref, sm_scale=sm_scale, causal=causal,
+                          window=window)
     o_spec = pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0))
     lse_spec = pl.BlockSpec(
         (1, block_q, _LANES), lambda bh, qi, kj: (bh, qi, 0)
@@ -311,7 +330,7 @@ def _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
 
 
 def _bwd_call(qb, kb, vb, out, do, lse, dadj, sm_scale, causal, block_q,
-              block_k, interpret):
+              block_k, interpret, window=None):
     """The two backward pallas_calls, shared by both custom VJPs.
 
     ``dadj=None`` (the plain path — no lse consumer) omits the extra
@@ -327,12 +346,13 @@ def _bwd_call(qb, kb, vb, out, do, lse, dadj, sm_scale, causal, block_q,
     extra = [] if dadj is None else [dadj]
 
     dq_kernel = functools.partial(
-        _flash_dq_kernel, sm_scale=sm_scale, causal=causal
+        _flash_dq_kernel, sm_scale=sm_scale, causal=causal, window=window
     )
     if dadj is None:
         def dq_kernel(q, k, v, o, do_, lse_, dq_, acc):
             _flash_dq_kernel(q, k, v, o, do_, lse_, None, dq_, acc,
-                             sm_scale=sm_scale, causal=causal)
+                             sm_scale=sm_scale, causal=causal,
+                             window=window)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, T // block_q, T // block_k),
@@ -354,12 +374,13 @@ def _bwd_call(qb, kb, vb, out, do, lse, dadj, sm_scale, causal, block_q,
     )(qb, kb, vb, out, do, lse, *extra)
 
     dkv_kernel = functools.partial(
-        _flash_dkv_kernel, sm_scale=sm_scale, causal=causal
+        _flash_dkv_kernel, sm_scale=sm_scale, causal=causal, window=window
     )
     if dadj is None:
         def dkv_kernel(q, k, v, o, do_, lse_, dk_, dv_, ka, va):
             _flash_dkv_kernel(q, k, v, o, do_, lse_, None, dk_, dv_, ka, va,
-                              sm_scale=sm_scale, causal=causal)
+                              sm_scale=sm_scale, causal=causal,
+                              window=window)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, T // block_k, T // block_q),
@@ -392,24 +413,27 @@ def _bwd_call(qb, kb, vb, out, do, lse, dadj, sm_scale, causal, block_q,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
+           window):
     return _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k,
-                     interpret, with_lse=False)
+                     interpret, with_lse=False, window=window)
 
 
-def _flash_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
+               window):
     out, lse = _fwd_call(qb, kb, vb, sm_scale, causal, block_q, block_k,
-                         interpret, with_lse=True)
+                         interpret, with_lse=True, window=window)
     return out, (qb, kb, vb, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, window, res,
+               do):
     qb, kb, vb, out, lse = res
     # dadj=None: no lse consumer, so the kernels omit the input entirely
     # instead of streaming a known-zero tensor through both grids.
     return _bwd_call(qb, kb, vb, out, do, lse, None, sm_scale, causal,
-                     block_q, block_k, interpret)
+                     block_q, block_k, interpret, window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -482,7 +506,10 @@ def _prep_blocks(q, k, v, block_q, block_k):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=(
+        "causal", "sm_scale", "block_q", "block_k", "interpret", "window"
+    ),
 )
 def flash_attention(
     q: jax.Array,
@@ -494,6 +521,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Fused attention on (B, T, H, D); T must divide by the block sizes.
 
@@ -503,17 +531,30 @@ def flash_attention(
     through the kernels and sliced back.  Off-TPU without ``interpret``
     this falls back to the reference einsum/softmax path (XLA fuses it
     well enough on CPU; the kernel is the TPU fast path).
+
+    ``window`` (requires ``causal``) is sliding-window attention: row
+    ``r`` attends to keys ``[r - window + 1, r]``.  Blocks entirely
+    outside the band are skipped in the forward AND both backward
+    kernels, so cost scales O(T * window) instead of O(T^2) — the
+    standard long-context local-attention trade (Mistral-style).
     """
     D = q.shape[-1]
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(D))
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     on_tpu = jax.devices()[0].platform == "tpu"
     if not on_tpu and not interpret:
-        return attention_reference(q, k, v, causal=causal, sm_scale=scale)
+        return attention_reference(q, k, v, causal=causal, sm_scale=scale,
+                                   window=window)
     qb, kb, vb, block_q, block_k, unpack = _prep_blocks(
         q, k, v, block_q, block_k
     )
     return unpack(
-        _flash(qb, kb, vb, scale, causal, block_q, block_k, interpret)
+        _flash(qb, kb, vb, scale, causal, block_q, block_k, interpret,
+               window)
     )
 
 

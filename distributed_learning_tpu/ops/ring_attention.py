@@ -43,13 +43,21 @@ def attention_reference(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
-    """Plain full attention (B, T, H, D) — the correctness oracle."""
+    """Plain full attention (B, T, H, D) — the correctness oracle.
+
+    ``window`` (requires ``causal``) restricts row ``r`` to keys in
+    ``[r - window + 1, r]`` — causal sliding-window attention."""
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if causal:
         T, S = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((T, S), bool), k=S - T)
+        if window is not None:
+            mask &= ~jnp.tril(jnp.ones((T, S), bool), k=S - T - window)
         logits = jnp.where(mask[None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
