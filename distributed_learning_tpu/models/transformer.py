@@ -6,8 +6,18 @@ machinery (``ops/ring_attention.py``) has a first-class consumer: the same
 gossip-SGD trainer can train a language model whose attention runs
 sequence-parallel over the device ring.
 
-``attn_impl``: ``"full"`` (single-device reference), ``"ring"`` or
-``"ulysses"`` (inside ``shard_map`` with ``seq_axis`` sharded).
+Knobs:
+
+* ``attn_impl`` — ``"full"`` (reference), ``"flash"`` (Pallas kernels),
+  ``"ring"`` / ``"ring_flash"`` / ``"ulysses"`` (inside ``shard_map``
+  with ``seq_axis`` sharded);
+* ``attn_window`` — causal sliding-window attention (full/flash);
+* ``pos_emb`` — learned table or rotary (``"rope"``, global positions,
+  sequence-parallel safe);
+* ``num_kv_heads`` — grouped-query attention (KV cache shrinks H/Hkv);
+* ``mlp`` / ``num_experts`` / ``moe_top_k`` — dense or expert-parallel
+  MoE feed-forward;
+* ``decode`` + :func:`generate` — KV-cache autoregressive generation.
 """
 
 from __future__ import annotations
@@ -29,6 +39,37 @@ from distributed_learning_tpu.ops.ring_attention import (
 __all__ = ["TransformerLM", "generate"]
 
 
+def _rope(x, positions, *, base: float = 10000.0):
+    """Rotary position embedding (arXiv:2104.09864) over the head dim,
+    in the half-split (GPT-NeoX) layout: dimension ``j`` pairs with
+    ``j + Dh/2`` and the pair rotates by ``pos / base^(2j/Dh)``.  (The
+    paper's interleaved consecutive-pair layout is a fixed permutation
+    of this one — self-consistent here, but checkpoints ported from
+    interleaved-layout models would need that permutation applied.)
+
+    ``x`` is (B, T, H, Dh) with even Dh; ``positions`` is (T,) GLOBAL
+    token positions — under sequence parallelism each shard passes its
+    offset slice, and in decode mode the cache write index, so the same
+    rotation is applied no matter how the sequence is split.  Applied to
+    Q and K before attention; relative-position structure then lives in
+    the dot products and no learned position table is needed.
+    """
+    B, T, H, Dh = x.shape
+    if Dh % 2:
+        raise ValueError(f"rope needs an even head_dim, got {Dh}")
+    half = Dh // 2
+    freqs = positions[:, None].astype(jnp.float32) / (
+        base ** (jnp.arange(half, dtype=jnp.float32) / half)
+    )  # (T, half)
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
 class _Attention(nn.Module):
     num_heads: int
     head_dim: int
@@ -38,9 +79,11 @@ class _Attention(nn.Module):
     window: int | None = None  # sliding window (full/flash paths only)
     decode: bool = False       # autoregressive KV-cache mode
     cache_len: int = 0         # static KV-cache length (decode mode)
+    rope: bool = False         # rotary Q/K (positions arg required)
+    num_kv_heads: int | None = None  # GQA: kv heads < query heads
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         # QKV as ONE DenseGeneral with structured (3, H, Dh) output
         # features — the kernel is (d_model, 3, H, Dh), so tensor
         # parallelism shards it on the HEAD axis (training/tp.py) and
@@ -48,11 +91,38 @@ class _Attention(nn.Module):
         # resharding inside the block.  A flat Dense(3*H*Dh) kernel can
         # only be split contiguously over the concatenated [Q|K|V]
         # columns, which straddles heads and forces XLA to re-gather.
-        qkv = nn.DenseGeneral(
-            features=(3, self.num_heads, self.head_dim),
-            use_bias=False, dtype=self.dtype,
-        )(x)  # (B, T, 3, H, Dh)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        H = self.num_heads
+        Hkv = self.num_kv_heads if self.num_kv_heads is not None else H
+        if Hkv == H:
+            qkv = nn.DenseGeneral(
+                features=(3, H, self.head_dim),
+                use_bias=False, dtype=self.dtype,
+            )(x)  # (B, T, 3, H, Dh)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            # Grouped-query attention (arXiv:2305.13245): Hkv shared K/V
+            # heads serve H/Hkv query heads each.  Projections, decode
+            # cache, and (in decode) the cache WRITE all carry only Hkv
+            # heads — the KV-cache shrinks by H/Hkv, which is the point;
+            # compute paths broadcast K/V up to H just before attention.
+            if H % Hkv:
+                raise ValueError(
+                    f"num_heads {H} must divide by num_kv_heads {Hkv}"
+                )
+            q = nn.DenseGeneral(
+                features=(H, self.head_dim), use_bias=False,
+                dtype=self.dtype, name="q_proj",
+            )(x)  # (B, T, H, Dh)
+            kv = nn.DenseGeneral(
+                features=(2, Hkv, self.head_dim), use_bias=False,
+                dtype=self.dtype, name="kv_proj",
+            )(x)  # (B, T, 2, Hkv, Dh)
+            k, v = kv[:, :, 0], kv[:, :, 1]
+        if self.rope:
+            # One rope application for BOTH modes: the caller always
+            # passes global positions (decode mode derives them from the
+            # top-level position counter), so no per-layer recompute.
+            q, k = _rope(q, positions), _rope(k, positions)
         if self.window is not None and self.attn_impl not in ("full", "flash"):
             raise ValueError(
                 f"window is only supported for full/flash attention, "
@@ -60,6 +130,7 @@ class _Attention(nn.Module):
             )
         if self.decode:
             return self._decode_step(q, k, v, x)
+        k, v = self._expand_kv(k, v)
         if self.attn_impl == "full":
             out = attention_reference(q, k, v, causal=True,
                                       window=self.window)
@@ -81,6 +152,15 @@ class _Attention(nn.Module):
         # head-sharded under TP with one psum placed by the partitioner.
         return self._out_proj(out, x.shape[-1])
 
+    def _expand_kv(self, k, v):
+        """Broadcast Hkv K/V heads up to the H query heads (no-op when
+        equal): repeat each kv head for its group of queries."""
+        H = self.num_heads
+        if k.shape[2] == H:
+            return k, v
+        g = H // k.shape[2]
+        return (jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2))
+
     def _out_proj(self, out, d):
         return nn.DenseGeneral(
             features=d, axis=(-2, -1),
@@ -97,7 +177,8 @@ class _Attention(nn.Module):
         ``<= i + t`` (inside ``window`` if set) — masking by position
         instead of slicing keeps every shape static for jit.
         """
-        B, T, H, Dh = q.shape
+        B, T, _, Dh = q.shape
+        Hkv = k.shape[2]  # under GQA the cache holds only the kv heads
         L = self.cache_len
         if T > L:
             raise ValueError(
@@ -106,11 +187,11 @@ class _Attention(nn.Module):
             )
         ck = self.variable(
             "cache", "key",
-            lambda: jnp.zeros((B, L, H, Dh), self.dtype),
+            lambda: jnp.zeros((B, L, Hkv, Dh), self.dtype),
         )
         cv = self.variable(
             "cache", "value",
-            lambda: jnp.zeros((B, L, H, Dh), self.dtype),
+            lambda: jnp.zeros((B, L, Hkv, Dh), self.dtype),
         )
         idx = self.variable(
             "cache", "index", lambda: jnp.zeros((), jnp.int32)
@@ -124,18 +205,26 @@ class _Attention(nn.Module):
         )
         idx.value = i + T
         scale = 1.0 / (Dh ** 0.5)
+        # Grouped attention against the Hkv-head cache: reshape queries
+        # to (B, T, Hkv, group, Dh) and contract against the cache
+        # directly — the expanded (B, L, H, Dh) copy jnp.repeat would
+        # materialize per generated token is exactly the memory GQA
+        # exists to avoid.
+        g = q.shape[2] // Hkv
+        qg = q.reshape(B, T, Hkv, g, Dh)
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, ck.value
+            "bqhgd,bkhd->bhgqk", qg, ck.value
         ).astype(jnp.float32) * scale
         qpos = i + jnp.arange(T)                      # (T,)
         kpos = jnp.arange(L)                          # (L,)
         live = kpos[None, :] <= qpos[:, None]         # (T, L)
         if self.window is not None:
             live &= kpos[None, :] > qpos[:, None] - self.window
-        s = jnp.where(live[None, None], s, -jnp.inf)
+        s = jnp.where(live[None, None, None], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.value.dtype),
-                         cv.value)
+        out = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p.astype(cv.value.dtype), cv.value
+        ).reshape(B, T, Hkv * g, Dh)
         return self._out_proj(out, x.shape[-1])
 
 
@@ -152,14 +241,17 @@ class _Block(nn.Module):
     attn_window: int | None = None
     decode: bool = False
     cache_len: int = 0
+    rope: bool = False
+    num_kv_heads: int | None = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + _Attention(
             self.num_heads, self.head_dim, self.attn_impl, self.seq_axis,
             self.dtype, self.attn_window, self.decode, self.cache_len,
-        )(h)
+            self.rope, self.num_kv_heads,
+        )(h, positions)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.mlp == "moe":
             # Expert-parallel feed-forward (models/moe.py): params become
@@ -198,6 +290,8 @@ class TransformerLM(nn.Module):
     num_experts: int = 4
     moe_top_k: int = 1       # router choices per token (1=Switch, 2=GShard)
     attn_window: int | None = None  # sliding-window attention (full/flash)
+    pos_emb: str = "learned"  # "learned" table | "rope" rotary Q/K
+    num_kv_heads: int | None = None  # GQA: shared K/V heads (cache /Hkv)
     decode: bool = False     # KV-cache autoregressive mode (see generate).
                              # Direct decode users must keep prompt+steps
                              # <= max_len; past it the dynamic cache write
@@ -244,15 +338,24 @@ class TransformerLM(nn.Module):
                     f"{n_shards} shards) exceeds max_len {self.max_len}"
                 )
             positions = jax.lax.axis_index(self.seq_axis) * T + jnp.arange(T)
-        pos = nn.Embed(self.max_len, d_model, dtype=self.dtype)(positions)
-        x = x + pos[None]
+        if self.pos_emb == "rope":
+            use_rope = True
+        elif self.pos_emb == "learned":
+            use_rope = False
+            pos = nn.Embed(self.max_len, d_model, dtype=self.dtype)(positions)
+            x = x + pos[None]
+        else:
+            raise ValueError(
+                f"unknown pos_emb {self.pos_emb!r} (want learned|rope)"
+            )
         for _ in range(self.num_layers):
             x = _Block(
                 self.num_heads, self.head_dim, self.mlp_ratio,
                 self.attn_impl, self.seq_axis, self.dtype,
                 self.mlp, self.num_experts, self.moe_top_k,
                 self.attn_window, self.decode, self.max_len,
-            )(x)
+                use_rope, self.num_kv_heads,
+            )(x, positions if use_rope else None)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)
         return logits.astype(jnp.float32)

@@ -53,6 +53,12 @@ def transformer_tp_rules(path: tuple, leaf, model_axis: str) -> P:
     if len(names) < 2:
         return P()
     if any(n.startswith("_Attention") for n in names):
+        # GQA projections carry their own names; the head axis is dim 1
+        # of q_proj (d, H, Dh) and dim 2 of kv_proj (d, 2, Hkv, Dh).
+        if names[-2] == "q_proj":
+            return P(None, model_axis, None)
+        if names[-2] == "kv_proj":
+            return P(None, None, model_axis, None)
         # Head-axis sharding on both attention kernels: QKV outputs and
         # out-projection inputs split per head, so Q/K/V activations,
         # the attention math, and the contraction stay head-local — the
@@ -77,11 +83,24 @@ def transformer_tp_rules(path: tuple, leaf, model_axis: str) -> P:
     return P()
 
 
+def _divisible_or_replicated(spec: P, leaf, mesh: Mesh, model_axis: str) -> P:
+    """Fall back to replicated when the sharded dim does not divide by
+    the axis size (e.g. MQA's kv_proj with Hkv=1 on a 4-way model axis):
+    replication is always correct, and a crash would make an otherwise
+    valid model configuration unusable under TP."""
+    n = mesh.shape[model_axis]
+    for d, name in enumerate(spec):
+        if name == model_axis and leaf.shape[d] % n:
+            return P()
+    return spec
+
+
 def shard_transformer_params(params: Any, mesh: Mesh,
                              model_axis: str = "model") -> Any:
     """Device-put a TransformerLM param tree with megatron-style specs."""
     def place(path, leaf):
         spec = transformer_tp_rules(path, leaf, model_axis)
+        spec = _divisible_or_replicated(spec, leaf, mesh, model_axis)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)
@@ -108,7 +127,10 @@ def make_tp_train_step(
 
     def constrain_params(params):
         def place(path, leaf):
-            spec = transformer_tp_rules(path, leaf, model_axis)
+            spec = _divisible_or_replicated(
+                transformer_tp_rules(path, leaf, model_axis),
+                leaf, mesh, model_axis,
+            )
             return jax.lax.with_sharding_constraint(
                 leaf, NamedSharding(mesh, spec)
             )
@@ -127,7 +149,10 @@ def make_tp_train_step(
         # replicated for it rather than mis-shard some moments.
         shape_spec: dict = {}
         def record(path, leaf):
-            spec = transformer_tp_rules(path, leaf, model_axis)
+            spec = _divisible_or_replicated(
+                transformer_tp_rules(path, leaf, model_axis),
+                leaf, mesh, model_axis,
+            )
             prev = shape_spec.get(leaf.shape)
             if prev is not None and prev != spec:
                 shape_spec[leaf.shape] = P()  # collision: stay safe

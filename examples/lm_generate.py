@@ -1,0 +1,79 @@
+"""Train a tiny LM, then GENERATE from it with the KV cache.
+
+The complete modern-LM loop in one script: a RoPE + GQA + sliding-window
+TransformerLM learns deterministic arithmetic progressions (``t+1 mod
+V``), then :func:`generate` continues a prompt autoregressively through
+the decode cache — greedy decoding must reproduce the progression
+exactly, which the script checks and reports.
+
+Run (any platform; ~20s on CPU):
+
+    python -m examples.lm_generate
+    python -m examples.lm_generate --steps 200 --gen 12
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_learning_tpu.models.transformer import (
+    TransformerLM,
+    generate,
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--gen", type=int, default=8)
+    args = ap.parse_args()
+    V = args.vocab
+
+    model = TransformerLM(
+        vocab_size=V, num_layers=2, num_heads=4, head_dim=8, max_len=64,
+        pos_emb="rope", num_kv_heads=2, attn_window=16,
+    )
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, V, size=(8, 1))
+    seq = (base + np.arange(33)) % V
+    x = jnp.asarray(seq[:, :-1], jnp.int32)
+    y = jnp.asarray(seq[:, 1:], jnp.int32)
+
+    params = model.init(jax.random.key(0), x)["params"]
+    tx = optax.adam(5e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        def loss_fn(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply({"params": p}, x), y
+            ).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    for i in range(args.steps):
+        params, opt, loss = step(params, opt)
+    print(f"trained {args.steps} steps, final loss {float(loss):.4f}")
+
+    start = 3
+    prompt = jnp.asarray(((start + np.arange(5)) % V)[None], jnp.int32)
+    toks = np.asarray(generate(model, params, prompt, args.gen))[0]
+    expect = (start + 5 + np.arange(args.gen)) % V
+    n_ok = int((toks == expect).sum())
+    print(f"prompt: {np.asarray(prompt)[0].tolist()}")
+    print(f"generated: {toks.tolist()}")
+    print(f"expected:  {expect.tolist()}")
+    print(f"correct_tokens: {n_ok}/{args.gen}")
+
+
+if __name__ == "__main__":
+    main()

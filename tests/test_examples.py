@@ -204,3 +204,14 @@ def test_lm_2d_mesh_example():
     m = re.search(r"loss (\d+\.\d+) -> (\d+\.\d+)", out)
     assert m, out
     assert float(m.group(2)) < float(m.group(1)), out
+
+
+def test_lm_generate_example():
+    """The generation demo: computed correct-token count must be perfect
+    at the full default training budget's smaller test size."""
+    out = _run("lm_generate", "--steps", "220", "--gen", "6")
+    m = re.search(r"correct_tokens: (\d+)/(\d+)", out)
+    assert m, out
+    assert int(m.group(1)) == int(m.group(2)) == 6, out
+    loss = float(re.search(r"final loss ([\d.]+)", out).group(1))
+    assert loss < 0.1, out

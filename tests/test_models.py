@@ -226,3 +226,186 @@ def test_transformer_decode_windowed_and_sampled():
         generate(model, params, prompt, 2, temperature=0.5)
     with pytest.raises(ValueError, match="max_len"):
         generate(model, params, prompt, 100)
+
+
+def test_transformer_rope():
+    """RoPE: no learned position table in the params, decode matches the
+    full forward exactly, and training works."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_learning_tpu.models.transformer import (
+        TransformerLM,
+        generate,
+    )
+
+    kw = dict(vocab_size=32, num_layers=2, num_heads=2, head_dim=8,
+              max_len=32, pos_emb="rope")
+    model = TransformerLM(**kw)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.integers(0, 32, size=(2, 16)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, 32, size=(2, 16)), jnp.int32)
+    params = model.init(jax.random.key(3), x)["params"]
+    # Exactly ONE Embed (tokens); rope has no position table.
+    embeds = [k for k in params if k.startswith("Embed")]
+    assert embeds == ["Embed_0"], embeds
+
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        def loss_fn(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply({"params": p}, x), y).mean()
+        l, g = jax.value_and_grad(loss_fn)(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, l
+
+    p, o = params, opt
+    _, _, l0 = step(p, o)
+    for _ in range(6):
+        p, o, loss = step(p, o)
+    assert float(loss) < float(l0)
+
+    # Decode (rope from the cache index) == full forward, greedy.
+    prompt = x[:, :5]
+    got = generate(model, params, prompt, 4)
+    seq = prompt
+    for _ in range(4):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(seq[:, 5:]))
+
+    with np.testing.assert_raises(Exception):
+        TransformerLM(**{**kw, "pos_emb": "bogus"}).init(
+            jax.random.key(0), x
+        )
+
+
+def test_transformer_gqa():
+    """Grouped-query attention: the KV cache carries only Hkv heads,
+    decode equals the full forward, and the GQA forward equals a
+    manually kv-repeated multi-head run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_tpu.models.transformer import (
+        TransformerLM,
+        generate,
+    )
+
+    kw = dict(vocab_size=32, num_layers=2, num_heads=4, head_dim=8,
+              max_len=32, num_kv_heads=2, pos_emb="rope")
+    model = TransformerLM(**kw)
+    rng = np.random.default_rng(5)
+    prompt = jnp.asarray(rng.integers(0, 32, size=(2, 6)), jnp.int32)
+    params = model.init(jax.random.key(5), prompt)["params"]
+    # GQA projections exist with the reduced kv shape.
+    att = params["_Block_0"]["_Attention_0"]
+    assert att["q_proj"]["kernel"].shape == (32, 4, 8)
+    assert att["kv_proj"]["kernel"].shape == (32, 2, 2, 8)
+
+    # Decode == repeated full forward (cache correctness with Hkv heads).
+    got = generate(model, params, prompt, 5)
+    seq = prompt
+    for _ in range(5):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(seq[:, 6:]))
+
+    # The decode cache really is Hkv-headed.
+    dec = model.clone(decode=True)
+    _, state = dec.apply({"params": params}, prompt, mutable=["cache"])
+    ck = state["cache"]["_Block_0"]["_Attention_0"]["key"]
+    assert ck.shape == (2, 32, 2, 8), ck.shape
+
+    import pytest
+    with pytest.raises(ValueError, match="divide"):
+        bad = TransformerLM(**{**kw, "num_kv_heads": 3})
+        bad.init(jax.random.key(0), prompt)
+
+
+def test_transformer_gqa_tp_shards_head_axes():
+    """TP rules place the GQA kernels on their head axes and the sharded
+    forward equals the unsharded one."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distributed_learning_tpu.models.transformer import TransformerLM
+    from distributed_learning_tpu.training.tp import (
+        shard_transformer_params,
+        transformer_tp_rules,
+    )
+
+    kw = dict(vocab_size=16, num_layers=1, num_heads=4, head_dim=8,
+              max_len=8, num_kv_heads=2)
+    model = TransformerLM(**kw)
+    x = jnp.zeros((4, 8), jnp.int32)
+    params = model.init(jax.random.key(6), x)["params"]
+    att = params["_Block_0"]["_Attention_0"]
+
+    def spec(leaf_path_suffix, leaf):
+        path = tuple(
+            jax.tree_util.DictKey(k)
+            for k in ("_Block_0", "_Attention_0") + leaf_path_suffix
+        )
+        return transformer_tp_rules(path, leaf, "model")
+
+    assert spec(("q_proj", "kernel"), att["q_proj"]["kernel"]) == \
+        P(None, "model", None)
+    assert spec(("kv_proj", "kernel"), att["kv_proj"]["kernel"]) == \
+        P(None, None, "model", None)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                ("data", "model"))
+    ref = model.apply({"params": params}, x)
+    sharded = shard_transformer_params(params, mesh, "model")
+    with mesh:
+        got = jax.jit(lambda p, t: model.apply({"params": p}, t))(
+            sharded, x
+        )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_transformer_mqa_tp_replicates_indivisible_kv():
+    """MQA (one kv head) on a model axis wider than Hkv: kv_proj falls
+    back to replicated instead of crashing, q_proj stays head-sharded,
+    and the forward still matches unsharded."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_learning_tpu.models.transformer import TransformerLM
+    from distributed_learning_tpu.training.tp import (
+        shard_transformer_params,
+    )
+
+    model = TransformerLM(vocab_size=16, num_layers=1, num_heads=4,
+                          head_dim=8, max_len=8, num_kv_heads=1)
+    x = jnp.zeros((4, 8), jnp.int32)
+    params = model.init(jax.random.key(7), x)["params"]
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "model"))
+    sharded = shard_transformer_params(params, mesh, "model")
+    att = sharded["_Block_0"]["_Attention_0"]
+    assert att["kv_proj"]["kernel"].sharding.spec == P()
+    assert "model" in jax.tree_util.tree_flatten(
+        tuple(att["q_proj"]["kernel"].sharding.spec)
+    )[0]
+    ref = model.apply({"params": params}, x)
+    with mesh:
+        got = jax.jit(lambda p, t: model.apply({"params": p}, t))(
+            sharded, x
+        )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5)

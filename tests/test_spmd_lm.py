@@ -124,3 +124,36 @@ def test_2d_mesh_matches_single_device_loss():
         for i in range(N_AGENTS)
     ])
     np.testing.assert_allclose(float(loss), ref, atol=2e-5)
+
+
+def test_2d_mesh_rope_matches_single_device_loss():
+    """RoPE under sequence parallelism: each shard rotates Q/K by its
+    GLOBAL positions, so the sharded loss must equal the unsharded rope
+    model exactly — a wrong (local) position offset would break this."""
+    mesh = _mesh()
+    kw = dict(vocab_size=VOCAB, num_layers=1, num_heads=2, head_dim=8,
+              max_len=T, pos_emb="rope")
+    model = TransformerLM(**kw, attn_impl="ring", seq_axis="seq")
+    init_twin = TransformerLM(**kw, attn_impl="full")
+    tx = optax.sgd(0.0)
+
+    x, y = _data(2)
+    params, opt = stack_agent_states(
+        init_twin, tx, jax.random.key(2), x[0], N_AGENTS
+    )
+    step = make_gossip_lm_step(mesh, model, tx)
+    with mesh:
+        _, _, loss = step(params, opt, x, y)
+
+    ref = np.mean([
+        float(
+            optax.softmax_cross_entropy_with_integer_labels(
+                init_twin.apply(
+                    {"params": jax.tree.map(lambda a: a[i], params)}, x[i]
+                ),
+                y[i],
+            ).mean()
+        )
+        for i in range(N_AGENTS)
+    ])
+    np.testing.assert_allclose(float(loss), ref, atol=2e-5)
