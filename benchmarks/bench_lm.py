@@ -85,6 +85,41 @@ def _measure(
     return B * T / dt, dt
 
 
+def _measure_decode(
+    T_prompt: int, steps: int, *, B: int, vocab: int, num_layers: int,
+    num_heads: int, head_dim: int, num_kv_heads=None,
+) -> tuple[float, float]:
+    """Steady-state autoregressive generation rate (tokens/sec summed
+    over the batch) through the KV-cache decode path."""
+    from distributed_learning_tpu.models import TransformerLM
+    from distributed_learning_tpu.models.transformer import generate
+
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=num_layers, num_heads=num_heads,
+        head_dim=head_dim, max_len=T_prompt + steps, attn_impl="full",
+        num_kv_heads=num_kv_heads, dtype=jnp.bfloat16,
+    )
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, vocab, size=(B, T_prompt)), jnp.int32
+    )
+    params = jax.jit(model.init)(jax.random.key(0), prompt)["params"]
+    # Subtract the prefill (one O(T^2) forward, identical across
+    # configurations) from the timed window so the reported rate is the
+    # steady-state single-token decode loop — the quantity the MHA/GQA
+    # comparison is about.  steps=1 ≈ prefill + one step.
+    for n in (1, steps):
+        sync(generate(model, params, prompt, n))  # compile both programs
+    t0 = time.perf_counter()
+    sync(generate(model, params, prompt, 1))
+    dt_prefill = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sync(generate(model, params, prompt, steps))
+    dt = time.perf_counter() - t0
+    decode_dt = max(dt - dt_prefill, 1e-9)
+    return B * (steps - 1) / decode_dt, dt
+
+
 def run() -> None:
     full = full_scale()
     if full:
@@ -124,6 +159,42 @@ def run() -> None:
             "seconds_per_step": round(dt, 4),
             "platform": platform(),
         })
+    # Autoregressive decode throughput (the KV-cache path), MHA vs GQA.
+    if full:
+        dec_cases = [("mha", None, 2048, 256), ("gqa4", 2, 2048, 256)]
+    else:
+        dec_cases = [("mha", None, 32, 8), ("gqa4", 1, 32, 8)]
+    for tag, hkv, tp, steps in dec_cases:
+        try:
+            toks, dt = _measure_decode(
+                tp, steps, B=kw["B"], vocab=kw["vocab"],
+                num_layers=kw["num_layers"], num_heads=kw["num_heads"],
+                head_dim=kw["head_dim"], num_kv_heads=hkv,
+            )
+        except Exception as e:
+            emit({
+                "metric": f"lm_decode_tokens_per_sec_{tag}",
+                "value": None,
+                "unit": "tokens/sec",
+                "vs_baseline": None,
+                "error": f"{type(e).__name__}: {str(e)[:120]}",
+            })
+            continue
+        emit({
+            "metric": f"lm_decode_tokens_per_sec_{tag}",
+            "value": round(toks, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "config": (
+                f"prefill {tp} + {steps} greedy steps, B{kw['B']} "
+                f"L{kw['num_layers']} H{kw['num_heads']}x"
+                f"{kw['head_dim']} kv_heads={hkv or kw['num_heads']}, "
+                "KV-cache decode"
+            ),
+            "seconds_total": round(dt, 3),
+            "platform": platform(),
+        })
+
     # Headline ratio: the kernel's end-to-end training win at matched T.
     for T in sorted({t for _, t in cases}):
         fu, fl = results.get(("full", T)), results.get(("flash", T))

@@ -33,10 +33,6 @@ echo "== stage 1: flash attention fwd+bwd TFLOP/s" >&2
 BENCH_OUT="$CAPTURE" timeout 1800 python -m benchmarks.run_attention_only \
   2>"$OUT/attention_$STAMP.err" || echo "stage 1 rc=$?" >&2
 
-echo "== stage 1b: LM training throughput (full vs flash attention)" >&2
-BENCH_OUT="$CAPTURE" timeout 1800 python -m benchmarks.bench_lm \
-  2>"$OUT/lm_$STAMP.err" || echo "stage 1b rc=$?" >&2
-
 echo "== stage 2: WRN profile ablations" >&2
 timeout 3600 python -m benchmarks.profile_wrn \
   2>"$OUT/profile_$STAMP.err" | tee -a "$OUT/profile_$STAMP.out" \
@@ -45,6 +41,10 @@ echo "== stage 2b: profiler trace + top-ops summary" >&2
 timeout 1200 python -m benchmarks.profile_wrn --trace \
   2>>"$OUT/profile_$STAMP.err" | tee -a "$OUT/profile_$STAMP.out" \
   || echo "stage 2b rc=$?" >&2
+
+echo "== stage 2c: LM training throughput (full vs flash attention)" >&2
+BENCH_OUT="$CAPTURE" timeout 1800 python -m benchmarks.bench_lm \
+  2>"$OUT/lm_$STAMP.err" || echo "stage 2c rc=$?" >&2
 
 echo "== stage 3: WRN accuracy" >&2
 ACC_JSON="$OUT/wrn_accuracy_$STAMP.json"

@@ -215,3 +215,20 @@ def test_lm_generate_example():
     assert int(m.group(1)) == int(m.group(2)) == 6, out
     loss = float(re.search(r"final loss ([\d.]+)", out).group(1))
     assert loss < 0.1, out
+
+
+def test_parallelism_matrix_example():
+    """tp/pp-1F1B/fsdp demos: computed oracle errors must be tiny and
+    both training demos must reduce their loss."""
+    out = _run("parallelism_matrix", timeout=580.0,
+               env_extra={"PM_STEPS": "4"})
+    tp_err = float(re.search(r"tp: sharded==unsharded err ([\d.e+-]+)",
+                             out).group(1))
+    pp_err = float(re.search(r"pp\(1F1B\): grads==autodiff err ([\d.e+-]+)",
+                             out).group(1))
+    assert tp_err < 1e-4 and pp_err < 1e-4, out
+    frac = float(re.search(r"per-device residency ([\d.]+)", out).group(1))
+    assert abs(frac - 1 / 8) < 1e-6, out
+    for m in re.finditer(r"loss ([\d.]+) -> ([\d.]+)", out):
+        assert float(m.group(2)) < float(m.group(1)), out
+    assert "parallelism matrix ok" in out
