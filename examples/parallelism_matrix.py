@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 
 STEPS = int(os.environ.get("PM_STEPS", "8"))
@@ -59,8 +59,8 @@ def demo_tp() -> None:
     step = make_tp_train_step(mesh, model, tx)
     opt = tx.init(sharded)
     with mesh:
-        _, _, l0 = step(sharded, opt, x, y)
-        p, o = sharded, opt
+        p, o, l0 = step(sharded, opt, x, y)
+        loss = l0
         for _ in range(STEPS):
             p, o, loss = step(p, o, x, y)
     print(f"tp: sharded==unsharded err {err:.2e}, "
@@ -121,8 +121,8 @@ def demo_fsdp() -> None:
     opt = tx.init(params)
     step = make_fsdp_train_step(mesh, model, tx)
     with mesh:
-        _, _, l0 = step(params, opt, x, y)
-        p, o = params, opt
+        p, o, l0 = step(params, opt, x, y)
+        loss = l0
         for _ in range(STEPS):
             p, o, loss = step(p, o, x, y)
     emb = p["Embed_0"]["embedding"]
