@@ -81,14 +81,22 @@ class ConsensusAgent:
         host: str = "127.0.0.1",
         port: int = 0,
         bf16_wire: bool = False,
+        int8_wire: bool = False,
         sparse_wire: bool = False,
         rejoin: bool = False,
         debug: bool = False,
     ):
+        if bf16_wire and int8_wire:
+            raise ValueError("bf16_wire and int8_wire are mutually exclusive")
         self.token = str(token)
         self.master_addr = (master_host, master_port)
         self.host, self.port = host, port
         self.bf16_wire = bf16_wire
+        # int8 wire: quarter-size value payloads via symmetric per-tensor
+        # quantization (tensor_codec FLAG_INT8_COMPRESSED).  Meant for
+        # error-feedback loops (run_choco_once) where the quantization
+        # noise is folded back into the next correction.
+        self.int8_wire = int8_wire
         # Sparse wire: value responses ship non-zeros as k values + indices
         # (tensor_codec.encode_sparse) — for k-sparse payloads such as
         # CHOCO compressed-gossip corrections (run_choco_once).  Deploy
@@ -327,7 +335,8 @@ class ConsensusAgent:
         for ref, verdict in self._sparse_cache:
             if ref is value:
                 return verdict
-        breakeven = value.size / (3 if self.bf16_wire else 2)
+        per_dense = 1 if self.int8_wire else 2 if self.bf16_wire else 4
+        breakeven = value.size * per_dense / (4 + per_dense)
         verdict = bool(np.count_nonzero(value) < breakeven)
         self._sparse_cache = [(value, verdict), self._sparse_cache[0]]
         return verdict
@@ -339,11 +348,11 @@ class ConsensusAgent:
         if self.sparse_wire and value is not None and self._sparse_wins(value):
             return P.ValueResponseSparse(
                 round_id=round_id, iteration=iteration, value=value,
-                bf16_wire=self.bf16_wire,
+                bf16_wire=self.bf16_wire, int8_wire=self.int8_wire,
             )
         return P.ValueResponse(
             round_id=round_id, iteration=iteration, value=value,
-            bf16_wire=self.bf16_wire,
+            bf16_wire=self.bf16_wire, int8_wire=self.int8_wire,
         )
 
     async def _flush_deferred(self) -> None:
@@ -574,9 +583,13 @@ class ConsensusAgent:
         )
 
         if self.sparse_wire:
-            q = decode_sparse(encode_sparse(q, bf16_wire=self.bf16_wire))
-        elif self.bf16_wire:
-            q = decode_tensor(encode_tensor(q, bf16_wire=True))
+            q = decode_sparse(encode_sparse(
+                q, bf16_wire=self.bf16_wire, int8_wire=self.int8_wire
+            ))
+        elif self.bf16_wire or self.int8_wire:
+            q = decode_tensor(encode_tensor(
+                q, bf16_wire=self.bf16_wire, int8_wire=self.int8_wire
+            ))
         self._op_id += 1
         self._iteration = 0
         neighbor_qs = await self._exchange_values(q)

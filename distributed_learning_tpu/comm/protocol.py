@@ -59,8 +59,9 @@ def _unpack_str(buf: bytes, off: int) -> Tuple[str, int]:
     return buf[off : off + n].decode("utf-8"), off + n
 
 
-def _pack_tensor(x: np.ndarray, bf16_wire: bool) -> bytes:
-    t = encode_tensor(x, bf16_wire=bf16_wire)
+def _pack_tensor(x: np.ndarray, bf16_wire: bool,
+                 int8_wire: bool = False) -> bytes:
+    t = encode_tensor(x, bf16_wire=bf16_wire, int8_wire=int8_wire)
     return struct.pack("<I", len(t)) + t
 
 
@@ -244,11 +245,12 @@ class ValueResponse(Message):
     iteration: int = 0
     value: Optional[np.ndarray] = None
     bf16_wire: bool = False
+    int8_wire: bool = False
 
     def _pack(self) -> bytes:
         v = self.value if self.value is not None else np.zeros(0, np.float32)
         return struct.pack("<qq", self.round_id, self.iteration) + _pack_tensor(
-            np.asarray(v), self.bf16_wire
+            np.asarray(v), self.bf16_wire, self.int8_wire
         )
 
     @classmethod
@@ -363,12 +365,14 @@ class ValueResponseSparse(Message):
     iteration: int = 0
     value: Optional[np.ndarray] = None
     bf16_wire: bool = False
+    int8_wire: bool = False
 
     def _pack(self) -> bytes:
         from distributed_learning_tpu.comm.tensor_codec import encode_sparse
 
         v = self.value if self.value is not None else np.zeros(0, np.float32)
-        t = encode_sparse(np.asarray(v), bf16_wire=self.bf16_wire)
+        t = encode_sparse(np.asarray(v), bf16_wire=self.bf16_wire,
+                          int8_wire=self.int8_wire)
         return struct.pack("<qqI", self.round_id, self.iteration, len(t)) + t
 
     @classmethod

@@ -9,7 +9,10 @@ from any peer, and f64-sized frames) with a fixed, safe layout:
 
 ``flags`` bit 0 marks a float32 tensor narrowed to bfloat16 on the wire
 (half the bytes; round-to-nearest-even via the native codec) — the TPU
-wire format for gossip values.  Integrity is checked one level up by the
+wire format for gossip values.  ``flags`` bit 1 marks symmetric int8
+quantization (quarter bytes: one f32 scale = max|x|/127 ahead of the
+int8 payload) — the CHOCO-wire option whose quantization error the
+error-feedback loop absorbs.  Integrity is checked one level up by the
 frame crc32 (``framing.py``).
 """
 
@@ -29,9 +32,11 @@ __all__ = [
     "decode_sparse",
     "top_k_sparse",
     "FLAG_BF16_COMPRESSED",
+    "FLAG_INT8_COMPRESSED",
 ]
 
 FLAG_BF16_COMPRESSED = 0x01
+FLAG_INT8_COMPRESSED = 0x02
 
 _DTYPE_CODES = {
     np.dtype(np.float32): 0,
@@ -41,6 +46,7 @@ _DTYPE_CODES = {
     np.dtype(np.uint8): 4,
     np.dtype(np.uint16): 5,  # raw bfloat16 bit patterns
     np.dtype(np.bool_): 6,
+    np.dtype(np.int8): 7,  # int8-quantized wire payloads
 }
 _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 _MAX_NDIM = 16
@@ -50,9 +56,17 @@ _MAX_NDIM = 16
 _MAX_SPARSE_DENSE_ELEMS = 1 << 28
 
 
-def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
-    """Serialize an array; ``bf16_wire=True`` narrows f32 payloads to bf16."""
+def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False,
+                  int8_wire: bool = False) -> bytes:
+    """Serialize an array.
+
+    For f32 payloads ``bf16_wire=True`` halves the bytes (RNE) and
+    ``int8_wire=True`` quarters them (symmetric quantization, per-tensor
+    f32 scale stored ahead of the int8 data).  Mutually exclusive.
+    """
     x = np.asarray(x)
+    if bf16_wire and int8_wire:
+        raise ValueError("bf16_wire and int8_wire are mutually exclusive")
     if not x.flags["C_CONTIGUOUS"]:
         # (ascontiguousarray unconditionally promotes 0-d arrays to 1-d,
         # so only reorder when actually needed.)
@@ -63,9 +77,15 @@ def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
         raise ValueError(f"ndim {x.ndim} exceeds wire limit {_MAX_NDIM}")
     flags = 0
     payload = x
+    prefix = b""
     if bf16_wire and x.dtype == np.float32:
         payload = native.f32_to_bf16(x)
         flags |= FLAG_BF16_COMPRESSED
+    elif int8_wire and x.dtype == np.float32:
+        scale = float(np.max(np.abs(x)) / 127.0) if x.size else 0.0
+        payload = native.f32_to_i8(x, scale)
+        flags |= FLAG_INT8_COMPRESSED
+        prefix = struct.pack("<f", scale)
     header = struct.pack(
         f"<BBBB{x.ndim}I",
         _DTYPE_CODES[np.dtype(payload.dtype)],
@@ -74,7 +94,7 @@ def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
         0,
         *x.shape,
     )
-    return header + payload.tobytes()
+    return header + prefix + payload.tobytes()
 
 
 def decode_tensor(buf: bytes) -> np.ndarray:
@@ -89,6 +109,12 @@ def decode_tensor(buf: bytes) -> np.ndarray:
     dims: Tuple[int, ...] = struct.unpack_from(f"<{ndim}I", buf, 4)
     offset = 4 + 4 * ndim
     dtype = _CODE_DTYPES[code]
+    scale = None
+    if flags & FLAG_INT8_COMPRESSED:
+        if dtype != np.dtype(np.int8):
+            raise ValueError("int8 flag on a non-int8 payload")
+        (scale,) = struct.unpack_from("<f", buf, offset)
+        offset += 4
     count = int(np.prod(dims, dtype=np.int64)) if ndim else 1
     expect = count * dtype.itemsize
     data = buf[offset : offset + expect]
@@ -100,13 +126,16 @@ def decode_tensor(buf: bytes) -> np.ndarray:
     x = np.frombuffer(data, dtype=dtype).reshape(dims)
     if flags & FLAG_BF16_COMPRESSED:
         x = native.bf16_to_f32(x)
+    elif flags & FLAG_INT8_COMPRESSED:
+        x = native.i8_to_f32(x, scale)
     return x
 
 
 # --------------------------------------------------------------------- #
 # Sparse wire format (compressed-gossip corrections)                    #
 # --------------------------------------------------------------------- #
-def encode_sparse(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
+def encode_sparse(x: np.ndarray, *, bf16_wire: bool = False,
+                  int8_wire: bool = False) -> bytes:
     """Serialize only the non-zero entries of a (dense) array.
 
     The wire for CHOCO-style corrections
@@ -117,8 +146,11 @@ def encode_sparse(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
     ``bf16_wire`` composes), indices are flat positions into the C-order
     ravel.  Per entry the sparse wire costs 4 (index) + 2 (bf16 value)
     bytes vs 2 dense, so it wins below ~1/3 density (f32: 8 vs 4, below
-    ~1/2) — at CHOCO's typical 1-10% top-k fractions a 3-33x (bf16) /
-    5-50x (f32) byte reduction; measured 6.6x at 5% top-k, bf16.
+    ~1/2; int8: 5 vs 1, below ~1/5) — at CHOCO's typical 1-10% top-k
+    fractions a 3-33x (bf16) / 5-50x (f32) byte reduction; measured 6.6x
+    at 5% top-k, bf16.  ``int8_wire`` quantizes the value payload
+    (scale from the non-zero values only, so sparsity does not waste
+    quantization range).
     """
     x = np.asarray(x)
     flat = x.ravel()  # C-order view (copy when non-contiguous)
@@ -140,7 +172,7 @@ def encode_sparse(x: np.ndarray, *, bf16_wire: bool = False) -> bytes:
         header
         + struct.pack("<I", idx.size)
         + idx.tobytes()
-        + encode_tensor(vals, bf16_wire=bf16_wire)
+        + encode_tensor(vals, bf16_wire=bf16_wire, int8_wire=int8_wire)
     )
 
 

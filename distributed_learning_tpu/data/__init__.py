@@ -8,6 +8,10 @@ from distributed_learning_tpu.data.titanic import (
     synthetic_titanic,
     titanic_source,
 )
+from distributed_learning_tpu.data.prefetch import (
+    epoch_batches,
+    prefetch_to_device,
+)
 from distributed_learning_tpu.data.cifar import (
     CIFAR_MEAN,
     CIFAR_STD,
@@ -34,4 +38,6 @@ __all__ = [
     "normalize",
     "shard_dataset",
     "synthetic_cifar",
+    "epoch_batches",
+    "prefetch_to_device",
 ]

@@ -1,0 +1,122 @@
+"""Host -> device input pipeline: background prefetch + epoch streaming.
+
+The trainer's default layout keeps every shard device-resident and
+gathers batches on device (``training/trainer.py``) — the right call at
+the reference's CIFAR scale.  This module is the path for datasets that
+do NOT fit in HBM: a host-side batch iterator whose next few batches
+are staged onto the device (optionally with a ``NamedSharding``) by a
+daemon thread while the current step computes, so the transfer rides
+under the compute instead of serializing with it.
+
+``jax.device_put`` is asynchronous: the thread only *initiates*
+transfers, the bounded queue provides the lookahead window, and the
+consumer blocks (if ever) on data that is usually already resident.
+This is the JAX-idiomatic replacement for the torch ``DataLoader``
+worker-pool pattern the reference's notebooks rely on
+(``CIFAR_10_Baseline.ipynb`` uses torchvision loaders).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["prefetch_to_device", "epoch_batches"]
+
+_SENTINEL = object()
+
+
+def prefetch_to_device(
+    iterator: Iterable[Any],
+    *,
+    size: int = 2,
+    sharding: Optional[Any] = None,
+) -> Iterator[Any]:
+    """Yield items from ``iterator`` with ``size`` batches staged ahead.
+
+    Each item (any pytree of arrays) is placed with ``jax.device_put``
+    — onto ``sharding`` (a ``Sharding``/``NamedSharding``; arrays are
+    laid out across the mesh while still in flight) or the default
+    device.  Exceptions raised by the source iterator propagate to the
+    consumer at the matching position; the daemon thread never outlives
+    the consumer by more than the queue depth.
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    q: "queue.Queue[Any]" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded-wait put so an abandoned consumer (early `break`)
+        # releases the thread instead of pinning size+1 staged device
+        # batches until process exit.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in iterator:
+                # device_put takes the whole pytree (sharding included).
+                staged = jax.device_put(item, sharding) \
+                    if sharding is not None else jax.device_put(item)
+                if not _put(staged):
+                    return
+        except BaseException as e:  # propagate into the consumer
+            _put((_SENTINEL, e))
+            return
+        _put((_SENTINEL, None))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 and \
+                    item[0] is _SENTINEL:
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        stop.set()
+
+
+def epoch_batches(
+    X: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    *,
+    seed: Optional[int] = None,
+    drop_remainder: bool = True,
+) -> Iterator[tuple]:
+    """Shuffled ``(x_batch, y_batch)`` host batches for one epoch.
+
+    Always shuffles: ``seed`` makes the permutation reproducible (pass
+    the epoch number for a distinct deterministic order per epoch);
+    ``seed=None`` draws a fresh one.  Host-side counterpart of the
+    trainer's device-side permutation gather: a numpy permutation,
+    contiguous slices, no copies beyond the batch fancy-index.  Compose with :func:`prefetch_to_device`::
+
+        for xb, yb in prefetch_to_device(
+            epoch_batches(X, y, 256, seed=epoch), size=2, sharding=s
+        ):
+            state = train_step(state, xb, yb)
+    """
+    n = X.shape[0]
+    if y.shape[0] != n:
+        raise ValueError(f"X has {n} rows but y has {y.shape[0]}")
+    idx = np.arange(n)
+    np.random.default_rng(seed).shuffle(idx)
+    end = n - (n % batch_size) if drop_remainder else n
+    for start in range(0, end, batch_size):
+        take = idx[start:start + batch_size]
+        yield X[take], y[take]

@@ -21,6 +21,8 @@ __all__ = [
     "native_available",
     "f32_to_bf16",
     "bf16_to_f32",
+    "f32_to_i8",
+    "i8_to_f32",
     "crc32",
 ]
 
@@ -81,6 +83,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
         ]
         lib.dlt_bf16_to_f32.restype = None
+        lib.dlt_f32_to_i8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_float,
+        ]
+        lib.dlt_f32_to_i8.restype = None
+        lib.dlt_i8_to_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_float,
+        ]
+        lib.dlt_i8_to_f32.restype = None
         lib.dlt_crc32.argtypes = [
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
         ]
@@ -121,6 +133,37 @@ def bf16_to_f32(bits: np.ndarray) -> np.ndarray:
     import ml_dtypes
 
     return bits.view(ml_dtypes.bfloat16).astype(np.float32)
+
+
+def f32_to_i8(x: np.ndarray, scale: float) -> np.ndarray:
+    """Symmetric int8 quantization: round(x/scale) clamped to [-127, 127]
+    (ties to even, matching np.rint).  ``scale`` is the caller's
+    per-tensor max|x|/127."""
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    out = np.empty(x.shape, dtype=np.int8)
+    inv = 0.0 if scale == 0.0 else 1.0 / float(scale)
+    lib = _load()
+    if lib is not None and x.size:
+        lib.dlt_f32_to_i8(
+            x.ctypes.data, out.ctypes.data, ctypes.c_size_t(x.size),
+            ctypes.c_float(inv),
+        )
+        return out
+    return np.clip(np.rint(x * inv), -127, 127).astype(np.int8)
+
+
+def i8_to_f32(q: np.ndarray, scale: float) -> np.ndarray:
+    """Dequantize int8 back to f32: q * scale."""
+    q = np.ascontiguousarray(q, dtype=np.int8)
+    out = np.empty(q.shape, dtype=np.float32)
+    lib = _load()
+    if lib is not None and q.size:
+        lib.dlt_i8_to_f32(
+            q.ctypes.data, out.ctypes.data, ctypes.c_size_t(q.size),
+            ctypes.c_float(scale),
+        )
+        return out
+    return q.astype(np.float32) * np.float32(scale)
 
 
 def crc32(data, seed: int = 0) -> int:

@@ -9,7 +9,10 @@
 //     wire/storage format) — halves gossip bandwidth with the same
 //     exponent range as f32;
 //   * crc32 (reflected polynomial 0xEDB88320) integrity checksums for
-//     frames, so a torn TCP stream is detected instead of deserialized.
+//     frames, so a torn TCP stream is detected instead of deserialized;
+//   * symmetric int8 quantization (scale = max|x|/127, round-to-nearest
+//     ties-to-even, matching np.rint) — quarter-size gossip payloads
+//     whose quantization error CHOCO's error feedback absorbs.
 //
 // Exposed with C linkage for ctypes; built by native/__init__.py with g++
 // -O3 at first use and cached next to this file.
@@ -42,6 +45,28 @@ void dlt_bf16_to_f32(const uint16_t* src, float* dst, size_t n) {
   uint32_t* out = reinterpret_cast<uint32_t*>(dst);
   for (size_t i = 0; i < n; ++i) {
     out[i] = static_cast<uint32_t>(src[i]) << 16;
+  }
+}
+
+// f32 -> int8 with a caller-supplied inverse scale:
+// q = clamp(rint(x/scale), -127, 127).  nearbyintf under the default
+// FE_TONEAREST mode rounds ties to even — bit-identical to the Python
+// fallback's np.rint.
+void dlt_f32_to_i8(const float* src, int8_t* dst, size_t n, float inv_scale) {
+  for (size_t i = 0; i < n; ++i) {
+    float v = src[i] * inv_scale;
+    // Match np.rint (ties to even): use __builtin_nearbyint under the
+    // default FE_TONEAREST mode.
+    float r = __builtin_nearbyintf(v);
+    if (r > 127.0f) r = 127.0f;
+    if (r < -127.0f) r = -127.0f;
+    dst[i] = static_cast<int8_t>(r);
+  }
+}
+
+void dlt_i8_to_f32(const int8_t* src, float* dst, size_t n, float scale) {
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = static_cast<float>(src[i]) * scale;
   }
 }
 

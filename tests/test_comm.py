@@ -536,3 +536,83 @@ def test_comm_top_k_compressor_roundtrip_choco():
         await _teardown(master, agents)
 
     asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_tensor_int8_wire_quarters_payload():
+    """int8 wire: ~4x smaller than f32, error bounded by scale/2, and
+    the native path is bit-identical to the numpy fallback."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(64, 33)).astype(np.float32)
+    buf = encode_tensor(x, int8_wire=True)
+    assert len(buf) < x.nbytes / 3.5
+    back = decode_tensor(buf)
+    scale = float(np.abs(x).max() / 127.0)
+    assert float(np.abs(back - x).max()) <= 0.5 * scale + 1e-9
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        encode_tensor(x, bf16_wire=True, int8_wire=True)
+    # Zero tensor: scale 0, exact roundtrip.
+    z = np.zeros((5,), np.float32)
+    np.testing.assert_array_equal(decode_tensor(
+        encode_tensor(z, int8_wire=True)), z)
+    # Sparse composition: values quantized, indices exact.
+    from distributed_learning_tpu.comm.tensor_codec import (
+        decode_sparse,
+        encode_sparse,
+    )
+
+    s = np.zeros(64, np.float32)
+    s[[3, 17, 40]] = [1.5, -2.25, 0.75]
+    sb = decode_sparse(encode_sparse(s, int8_wire=True))
+    sc = float(np.abs(s[[3, 17, 40]]).max() / 127.0)
+    assert float(np.abs(sb - s).max()) <= 0.5 * sc + 1e-9
+    assert set(np.flatnonzero(sb)) <= {3, 17, 40}
+
+
+def test_native_int8_matches_fallback_bit_exact(monkeypatch):
+    from distributed_learning_tpu import native
+
+    if not native.native_available():
+        pytest.skip("no native codec in this environment")
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=4096).astype(np.float32)
+    scale = float(np.abs(x).max() / 127.0)
+    q_native = native.f32_to_i8(x, scale)
+    q_py = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+    np.testing.assert_array_equal(q_native, q_py)
+    np.testing.assert_array_equal(
+        native.i8_to_f32(q_native, scale),
+        q_native.astype(np.float32) * np.float32(scale),
+    )
+
+
+def test_tcp_choco_converges_with_int8_wire():
+    """CHOCO error feedback absorbs int8 quantization: exact consensus
+    through quarter-size sparse corrections, with the sender applying
+    the wire-ROUNDED (quantized) correction to its own estimate."""
+
+    def topk25(v: np.ndarray) -> np.ndarray:
+        k = max(1, v.size // 4)
+        out = np.zeros_like(v)
+        idx = np.argsort(np.abs(v))[-k:]
+        out[idx] = v[idx]
+        return out
+
+    async def main():
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3"), ("3", "1")], ["1", "2", "3"],
+            sparse_wire=True, int8_wire=True,
+        )
+        rng = np.random.default_rng(1)
+        vals = [rng.normal(size=16).astype(np.float32) for _ in range(3)]
+        mean = np.mean(vals, axis=0)
+        xs = list(vals)
+        for _ in range(80):
+            xs = list(await asyncio.gather(
+                *(a.run_choco_once(xs[i], topk25, gamma=0.4)
+                  for i, a in enumerate(agents))
+            ))
+        for x in xs:
+            np.testing.assert_allclose(x, mean, atol=5e-2)
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 120))

@@ -117,3 +117,85 @@ def test_shard_dataset_disjoint_and_batch_aligned():
         total += len(xs)
     assert total <= 1000
     assert total >= 4 * 192  # near-equal shards of 250 -> 192 after trunc
+
+
+def test_epoch_batches_covers_and_shuffles():
+    from distributed_learning_tpu.data import epoch_batches
+
+    X = np.arange(20, dtype=np.float32)[:, None]
+    y = np.arange(20, dtype=np.int32)
+    got = list(epoch_batches(X, y, 8, seed=0))
+    # drop_remainder: 2 full batches of 8, 4 rows dropped.
+    assert len(got) == 2 and all(b[0].shape == (8, 1) for b in got)
+    seen = np.concatenate([b[1] for b in got])
+    assert len(set(seen.tolist())) == 16          # no duplicates
+    assert not np.array_equal(seen, np.arange(16))  # shuffled
+    # x/y stay aligned through the permutation.
+    for xb, yb in got:
+        np.testing.assert_array_equal(xb[:, 0].astype(np.int32), yb)
+    # Same seed -> same order; different seed -> different order.
+    again = np.concatenate([b[1] for b in epoch_batches(X, y, 8, seed=0)])
+    np.testing.assert_array_equal(seen, again)
+    other = np.concatenate([b[1] for b in epoch_batches(X, y, 8, seed=1)])
+    assert not np.array_equal(seen, other)
+
+
+def test_prefetch_to_device_preserves_stream_and_shards():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distributed_learning_tpu.data import (
+        epoch_batches,
+        prefetch_to_device,
+    )
+
+    X = np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32)
+    y = np.arange(32, dtype=np.int32)
+    plain = list(epoch_batches(X, y, 8, seed=3))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    fetched = list(prefetch_to_device(
+        epoch_batches(X, y, 8, seed=3), size=2, sharding=sharding
+    ))
+    assert len(fetched) == len(plain)
+    for (xa, ya), (xb, yb) in zip(plain, fetched):
+        np.testing.assert_array_equal(xa, np.asarray(xb))
+        np.testing.assert_array_equal(ya, np.asarray(yb))
+        assert xb.sharding.spec == P("data")
+
+
+def test_prefetch_propagates_source_errors():
+    import pytest
+
+    from distributed_learning_tpu.data import prefetch_to_device
+
+    def bad():
+        yield np.zeros(4)
+        raise RuntimeError("source broke")
+
+    it = prefetch_to_device(bad(), size=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="source broke"):
+        next(it)
+
+
+def test_prefetch_releases_producer_on_early_break():
+    import threading
+    import time
+
+    from distributed_learning_tpu.data import prefetch_to_device
+
+    before = threading.active_count()
+
+    def src():
+        for i in range(100):
+            yield np.full(4, i, np.float32)
+
+    it = prefetch_to_device(src(), size=1)
+    got = next(it)
+    np.testing.assert_array_equal(np.asarray(got), np.zeros(4))
+    it.close()  # the consumer walks away (generator finalized)
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer thread leaked"
