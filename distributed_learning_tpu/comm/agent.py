@@ -93,10 +93,15 @@ class ConsensusAgent:
         self.host, self.port = host, port
         self.bf16_wire = bf16_wire
         # int8 wire: quarter-size value payloads via symmetric per-tensor
-        # quantization (tensor_codec FLAG_INT8_COMPRESSED).  Meant for
-        # error-feedback loops (run_choco_once) where the quantization
-        # noise is folded back into the next correction.
+        # quantization (tensor_codec FLAG_INT8_COMPRESSED).  Applied ONLY
+        # inside run_choco_once's exchange: there the error-feedback loop
+        # folds quantization noise into the next correction.  Plain
+        # run_once/run_round values have no such feedback — int8 noise
+        # (up to max|x|/254 per hop) would put a floor under the
+        # convergence residual and spin eps-rounds to max_iterations —
+        # so those paths keep full precision.
         self.int8_wire = int8_wire
+        self._int8_active = False
         # Sparse wire: value responses ship non-zeros as k values + indices
         # (tensor_codec.encode_sparse) — for k-sparse payloads such as
         # CHOCO compressed-gossip corrections (run_choco_once).  Deploy
@@ -335,7 +340,7 @@ class ConsensusAgent:
         for ref, verdict in self._sparse_cache:
             if ref is value:
                 return verdict
-        per_dense = 1 if self.int8_wire else 2 if self.bf16_wire else 4
+        per_dense = 1 if self._int8_active else 2 if self.bf16_wire else 4
         breakeven = value.size * per_dense / (4 + per_dense)
         verdict = bool(np.count_nonzero(value) < breakeven)
         self._sparse_cache = [(value, verdict), self._sparse_cache[0]]
@@ -348,11 +353,11 @@ class ConsensusAgent:
         if self.sparse_wire and value is not None and self._sparse_wins(value):
             return P.ValueResponseSparse(
                 round_id=round_id, iteration=iteration, value=value,
-                bf16_wire=self.bf16_wire, int8_wire=self.int8_wire,
+                bf16_wire=self.bf16_wire, int8_wire=self._int8_active,
             )
         return P.ValueResponse(
             round_id=round_id, iteration=iteration, value=value,
-            bf16_wire=self.bf16_wire, int8_wire=self.int8_wire,
+            bf16_wire=self.bf16_wire, int8_wire=self._int8_active,
         )
 
     async def _flush_deferred(self) -> None:
@@ -592,7 +597,11 @@ class ConsensusAgent:
             ))
         self._op_id += 1
         self._iteration = 0
-        neighbor_qs = await self._exchange_values(q)
+        self._int8_active = self.int8_wire  # int8 only for this exchange
+        try:
+            neighbor_qs = await self._exchange_values(q)
+        finally:
+            self._int8_active = False
         assert neighbor_qs is not None  # no master Done in masterless mode
 
         self._choco_hat_self = self._choco_hat_self + q

@@ -83,6 +83,14 @@ def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False,
         flags |= FLAG_BF16_COMPRESSED
     elif int8_wire and x.dtype == np.float32:
         scale = float(np.max(np.abs(x)) / 127.0) if x.size else 0.0
+        if not np.isfinite(scale):
+            # A NaN/Inf anywhere poisons max|x| (and would quantize the
+            # whole tensor to garbage, platform-dependently).  Loud, not
+            # dropped — same stance as top_k_sparse.
+            raise ValueError(
+                "int8 wire requires finite values (scale came out "
+                f"{scale}); refusing to quantize a poisoned tensor"
+            )
         payload = native.f32_to_i8(x, scale)
         flags |= FLAG_INT8_COMPRESSED
         prefix = struct.pack("<f", scale)

@@ -37,7 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_learning_tpu.training.fsdp import fsdp_spec
 
-__all__ = ["make_gossip_fsdp_step", "shard_stacked_fsdp"]
+__all__ = ["make_gossip_fsdp_step", "shard_stacked_fsdp",
+           "make_gossip_tp_step", "shard_stacked_tp"]
 
 
 def _stacked_spec(leaf, n_data: int, agents_axis: str, data_axis: str) -> P:
@@ -60,6 +61,58 @@ def shard_stacked_fsdp(tree: Any, mesh: Mesh, agents_axis: str = "agents",
         ),
         tree,
     )
+
+
+
+
+def _build_gossip_step(mesh, model, tx, W, constrain_params, constrain_opt,
+                       data_sharding):
+    """Shared jitted step body for every gossip x <inner-axis> variant:
+    per-agent vmapped train step (each agent keeps its own optimizer
+    state) + one mixing-matrix einsum, with the variant supplying only
+    the leaf-placement strategy."""
+    import optax
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        params = constrain_params(params)
+        opt_state = constrain_opt(opt_state, params)
+        x = jax.lax.with_sharding_constraint(x, data_sharding)
+        y = jax.lax.with_sharding_constraint(y, data_sharding)
+
+        def agent_train(p, o, xa, ya):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, xa)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, ya
+                ).mean()
+
+            l, g = jax.value_and_grad(loss_fn)(p)
+            updates, o = tx.update(g, o, p)
+            return optax.apply_updates(p, updates), o, l
+
+        # vmap the WHOLE per-agent step over the stacked axis: each
+        # agent keeps its own optimizer state (scalar Adam count etc. —
+        # stacked tx.update would broadcast the per-agent count against
+        # param-shaped moments and fail), and the partitioner maps the
+        # vmapped program onto the agents axis from the constraints.
+        params, opt_state, losses = jax.vmap(agent_train)(
+            params, opt_state, x, y
+        )
+        loss = jnp.mean(losses)
+        # One gossip round: x_a <- sum_b W[a,b] x_b, elementwise across
+        # the inner-axis shards (mixing commutes with them).
+        params = jax.tree.map(
+            lambda a: jnp.einsum("ab,b...->a...", W.astype(a.dtype), a),
+            params,
+        )
+        return (
+            constrain_params(params),
+            constrain_opt(opt_state, params),
+            loss,
+        )
+
+    return step
 
 
 def make_gossip_fsdp_step(
@@ -105,44 +158,120 @@ def make_gossip_fsdp_step(
             tree,
         )
 
-    data_sharding = NamedSharding(mesh, P(agents_axis, data_axis))
+    return _build_gossip_step(
+        mesh, model, tx, W,
+        constrain_params=constrain,
+        constrain_opt=lambda opt, params: constrain(opt),
+        data_sharding=NamedSharding(mesh, P(agents_axis, data_axis)),
+    )
 
-    @jax.jit
-    def step(params, opt_state, x, y):
-        params = constrain(params)
-        opt_state = constrain(opt_state)
-        x = jax.lax.with_sharding_constraint(x, data_sharding)
-        y = jax.lax.with_sharding_constraint(y, data_sharding)
 
-        def agent_train(p, o, xa, ya):
-            def loss_fn(p):
-                logits = model.apply({"params": p}, xa)
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    logits, ya
-                ).mean()
 
-            l, g = jax.value_and_grad(loss_fn)(p)
-            updates, o = tx.update(g, o, p)
-            return optax.apply_updates(p, updates), o, l
 
-        # vmap the WHOLE per-agent step (loss, grad, optax update) over
-        # the stacked axis: each agent keeps its own optimizer state
-        # (scalar Adam count etc. — stacked `tx.update` would broadcast
-        # the per-agent count against param-shaped moments and fail),
-        # and the partitioner maps the vmapped program onto the agents
-        # axis from the sharding constraints.
-        params, opt_state, losses = jax.vmap(agent_train)(
-            params, opt_state, x, y
+def _stacked_megatron_spec(path, leaf, mesh: Mesh, agents_axis: str,
+                           model_axis: str) -> P:
+    """Stacked (N, ...) leaf spec: agents on dim 0, megatron TP rules
+    (with the divisibility fallback) on the remaining dims."""
+    from distributed_learning_tpu.training.tp import (
+        _divisible_or_replicated,
+        transformer_tp_rules,
+    )
+
+    inner_leaf = jax.ShapeDtypeStruct(leaf.shape[1:], leaf.dtype)
+    inner = transformer_tp_rules(path, inner_leaf, model_axis)
+    inner = _divisible_or_replicated(inner, inner_leaf, mesh, model_axis)
+    return P(agents_axis, *tuple(inner))
+
+
+def make_gossip_tp_step(
+    mesh: Mesh,
+    model: Any,
+    tx: Any,
+    mixing_matrix,
+    *,
+    agents_axis: str = "agents",
+    model_axis: str = "model",
+) -> Callable[..., Tuple[Any, Any, jax.Array]]:
+    """Gossip x TENSOR parallelism: ``(agents, model)`` mesh.
+
+    Same contract as :func:`make_gossip_fsdp_step`, but the inner axis
+    carries the transformer's megatron shardings
+    (:func:`~distributed_learning_tpu.training.tp.transformer_tp_rules`
+    applied per stacked leaf, with the divisibility fallback): each
+    agent row holds one replica split across its devices by HEAD/column/
+    row, and the gossip einsum mixes the distributed replicas without
+    ever gathering them.  With spmd_lm (gossip x sp) and gossip x fsdp
+    this closes the composition set: the reference's one parallelism
+    family rides any of the other axes.
+    """
+    N = mesh.shape[agents_axis]
+    W = jnp.asarray(np.asarray(mixing_matrix), jnp.float32)
+    if W.shape != (N, N):
+        raise ValueError(
+            f"mixing matrix {W.shape} != ({N}, {N}) mesh agents"
         )
-        loss = jnp.mean(losses)
-        # One gossip round: x_a <- sum_b W[a,b] x_b, elementwise across
-        # the data shards (mixing commutes with the fsdp sharding).
-        params = jax.tree.map(
-            lambda a: jnp.einsum(
-                "ab,b...->a...", W.astype(a.dtype), a
+
+    def constrain_params(tree):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(
+                    mesh,
+                    _stacked_megatron_spec(path, a, mesh, agents_axis,
+                                           model_axis),
+                )
             ),
-            params,
+            tree,
         )
-        return constrain(params), constrain(opt_state), loss
 
-    return step
+    def constrain_opt(opt_state, params):
+        # Optimizer moments are param-shaped under optax's own tree
+        # structure: match stacked shapes to stacked specs (collision ->
+        # replicated-inner), the same recipe as tp.py's constrain_opt.
+        shape_spec: dict = {}
+
+        def record(path, leaf):
+            spec = _stacked_megatron_spec(path, leaf, mesh, agents_axis,
+                                          model_axis)
+            prev = shape_spec.get(leaf.shape)
+            if prev is not None and prev != spec:
+                shape_spec[leaf.shape] = P(agents_axis)
+            else:
+                shape_spec[leaf.shape] = spec
+            return leaf
+
+        jax.tree_util.tree_map_with_path(record, params)
+
+        def place(leaf):
+            shape = getattr(leaf, "shape", None)
+            spec = shape_spec.get(shape)
+            if spec is None:
+                spec = P(agents_axis) if getattr(leaf, "ndim", 0) and \
+                    shape and shape[0] == N else P()
+            return jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec)
+            )
+
+        return jax.tree.map(place, opt_state)
+
+    return _build_gossip_step(
+        mesh, model, tx, W,
+        constrain_params=constrain_params,
+        constrain_opt=constrain_opt,
+        data_sharding=NamedSharding(mesh, P(agents_axis)),
+    )
+
+
+def shard_stacked_tp(params: Any, mesh: Mesh, agents_axis: str = "agents",
+                     model_axis: str = "model") -> Any:
+    """Device-put stacked per-agent params with agents x megatron specs."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jax.device_put(
+            leaf,
+            NamedSharding(
+                mesh,
+                _stacked_megatron_spec(path, leaf, mesh, agents_axis,
+                                       model_axis),
+            ),
+        ),
+        params,
+    )

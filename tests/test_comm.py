@@ -577,7 +577,12 @@ def test_native_int8_matches_fallback_bit_exact(monkeypatch):
     x = rng.normal(size=4096).astype(np.float32)
     scale = float(np.abs(x).max() / 127.0)
     q_native = native.f32_to_i8(x, scale)
-    q_py = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+    # Both shipped paths multiply by the precomputed inverse scale (the
+    # native kernel receives inv as c_float); the reference must do the
+    # same — x / scale can differ by 1 ulp at a tie boundary.
+    q_py = np.clip(
+        np.rint(x * np.float32(1.0 / scale)), -127, 127
+    ).astype(np.int8)
     np.testing.assert_array_equal(q_native, q_py)
     np.testing.assert_array_equal(
         native.i8_to_f32(q_native, scale),
