@@ -482,12 +482,31 @@ def _prep_blocks(q, k, v, block_q, block_k):
     block_k, unpack) where ``unpack`` restores a (B*H, T, Dp) result to
     (B, T, H, D) and slices off the head-dim padding."""
     B, T, H, D = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
+
+    def _fit(request: int) -> int:
+        # Largest block <= request that divides T, preferring 8-aligned
+        # (the TPU sublane tile) — so the measured-best large defaults
+        # degrade gracefully for any T instead of raising (same policy
+        # as ring_flash_attention's fit_block).
+        b = min(request, T)
+        aligned = next(
+            (c for c in range(b, 7, -1) if T % c == 0 and c % 8 == 0),
+            None,
+        )
+        if aligned is not None:
+            return aligned
+        while T % b:
+            b -= 1
+        return b
+
+    block_q = _fit(block_q)
+    block_k = _fit(block_k)
+    if jax.devices()[0].platform == "tpu" and T % 8:
+        # Unaligned T cannot produce 8-aligned blocks; fail with a clear
+        # message instead of a Mosaic lowering error.
         raise ValueError(
-            f"sequence length {T} must be divisible by block sizes "
-            f"({block_q}, {block_k})"
+            f"flash_attention on TPU needs T divisible by 8, got {T}; "
+            "pad the sequence or use attention_reference"
         )
     # The TPU lowering tiles the last two block dims to (8, 128): pad the
     # head dim up to a lane multiple.  Zero K/Q columns leave every score
@@ -518,8 +537,8 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
     interpret: bool = False,
     window: Optional[int] = None,
 ) -> jax.Array:
@@ -531,6 +550,12 @@ def flash_attention(
     through the kernels and sliced back.  Off-TPU without ``interpret``
     this falls back to the reference einsum/softmax path (XLA fuses it
     well enough on CPU; the kernel is the TPU fast path).
+
+    Default blocks (256, 512) are the measured-best forward
+    configuration from the on-chip sweep at 8k-131k tokens
+    (``BASELINE.json: flash_attention_*``); for any T they degrade to
+    the largest 8-aligned blocks that divide T, so every previously
+    valid sequence length keeps working.
 
     ``window`` (requires ``causal``) is sliding-window attention: row
     ``r`` attends to keys ``[r - window + 1, r]``.  Blocks entirely
@@ -568,8 +593,8 @@ def flash_attention_with_lse(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Like :func:`flash_attention` but also returns the per-row

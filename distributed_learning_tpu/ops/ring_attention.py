@@ -185,8 +185,8 @@ def ring_flash_attention(
     axis_name: str,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Ring attention with the Pallas flash kernel as the per-block

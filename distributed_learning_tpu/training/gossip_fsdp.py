@@ -65,13 +65,22 @@ def shard_stacked_fsdp(tree: Any, mesh: Mesh, agents_axis: str = "agents",
 
 
 
-def _build_gossip_step(mesh, model, tx, W, constrain_params, constrain_opt,
-                       data_sharding):
+def _build_gossip_step(mesh, model, tx, mixing_matrix, constrain_params,
+                       constrain_opt, data_sharding, *,
+                       agents_axis="agents"):
     """Shared jitted step body for every gossip x <inner-axis> variant:
     per-agent vmapped train step (each agent keeps its own optimizer
     state) + one mixing-matrix einsum, with the variant supplying only
-    the leaf-placement strategy."""
+    the leaf-placement strategy.  Validates the mixing matrix against
+    the mesh's agent count."""
     import optax
+
+    N = mesh.shape[agents_axis]
+    W = jnp.asarray(np.asarray(mixing_matrix), jnp.float32)
+    if W.shape != (N, N):
+        raise ValueError(
+            f"mixing matrix {W.shape} != ({N}, {N}) mesh agents"
+        )
 
     @jax.jit
     def step(params, opt_state, x, y):
@@ -137,15 +146,7 @@ def make_gossip_fsdp_step(
     ``Topology.ring(N).metropolis_weights()``); one round applies per
     step, after the optimizer update — the trainer cadence.
     """
-    import optax
-
-    N = mesh.shape[agents_axis]
     n_data = mesh.shape[data_axis]
-    W = jnp.asarray(np.asarray(mixing_matrix), jnp.float32)
-    if W.shape != (N, N):
-        raise ValueError(
-            f"mixing matrix {W.shape} != ({N}, {N}) mesh agents"
-        )
 
     def constrain(tree):
         return jax.tree.map(
@@ -159,10 +160,11 @@ def make_gossip_fsdp_step(
         )
 
     return _build_gossip_step(
-        mesh, model, tx, W,
+        mesh, model, tx, mixing_matrix,
         constrain_params=constrain,
         constrain_opt=lambda opt, params: constrain(opt),
         data_sharding=NamedSharding(mesh, P(agents_axis, data_axis)),
+        agents_axis=agents_axis,
     )
 
 
@@ -205,11 +207,6 @@ def make_gossip_tp_step(
     family rides any of the other axes.
     """
     N = mesh.shape[agents_axis]
-    W = jnp.asarray(np.asarray(mixing_matrix), jnp.float32)
-    if W.shape != (N, N):
-        raise ValueError(
-            f"mixing matrix {W.shape} != ({N}, {N}) mesh agents"
-        )
 
     def constrain_params(tree):
         return jax.tree_util.tree_map_with_path(
@@ -254,10 +251,11 @@ def make_gossip_tp_step(
         return jax.tree.map(place, opt_state)
 
     return _build_gossip_step(
-        mesh, model, tx, W,
+        mesh, model, tx, mixing_matrix,
         constrain_params=constrain_params,
         constrain_opt=constrain_opt,
         data_sharding=NamedSharding(mesh, P(agents_axis)),
+        agents_axis=agents_axis,
     )
 
 
