@@ -17,7 +17,10 @@ from distributed_learning_tpu.models.logreg import (
 )
 from distributed_learning_tpu.models.mlp import ANNModel
 from distributed_learning_tpu.models.moe import MoEMLP
-from distributed_learning_tpu.models.transformer import TransformerLM
+from distributed_learning_tpu.models.transformer import (
+    TransformerLM,
+    generate,
+)
 from distributed_learning_tpu.models.vision import LeNet, ResNet, VGG, WideResNet
 
 _REGISTRY = {
@@ -69,6 +72,7 @@ def get_model(name: str, *args: Any, **kwargs: Any):
 __all__ = [
     "ANNModel",
     "TransformerLM",
+    "generate",
     "MoEMLP",
     "LeNet",
     "VGG",

@@ -17,6 +17,8 @@ Knobs:
 * ``num_kv_heads`` — grouped-query attention (KV cache shrinks H/Hkv);
 * ``mlp`` / ``num_experts`` / ``moe_top_k`` — dense or expert-parallel
   MoE feed-forward;
+* ``dropout_rate`` — residual-branch dropout under ``train=True`` (the
+  trainer already threads dropout rngs);
 * ``decode`` + :func:`generate` — KV-cache autoregressive generation.
 """
 
@@ -243,30 +245,40 @@ class _Block(nn.Module):
     cache_len: int = 0
     rope: bool = False
     num_kv_heads: int | None = None
+    dropout_rate: float = 0.0
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, train: bool = False):
+        def drop(h):
+            # Residual-branch dropout (the GPT placement), gated like the
+            # WRN blocks: deterministic unless training.
+            if self.dropout_rate > 0:
+                h = nn.Dropout(
+                    self.dropout_rate, deterministic=not train
+                )(h)
+            return h
+
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        x = x + _Attention(
+        x = x + drop(_Attention(
             self.num_heads, self.head_dim, self.attn_impl, self.seq_axis,
             self.dtype, self.attn_window, self.decode, self.cache_len,
             self.rope, self.num_kv_heads,
-        )(h, positions)
+        )(h, positions))
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.mlp == "moe":
             # Expert-parallel feed-forward (models/moe.py): params become
             # stacked (E, ...) kernels shardable over an expert mesh axis.
-            return x + MoEMLP(
+            return x + drop(MoEMLP(
                 num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
                 top_k=self.moe_top_k, dtype=self.dtype,
-            )(h)
+            )(h))
         if self.mlp != "dense":
             raise ValueError(f"unknown mlp {self.mlp!r} (want dense|moe)")
         d = x.shape[-1]
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
         h = nn.gelu(h)
         h = nn.Dense(d, dtype=self.dtype)(h)
-        return x + h
+        return x + drop(h)
 
 
 class TransformerLM(nn.Module):
@@ -290,6 +302,7 @@ class TransformerLM(nn.Module):
     num_experts: int = 4
     moe_top_k: int = 1       # router choices per token (1=Switch, 2=GShard)
     attn_window: int | None = None  # sliding-window attention (full/flash)
+    dropout_rate: float = 0.0  # residual-branch dropout (train=True only)
     pos_emb: str = "learned"  # "learned" table | "rope" rotary Q/K
     num_kv_heads: int | None = None  # GQA: shared K/V heads (cache /Hkv)
     decode: bool = False     # KV-cache autoregressive mode (see generate).
@@ -354,8 +367,8 @@ class TransformerLM(nn.Module):
                 self.attn_impl, self.seq_axis, self.dtype,
                 self.mlp, self.num_experts, self.moe_top_k,
                 self.attn_window, self.decode, self.max_len,
-                use_rope, self.num_kv_heads,
-            )(x, positions if use_rope else None)
+                use_rope, self.num_kv_heads, self.dropout_rate,
+            )(x, positions if use_rope else None, train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)
         return logits.astype(jnp.float32)

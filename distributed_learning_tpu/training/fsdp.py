@@ -88,6 +88,17 @@ def make_fsdp_train_step(
     layout survives the update (optimizer moments are param-shaped:
     the same spec function applies leaf-wise).
     """
+
+    if getattr(model, "dropout_rate", 0.0):
+        # These step builders apply the model without a dropout rng;
+        # accepting a dropout-configured model would silently train
+        # UN-regularized.  The GossipTrainer path threads dropout rngs;
+        # here the knob must be explicit.
+        raise ValueError(
+            "model has dropout_rate > 0 but this train step does not "
+            "thread dropout rngs; train via GossipTrainer or set "
+            "dropout_rate=0"
+        )
     import optax
 
     n = mesh.shape[data_axis]

@@ -409,3 +409,76 @@ def test_transformer_mqa_tp_replicates_indivisible_kv():
         )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-5)
+
+
+def test_transformer_dropout():
+    """dropout_rate: inactive at eval (exactly deterministic), active in
+    training (two rngs differ), and trainable through the GossipTrainer
+    path that already feeds dropout rngs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_tpu.models.transformer import TransformerLM
+
+    kw = dict(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+              max_len=16, dropout_rate=0.5)
+    model = TransformerLM(**kw)
+    x = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, size=(2, 16)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), x)["params"]
+    # Eval: no dropout, no rng needed, bit-stable.
+    a = model.apply({"params": params}, x)
+    b = model.apply({"params": params}, x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Train: rng-dependent.
+    t1 = model.apply({"params": params}, x, train=True,
+                     rngs={"dropout": jax.random.key(1)})
+    t2 = model.apply({"params": params}, x, train=True,
+                     rngs={"dropout": jax.random.key(2)})
+    assert float(jnp.max(jnp.abs(t1 - t2))) > 1e-4
+    # Same rng -> same output (reproducible).
+    t3 = model.apply({"params": params}, x, train=True,
+                     rngs={"dropout": jax.random.key(1)})
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t3))
+
+
+def test_dropout_model_rejected_by_rngless_step_builders():
+    """The SPMD step builders don't thread dropout rngs: accepting a
+    dropout-configured model would silently train unregularized, so
+    they must refuse it."""
+    import jax
+    import numpy as np
+    import optax
+    import pytest
+    from jax.sharding import Mesh
+
+    from distributed_learning_tpu.models.transformer import TransformerLM
+    from distributed_learning_tpu.parallel.topology import Topology
+    from distributed_learning_tpu.training.fsdp import make_fsdp_train_step
+    from distributed_learning_tpu.training.gossip_fsdp import (
+        make_gossip_fsdp_step,
+    )
+    from distributed_learning_tpu.training.spmd_lm import make_gossip_lm_step
+    from distributed_learning_tpu.training.tp import make_tp_train_step
+
+    model = TransformerLM(vocab_size=16, num_layers=1, num_heads=2,
+                          head_dim=8, max_len=8, dropout_rate=0.1)
+    tx = optax.adam(1e-3)
+    mesh1 = Mesh(np.array(jax.devices()[:8]), ("data",))
+    mesh2 = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                 ("agents", "data"))
+    mesh3 = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                 ("agents", "seq"))
+    mesh4 = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                 ("data", "model"))
+    W = Topology.ring(4).metropolis_weights()
+    for make in (
+        lambda: make_fsdp_train_step(mesh1, model, tx),
+        lambda: make_gossip_fsdp_step(mesh2, model, tx, W),
+        lambda: make_gossip_lm_step(mesh3, model, tx),
+        lambda: make_tp_train_step(mesh4, model, tx),
+    ):
+        with pytest.raises(ValueError, match="dropout"):
+            make()
