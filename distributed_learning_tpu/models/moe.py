@@ -50,6 +50,7 @@ class MoEMLP(nn.Module):
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
     top_k: int = 1
+    drop_tokens: bool = True
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -85,6 +86,11 @@ class MoEMLP(nn.Module):
         # top_k == 1 keeps the RAW router probability as the combine
         # weight (Switch-style) — renormalizing would make it constant
         # 1.0 and cut the router out of the gradient entirely.
+
+        if not self.drop_tokens:
+            return self._dense_dropfree(
+                x, tokens, onehots, gates, probs, B, T, d, E, S
+            )
 
         # Capacity slots with choice priority: choice j's tokens queue
         # behind ALL tokens of choices < j for the same expert.
@@ -145,6 +151,48 @@ class MoEMLP(nn.Module):
         self.sow(
             "moe_stats", "dropped_fraction",
             1.0 - jnp.sum(dispatch) / (S * self.top_k),
+            reduce_fn=lambda a, b: b,
+        )
+        return out.reshape(B, T, d).astype(x.dtype)
+
+    def _dense_dropfree(self, x, tokens, onehots, gates, probs, B, T, d,
+                        E, S):
+        """Drop-free path (``drop_tokens=False`` — autoregressive
+        decode): run EVERY expert on every token and combine with the
+        top-k gate weights.  Capacity drops depend on the other tokens
+        sharing the flattened batch (order-dependent), so decode must
+        not drop or incremental and from-scratch computations of the
+        same position diverge.  Dense all-experts costs E*S*d*h — less
+        than the (S, E, S)-dispatch alternative whenever S > ratio*d —
+        and keeps every shape static.
+        """
+        h = self.mlp_ratio * d
+        # Declare the SAME params as the dropping branch (names, shapes,
+        # initializers) so a drop-free module inits/shards identically.
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, d, h), self.dtype,
+        )
+        b_up = self.param("b_up", nn.initializers.zeros, (E, h), self.dtype)
+        w_dn = self.param(
+            "w_dn", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, h, d), self.dtype,
+        )
+        b_dn = self.param("b_dn", nn.initializers.zeros, (E, d), self.dtype)
+        act = jnp.einsum(
+            "sd,edh->seh", tokens.astype(jnp.float32),
+            w_up.astype(jnp.float32),
+        ) + b_up.astype(jnp.float32)[None]
+        act = nn.gelu(act)
+        out_e = jnp.einsum(
+            "seh,ehd->sed", act, w_dn.astype(jnp.float32)
+        ) + b_dn.astype(jnp.float32)[None]
+        weight = sum(
+            g[:, None] * oh for g, oh in zip(gates, onehots)
+        )  # (S, E)
+        out = jnp.einsum("se,sed->sd", weight, out_e)
+        self.sow(
+            "moe_stats", "dropped_fraction", jnp.zeros(()),
             reduce_fn=lambda a, b: b,
         )
         return out.reshape(B, T, d).astype(x.dtype)

@@ -271,6 +271,7 @@ class _Block(nn.Module):
             return x + drop(MoEMLP(
                 num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
                 top_k=self.moe_top_k, dtype=self.dtype,
+                drop_tokens=not self.decode,
             )(h))
         if self.mlp != "dense":
             raise ValueError(f"unknown mlp {self.mlp!r} (want dense|moe)")

@@ -32,6 +32,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["fsdp_spec", "shard_params_fsdp", "make_fsdp_train_step"]
 
 
+def reject_dropout_model(model) -> None:
+    """Shared precondition for every rng-less step builder: refuse a
+    dropout-configured model instead of silently training it
+    UN-regularized (these builders apply the model without a dropout
+    rng; the GossipTrainer path is the one that threads rngs)."""
+    if getattr(model, "dropout_rate", 0.0):
+        raise ValueError(
+            "model has dropout_rate > 0 but this train step does not "
+            "thread dropout rngs; train via GossipTrainer or set "
+            "dropout_rate=0"
+        )
+
+
 def fsdp_spec(leaf, axis_size: int, data_axis: str,
               avoid: Optional[P] = None) -> P:
     """PartitionSpec sharding ``leaf``'s largest divisible dim over
@@ -89,16 +102,7 @@ def make_fsdp_train_step(
     the same spec function applies leaf-wise).
     """
 
-    if getattr(model, "dropout_rate", 0.0):
-        # These step builders apply the model without a dropout rng;
-        # accepting a dropout-configured model would silently train
-        # UN-regularized.  The GossipTrainer path threads dropout rngs;
-        # here the knob must be explicit.
-        raise ValueError(
-            "model has dropout_rate > 0 but this train step does not "
-            "thread dropout rngs; train via GossipTrainer or set "
-            "dropout_rate=0"
-        )
+    reject_dropout_model(model)
     import optax
 
     n = mesh.shape[data_axis]

@@ -35,7 +35,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_learning_tpu.training.fsdp import fsdp_spec
+from distributed_learning_tpu.training.fsdp import (
+    fsdp_spec,
+    reject_dropout_model,
+)
 
 __all__ = ["make_gossip_fsdp_step", "shard_stacked_fsdp",
            "make_gossip_tp_step", "shard_stacked_tp"]
@@ -73,16 +76,7 @@ def _build_gossip_step(mesh, model, tx, mixing_matrix, constrain_params,
     state) + one mixing-matrix einsum, with the variant supplying only
     the leaf-placement strategy.  Validates the mixing matrix against
     the mesh's agent count."""
-    if getattr(model, "dropout_rate", 0.0):
-        # These step builders apply the model without a dropout rng;
-        # accepting a dropout-configured model would silently train
-        # UN-regularized.  The GossipTrainer path threads dropout rngs;
-        # here the knob must be explicit.
-        raise ValueError(
-            "model has dropout_rate > 0 but this train step does not "
-            "thread dropout rngs; train via GossipTrainer or set "
-            "dropout_rate=0"
-        )
+    reject_dropout_model(model)
     import optax
 
     N = mesh.shape[agents_axis]

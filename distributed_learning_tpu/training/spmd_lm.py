@@ -65,16 +65,11 @@ def make_gossip_lm_step(
     caller (the shift crosses shard boundaries, so it must happen on the
     global array).
     """
-    if getattr(model, "dropout_rate", 0.0):
-        # These step builders apply the model without a dropout rng;
-        # accepting a dropout-configured model would silently train
-        # UN-regularized.  The GossipTrainer path threads dropout rngs;
-        # here the knob must be explicit.
-        raise ValueError(
-            "model has dropout_rate > 0 but this train step does not "
-            "thread dropout rngs; train via GossipTrainer or set "
-            "dropout_rate=0"
-        )
+    from distributed_learning_tpu.training.fsdp import (
+        reject_dropout_model,
+    )
+
+    reject_dropout_model(model)
     n_agents = mesh.shape[agents_axis]
     w = float(self_weight) if self_weight is not None else 1.0 / 3.0
     perm_fwd = [(i, (i + 1) % n_agents) for i in range(n_agents)]

@@ -124,16 +124,11 @@ def make_tp_train_step(
     call so the layout survives the optimizer update.
     """
 
-    if getattr(model, "dropout_rate", 0.0):
-        # These step builders apply the model without a dropout rng;
-        # accepting a dropout-configured model would silently train
-        # UN-regularized.  The GossipTrainer path threads dropout rngs;
-        # here the knob must be explicit.
-        raise ValueError(
-            "model has dropout_rate > 0 but this train step does not "
-            "thread dropout rngs; train via GossipTrainer or set "
-            "dropout_rate=0"
-        )
+    from distributed_learning_tpu.training.fsdp import (
+        reject_dropout_model,
+    )
+
+    reject_dropout_model(model)
     import optax
 
     def constrain_params(params):

@@ -482,3 +482,37 @@ def test_dropout_model_rejected_by_rngless_step_builders():
     ):
         with pytest.raises(ValueError, match="dropout"):
             make()
+
+
+def test_transformer_moe_decode_matches_dropfree_forward():
+    """MoE capacity drops are batch-order-dependent, so decode runs
+    drop-free; it must match the full forward of a drop-free twin
+    exactly (same params — capacity is not a parameter)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_tpu.models.transformer import (
+        TransformerLM,
+        generate,
+    )
+
+    kw = dict(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+              max_len=16, mlp="moe", num_experts=4, moe_top_k=2)
+    model = TransformerLM(**kw)  # training model: capacity drops
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, size=(2, 5)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), prompt)["params"]
+    got = generate(model, params, prompt, 4)
+
+    # Oracle: recompute the whole growing sequence from scratch through
+    # the (drop-free) decode path each step — incremental cache reuse
+    # must equal recompute-from-scratch token for token.
+    dec = model.clone(decode=True)
+    seq = prompt
+    for _ in range(4):
+        logits, _ = dec.apply({"params": params}, seq, mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(seq[:, 5:]))

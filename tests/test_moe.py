@@ -263,3 +263,25 @@ def test_moe_top2_second_choice_queues_behind_first():
     expect_dropped = 1.0 - kept / (2 * S)
     np.testing.assert_allclose(float(dropped), expect_dropped, atol=1e-6)
     assert expect_dropped > 0.0        # the capacity squeeze is real
+
+
+def test_moe_dropfree_dense_matches_dispatch():
+    """The drop-free branch (dense all-experts + gate combine) equals
+    the dispatch formulation whenever ample capacity makes the latter
+    drop nothing — same params, same math, different plumbing."""
+    x = _x(9)
+    params = _layer(capacity_factor=16.0).init(
+        jax.random.key(9), x
+    )["params"]
+    via_dispatch = MoEMLP(num_experts=E, mlp_ratio=2,
+                          capacity_factor=16.0).apply({"params": params}, x)
+    via_dense = MoEMLP(num_experts=E, mlp_ratio=2,
+                       drop_tokens=False).apply({"params": params}, x)
+    np.testing.assert_allclose(
+        np.asarray(via_dense), np.asarray(via_dispatch), atol=2e-5
+    )
+    # Param trees are identical between the modes (init either way).
+    p2 = MoEMLP(num_experts=E, mlp_ratio=2, drop_tokens=False).init(
+        jax.random.key(9), x
+    )["params"]
+    assert jax.tree.structure(params) == jax.tree.structure(p2)
