@@ -62,7 +62,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--global-avg-every", type=int, default=None,
                    help="Gossip-PGA: exact all-reduce every H-th epoch")
     p.add_argument("--compression", default=None,
-                   help="CHOCO-SGD compressed gossip: topk:F | randk:F | sign | none (disables, overriding a saved config)")
+                   help="CHOCO-SGD compressed gossip: topk:F | atopk:F | randk:F | sign | int8 | none (disables, overriding a saved config)")
     p.add_argument("--compression-gamma", type=float, default=None)
     p.add_argument("--augment", action="store_true",
                    help="jitted RandomCrop+Flip train augmentation")

@@ -31,6 +31,7 @@ from distributed_learning_tpu.parallel.compression import (
     approx_top_k,
     random_k,
     scaled_sign,
+    int8_quant,
 )
 
 __all__ = [
@@ -44,6 +45,7 @@ __all__ = [
     "approx_top_k",
     "random_k",
     "scaled_sign",
+    "int8_quant",
     "GradientTrackingEngine",
     "TrackingState",
     "Topology",

@@ -83,7 +83,7 @@ class ExperimentConfig:
     chebyshev: bool = False
     time_varying_p: Optional[float] = None  # erdos_renyi edge prob per epoch
     global_avg_every: Optional[int] = None  # Gossip-PGA period (2105.09080)
-    compression: Optional[str] = None  # CHOCO-SGD spec: topk:F | randk:F | sign
+    compression: Optional[str] = None  # CHOCO spec: topk:F | atopk:F | randk:F | sign | int8
     compression_gamma: float = 0.2
     # misc
     seed: int = 0

@@ -153,3 +153,27 @@ def test_compressor_from_spec_atopk():
     )
     out = comp(v, jax.random.key(0))
     assert 0 < int(jnp.sum(out != 0)) <= 20
+
+
+def test_int8_compressor_contracts_and_choco_converges():
+    """int8 delta quantization: bounded per-entry error and CHOCO reaches
+    consensus through it (the on-device twin of the int8 wire)."""
+    comp = compressor_from_spec("int8")
+    v = jnp.asarray(np.random.default_rng(0).normal(size=512), jnp.float32)
+    q = comp(v, jax.random.key(0))
+    scale = float(jnp.max(jnp.abs(v)) / 127.0)
+    assert float(jnp.max(jnp.abs(q - v))) <= 0.5 * scale + 1e-9
+    # Contraction: quantization error well below the signal.
+    assert float(jnp.sum((q - v) ** 2)) < 0.01 * float(jnp.sum(v ** 2))
+
+    topo = Topology.ring(4)
+    eng = ChocoGossipEngine(topo.metropolis_weights(), comp, gamma=0.8)
+    x0 = jnp.asarray(
+        np.random.default_rng(1).normal(size=(4, 64)), jnp.float32
+    )
+    state, res = eng.run(eng.init(x0), 150)
+    mean = x0.mean(axis=0)
+    np.testing.assert_allclose(
+        np.asarray(state.x), np.tile(mean, (4, 1)), atol=1e-3
+    )
+    assert float(res[-1]) < 1e-3
