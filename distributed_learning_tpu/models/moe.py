@@ -29,7 +29,61 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["MoEMLP", "shard_moe_params", "moe_param_spec"]
+__all__ = [
+    "MoEMLP",
+    "shard_moe_params",
+    "moe_param_spec",
+    "collect_load_balance_loss",
+    "apply_collecting_moe_aux",
+]
+
+
+def apply_collecting_moe_aux(model, params, x, **apply_kwargs):
+    """``model.apply`` with the MoE stat collection open, returning
+    ``(output, aux)`` where ``aux`` is the per-layer-mean load-balance
+    loss or ``None`` for dense models.
+
+    The shared forward for every step builder that regularizes routing:
+    one place owns the ``mutable=["moe_stats"]`` plumbing so the
+    builders cannot drift apart.
+    """
+    out, state = model.apply(
+        {"params": params}, x, mutable=["moe_stats"], **apply_kwargs
+    )
+    return out, collect_load_balance_loss(state)
+
+
+def collect_load_balance_loss(state: Any):
+    """Mean over MoE layers of the sown ``moe_stats/load_balance_loss``.
+
+    ``state`` is the mutable-collection dict returned by
+    ``model.apply(..., mutable=["moe_stats"])``.  A model with several
+    MoE blocks sows one scalar per block under its own module path; the
+    step builders regularize with the MEAN across blocks (the Switch
+    convention — arXiv:2101.03961 reports per-layer aux averaged into
+    one coefficient) so the coefficient's meaning doesn't change with
+    depth.
+
+    Returns ``None`` when the model sowed nothing (a dense model run
+    through an MoE-aware step builder) — a trace-time structural fact,
+    so step builders can skip the aux term entirely under ``jit``.
+    """
+    from collections.abc import Mapping
+
+    col = state.get("moe_stats") if isinstance(state, Mapping) else None
+    if not col:
+        return None
+    leaves = [
+        leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(col)[0]
+        if any(getattr(k, "key", None) == "load_balance_loss" for k in path)
+    ]
+    if not leaves:
+        return None
+    total = leaves[0]
+    for leaf in leaves[1:]:
+        total = total + leaf
+    return total / len(leaves)
 
 
 class MoEMLP(nn.Module):
@@ -87,9 +141,21 @@ class MoEMLP(nn.Module):
         # weight (Switch-style) — renormalizing would make it constant
         # 1.0 and cut the router out of the gradient entirely.
 
+        # Load-balance aux on FIRST choices (Switch eq. 4).  Sown before
+        # the routing-branch split so both branches expose the identical
+        # stat surface — the aux depends only on the router, not on how
+        # tokens are dispatched.
+        f_e = jnp.mean(onehots[0], axis=0)                     # (E,)
+        p_e = jnp.mean(probs, axis=0)                          # (E,)
+        self.sow(
+            "moe_stats", "load_balance_loss",
+            E * jnp.sum(f_e * p_e),
+            reduce_fn=lambda a, b: b,
+        )
+
         if not self.drop_tokens:
             return self._dense_dropfree(
-                x, tokens, onehots, gates, probs, B, T, d, E, S
+                x, tokens, onehots, gates, B, T, d, E, S
             )
 
         # Capacity slots with choice priority: choice j's tokens queue
@@ -112,15 +178,6 @@ class MoEMLP(nn.Module):
         dispatch = sum(dispatches)
         combine_w = sum(
             g[:, None, None] * dsp for g, dsp in zip(gates, dispatches)
-        )
-
-        # Load-balance aux on FIRST choices (Switch eq. 4).
-        f_e = jnp.mean(onehots[0], axis=0)                     # (E,)
-        p_e = jnp.mean(probs, axis=0)                          # (E,)
-        self.sow(
-            "moe_stats", "load_balance_loss",
-            E * jnp.sum(f_e * p_e),
-            reduce_fn=lambda a, b: b,
         )
 
         # Expert buffers: (E, C, d) — the all-to-all XLA inserts when
@@ -155,7 +212,7 @@ class MoEMLP(nn.Module):
         )
         return out.reshape(B, T, d).astype(x.dtype)
 
-    def _dense_dropfree(self, x, tokens, onehots, gates, probs, B, T, d,
+    def _dense_dropfree(self, x, tokens, onehots, gates, B, T, d,
                         E, S):
         """Drop-free path (``drop_tokens=False`` — autoregressive
         decode): run EVERY expert on every token and combine with the

@@ -91,6 +91,7 @@ def make_fsdp_train_step(
     tx: Any,
     *,
     data_axis: str = "data",
+    moe_aux_coef: float = 0.01,
 ) -> Callable[..., Tuple[Any, Any, jax.Array]]:
     """Jitted FSDP step: params, moments, and batch all sharded over
     ``data_axis``; XLA schedules the gather/scatter traffic.
@@ -100,10 +101,17 @@ def make_fsdp_train_step(
     Re-constrains params and optimizer state every call so the ZeRO
     layout survives the update (optimizer moments are param-shaped:
     the same spec function applies leaf-wise).
+
+    If the model sows ``moe_stats/load_balance_loss`` (an MoE MLP —
+    ``models/moe.py``), ``moe_aux_coef`` times the per-layer-mean aux is
+    added to the objective (Switch default 0.01, arXiv:2101.03961 §2.2);
+    dense models pay nothing.
     """
 
     reject_dropout_model(model)
     import optax
+
+    from distributed_learning_tpu.models.moe import apply_collecting_moe_aux
 
     n = mesh.shape[data_axis]
 
@@ -125,10 +133,13 @@ def make_fsdp_train_step(
         y = jax.lax.with_sharding_constraint(y, data_sharding)
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, x)
-            return optax.softmax_cross_entropy_with_integer_labels(
+            logits, aux = apply_collecting_moe_aux(model, p, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y
             ).mean()
+            if aux is not None:
+                loss = loss + moe_aux_coef * aux
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads = constrain(grads)  # reduce-scatter, not all-reduce

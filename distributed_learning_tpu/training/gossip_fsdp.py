@@ -70,12 +70,15 @@ def shard_stacked_fsdp(tree: Any, mesh: Mesh, agents_axis: str = "agents",
 
 def _build_gossip_step(mesh, model, tx, mixing_matrix, constrain_params,
                        constrain_opt, data_sharding, *,
-                       agents_axis="agents"):
+                       agents_axis="agents", moe_aux_coef=0.01):
     """Shared jitted step body for every gossip x <inner-axis> variant:
     per-agent vmapped train step (each agent keeps its own optimizer
     state) + one mixing-matrix einsum, with the variant supplying only
     the leaf-placement strategy.  Validates the mixing matrix against
-    the mesh's agent count."""
+    the mesh's agent count.  MoE models' sown load-balance aux joins
+    each agent's objective at ``moe_aux_coef`` (Switch default 0.01)."""
+    from distributed_learning_tpu.models.moe import apply_collecting_moe_aux
+
     reject_dropout_model(model)
     import optax
 
@@ -95,10 +98,13 @@ def _build_gossip_step(mesh, model, tx, mixing_matrix, constrain_params,
 
         def agent_train(p, o, xa, ya):
             def loss_fn(p):
-                logits = model.apply({"params": p}, xa)
-                return optax.softmax_cross_entropy_with_integer_labels(
+                logits, aux = apply_collecting_moe_aux(model, p, xa)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
                     logits, ya
                 ).mean()
+                if aux is not None:
+                    loss = loss + moe_aux_coef * aux
+                return loss
 
             l, g = jax.value_and_grad(loss_fn)(p)
             updates, o = tx.update(g, o, p)
@@ -136,6 +142,7 @@ def make_gossip_fsdp_step(
     *,
     agents_axis: str = "agents",
     data_axis: str = "data",
+    moe_aux_coef: float = 0.01,
 ) -> Callable[..., Tuple[Any, Any, jax.Array]]:
     """Build ``step(params, opt_state, x, y) -> (params, opt_state,
     mean_loss)`` on an ``(agents, data)`` mesh.
@@ -169,6 +176,7 @@ def make_gossip_fsdp_step(
         constrain_opt=lambda opt, params: constrain(opt),
         data_sharding=NamedSharding(mesh, P(agents_axis, data_axis)),
         agents_axis=agents_axis,
+        moe_aux_coef=moe_aux_coef,
     )
 
 
@@ -197,6 +205,7 @@ def make_gossip_tp_step(
     *,
     agents_axis: str = "agents",
     model_axis: str = "model",
+    moe_aux_coef: float = 0.01,
 ) -> Callable[..., Tuple[Any, Any, jax.Array]]:
     """Gossip x TENSOR parallelism: ``(agents, model)`` mesh.
 
@@ -260,6 +269,7 @@ def make_gossip_tp_step(
         constrain_opt=constrain_opt,
         data_sharding=NamedSharding(mesh, P(agents_axis)),
         agents_axis=agents_axis,
+        moe_aux_coef=moe_aux_coef,
     )
 
 

@@ -43,6 +43,7 @@ def make_gossip_lm_step(
     agents_axis: str = "agents",
     seq_axis: str = "seq",
     self_weight: float | None = None,
+    moe_aux_coef: float = 0.01,
 ) -> Callable[..., Tuple[Any, Any, jax.Array]]:
     """Build the jitted 2D train step.
 
@@ -65,6 +66,9 @@ def make_gossip_lm_step(
     caller (the shift crosses shard boundaries, so it must happen on the
     global array).
     """
+    from distributed_learning_tpu.models.moe import (
+        apply_collecting_moe_aux,
+    )
     from distributed_learning_tpu.training.fsdp import (
         reject_dropout_model,
     )
@@ -85,12 +89,18 @@ def make_gossip_lm_step(
         y = y_tok[0]
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, x)
+            logits, aux = apply_collecting_moe_aux(model, p, x)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             # Sum locally; normalize by the GLOBAL token count so the
             # psum'd gradient is the gradient of the global mean.
             n_total = y.size * lax.axis_size(seq_axis)
-            return jnp.sum(ce) / n_total
+            loss = jnp.sum(ce) / n_total
+            if aux is not None:
+                # Each seq shard routed only its local tokens; dividing
+                # by the axis size makes the psum'd term the coefficient
+                # times the MEAN aux across shards.
+                loss = loss + moe_aux_coef * aux / lax.axis_size(seq_axis)
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(p)
         # One agent's seq-replicas each saw a different token shard: sum

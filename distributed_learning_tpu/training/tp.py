@@ -113,6 +113,7 @@ def make_tp_train_step(
     *,
     data_axis: str = "data",
     model_axis: str = "model",
+    moe_aux_coef: float = 0.01,
 ) -> Callable[..., Tuple[Any, Any, jax.Array]]:
     """Jitted DP x TP step: batch over ``data_axis``, weights over
     ``model_axis``, all collectives inserted by the XLA partitioner.
@@ -122,8 +123,15 @@ def make_tp_train_step(
     by the data-axis size).  Params may come from
     :func:`shard_transformer_params`; the step re-constrains them every
     call so the layout survives the optimizer update.
+
+    An MoE model's sown ``moe_stats/load_balance_loss`` joins the
+    objective scaled by ``moe_aux_coef`` (Switch default 0.01); dense
+    models are unaffected.
     """
 
+    from distributed_learning_tpu.models.moe import (
+        apply_collecting_moe_aux,
+    )
     from distributed_learning_tpu.training.fsdp import (
         reject_dropout_model,
     )
@@ -185,10 +193,13 @@ def make_tp_train_step(
         y = jax.lax.with_sharding_constraint(y_tok, data_sharding)
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, x)
-            return optax.softmax_cross_entropy_with_integer_labels(
+            logits, aux = apply_collecting_moe_aux(model, p, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y
             ).mean()
+            if aux is not None:
+                loss = loss + moe_aux_coef * aux
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)

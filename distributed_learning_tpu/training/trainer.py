@@ -303,6 +303,7 @@ class GossipTrainer:
         remat: bool = False,
         donate_state: bool = True,
         eval_batch_size: int = 1024,
+        moe_aux_coef: float = 0.01,
     ):
         self.eval_batch_size = int(eval_batch_size)
         self.node_names = list(node_names)
@@ -339,6 +340,10 @@ class GossipTrainer:
         self.augment_pad_value = augment_pad_value
         self.remat = bool(remat)
         self.donate_state = bool(donate_state)
+        # MoE router balancing: coefficient on the sown
+        # moe_stats/load_balance_loss (Switch default 0.01,
+        # arXiv:2101.03961 §2.2).  No-op for dense models.
+        self.moe_aux_coef = float(moe_aux_coef)
 
         # Mixing matrix: MasterNode's `weights` topology dict, a Topology
         # (-> Metropolis), an explicit matrix, or None (isolated nodes).
@@ -508,10 +513,15 @@ class GossipTrainer:
         return Xs, ys
 
     def _build_jitted(self):
+        from distributed_learning_tpu.models.moe import (
+            collect_load_balance_loss,
+        )
+
         model, tx, loss_fn = self.model, self.tx, self.loss_fn
         metric_fn = self.metric_fn
         n = len(self.node_names)
         has_dropout = self.dropout
+        moe_aux_coef = self.moe_aux_coef
 
         def init_node(rng, x0):
             variables = model.init(rng, x0, train=False)
@@ -536,16 +546,20 @@ class GossipTrainer:
                 variables = {"params": p}
                 if batch_stats is not None:
                     variables["batch_stats"] = batch_stats
-                mutable = ["batch_stats"] if batch_stats is not None else False
-                out = model.apply(
+                mutable = ["moe_stats"] + (
+                    ["batch_stats"] if batch_stats is not None else []
+                )
+                logits, mut = model.apply(
                     variables,
                     x,
                     train=True,
                     rngs={"dropout": rng} if has_dropout else {},
                     mutable=mutable,
                 )
-                logits, mut = out if mutable else (out, {})
                 loss = loss_fn(logits, y)
+                aux = collect_load_balance_loss(mut)
+                if aux is not None:
+                    loss = loss + moe_aux_coef * aux
                 acc = metric_fn(logits, y)
                 return loss, (mut.get("batch_stats", None), acc)
 
