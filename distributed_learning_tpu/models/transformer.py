@@ -178,6 +178,14 @@ class _Attention(nn.Module):
         and each query row ``t`` attends to cached positions
         ``<= i + t`` (inside ``window`` if set) — masking by position
         instead of slicing keeps every shape static for jit.
+
+        Stepping past ``cache_len`` poisons the output with NaN: the
+        clamped ``dynamic_update_slice`` would otherwise land the write
+        on the last slot while the position counter keeps advancing —
+        silently wrong attention.  ``generate()`` never reaches this;
+        the guard is for direct ``apply`` users driving the cache
+        themselves (the index is a traced value, so a Python raise
+        cannot see it under jit).
         """
         B, T, _, Dh = q.shape
         Hkv = k.shape[2]  # under GQA the cache holds only the kv heads
@@ -227,6 +235,10 @@ class _Attention(nn.Module):
         out = jnp.einsum(
             "bhgqk,bkhd->bqhgd", p.astype(cv.value.dtype), cv.value
         ).reshape(B, T, Hkv * g, Dh)
+        # Overflow guard (see docstring): once i + T walks past the
+        # cache the write has been clamped, so every subsequent output
+        # is garbage — make it loud, and keep it loud (idx only grows).
+        out = jnp.where(i + T > L, jnp.nan, out)
         return self._out_proj(out, x.shape[-1])
 
 

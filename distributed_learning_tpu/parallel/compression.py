@@ -178,13 +178,21 @@ def scaled_sign() -> Compressor:
 def int8_quant() -> Compressor:
     """Symmetric int8 quantization: round(v/s)*s with s = max|v|/127 —
     1 byte/entry + one scale, the on-device counterpart of the comm
-    backend's ``int8_wire`` (``comm/tensor_codec.py``).  Contractive:
-    per-entry error <= s/2, so ||Q(v)-v||^2 <= d s^2/4 =
-    d max|v|^2/(4*127^2) <= (d/64516) ||v||^2 — delta >= 1 - d/64516 for
-    d < 64516, and in practice far better since ||v||^2 concentrates
-    well above max|v|^2 for dense deltas.  Simulates the wire exactly:
-    the value AFTER compression is what both sender and receivers apply
-    to their estimates, matching the hat-consistency rule."""
+    backend's ``int8_wire`` (``comm/tensor_codec.py``).
+
+    Contractivity caveat: the worst-case bound (per-entry error <= s/2,
+    so ||Q(v)-v||^2 <= d s^2/4 <= (d/64516) ||v||^2, i.e.
+    delta >= 1 - d/64516) is only non-vacuous for d < 64516 — for
+    model-sized flattened deltas it guarantees nothing (adversarial
+    vectors with many entries near s/2 defeat it), so CHOCO's
+    delta-contraction assumption rests on the empirical concentration
+    of ||v||^2 well above max|v|^2 for dense gradient-like deltas.
+    Measure with :func:`compressor_delta` on representative deltas, or
+    compose with top-k for very large d if the measured delta is poor.
+
+    Simulates the wire exactly: the value AFTER compression is what
+    both sender and receivers apply to their estimates, matching the
+    hat-consistency rule."""
 
     def compress(v: jax.Array, key: jax.Array) -> jax.Array:
         flat = v.ravel()

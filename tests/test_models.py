@@ -516,3 +516,35 @@ def test_transformer_moe_decode_matches_dropfree_forward():
         nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
         seq = jnp.concatenate([seq, nxt[:, None]], 1)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(seq[:, 5:]))
+
+
+def test_transformer_decode_past_cache_is_loud():
+    """Direct-apply decode users who step past max_len get NaN, not
+    silently wrong attention: the clamped cache write (last slot) with a
+    still-advancing position counter is unrecoverable, so the output is
+    poisoned rather than plausible (generate() refuses earlier; this
+    guards the public dec.apply path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=32, num_layers=1, num_heads=2,
+                          head_dim=8, max_len=8)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, 32, size=(1, 8)), jnp.int32)
+    params = model.init(jax.random.key(3), prompt)["params"]
+    dec = model.clone(decode=True)
+
+    # Prefill exactly fills the cache: still healthy.
+    logits, state = dec.apply({"params": params}, prompt, mutable=["cache"])
+    assert np.isfinite(np.asarray(logits)).all()
+
+    # One step beyond the cache: loud, and stays loud.
+    nxt = jnp.zeros((1, 1), jnp.int32)
+    for _ in range(2):
+        logits, state = dec.apply(
+            {"params": params, **state}, nxt, mutable=["cache"]
+        )
+        assert np.isnan(np.asarray(logits)).all()
