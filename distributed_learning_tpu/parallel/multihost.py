@@ -23,7 +23,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["initialize", "hybrid_agent_mesh", "process_local_agents"]
+__all__ = [
+    "initialize",
+    "hybrid_agent_mesh",
+    "order_devices_for_ring",
+    "process_local_agents",
+]
 
 
 def initialize(
@@ -52,25 +57,38 @@ def initialize(
     initialize._done = True
 
 
-def hybrid_agent_mesh(
-    n_agents: Optional[int] = None, *, axis_name: str = "agents"
-) -> Mesh:
-    """One-axis agent mesh over the global device set, ordered so adjacent
-    agents are physically adjacent.
+def order_devices_for_ring(devices: Sequence) -> list:
+    """Sort devices by (process, slice, device id) so that a ring
+    topology laid over the order crosses DCN only at process/slice
+    boundaries — every other ring edge is an ICI hop.
 
-    Devices are sorted by (process, slice, device id): a ring topology's
-    neighbor exchange then crosses DCN only at process/slice boundaries —
-    every other edge is an ICI hop.  With ``n_agents`` unset, every global
-    device hosts one agent.
+    Pure ordering logic, separated from :func:`hybrid_agent_mesh` so
+    multi-slice layouts are testable without pod hardware (the tests
+    feed stand-in device objects carrying the three attributes).
+    ``slice_index`` may be absent or ``None`` on non-pod backends; both
+    collapse to slice 0.
     """
-    devices = sorted(
-        jax.devices(),
+    return sorted(
+        devices,
         key=lambda d: (
             d.process_index,
             getattr(d, "slice_index", 0) or 0,
             d.id,
         ),
     )
+
+
+def hybrid_agent_mesh(
+    n_agents: Optional[int] = None, *, axis_name: str = "agents"
+) -> Mesh:
+    """One-axis agent mesh over the global device set, ordered so adjacent
+    agents are physically adjacent.
+
+    Devices are sorted by (process, slice, device id) — see
+    :func:`order_devices_for_ring`.  With ``n_agents`` unset, every global
+    device hosts one agent.
+    """
+    devices = order_devices_for_ring(jax.devices())
     n = n_agents or len(devices)
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")

@@ -157,3 +157,102 @@ def test_two_process_initialize_and_local_agents():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"OK-MH {pid}" in out
+
+
+# --------------------------------------------------------------------- #
+# Multi-slice mesh ordering: pod layouts are out of reach here, but the
+# ordering logic that keeps ring traffic on ICI is pure — drive it with
+# stand-in device objects carrying (process_index, slice_index, id).
+# --------------------------------------------------------------------- #
+
+import jax
+import numpy as np
+
+
+class _FakeDev:
+    def __init__(self, process_index, slice_index, id):
+        self.process_index = process_index
+        self.slice_index = slice_index
+        self.id = id
+
+    def __repr__(self):
+        return f"p{self.process_index}s{self.slice_index}d{self.id}"
+
+
+def _cross_slice_ring_edges(order):
+    """Count closed-ring edges whose endpoints live on different slices
+    (the DCN hops a gossip ring pays per round)."""
+    n = len(order)
+    key = lambda d: (d.process_index, getattr(d, "slice_index", 0) or 0)
+    return sum(1 for i in range(n) if key(order[i]) != key(order[(i + 1) % n]))
+
+
+def _assert_slices_contiguous(order):
+    key = lambda d: (d.process_index, getattr(d, "slice_index", 0) or 0)
+    seen, prev = set(), None
+    for d in order:
+        k = key(d)
+        if k != prev:
+            assert k not in seen, f"slice {k} split apart in {order}"
+            seen.add(k)
+            prev = k
+
+
+def test_ring_order_2x4_slices_stay_contiguous():
+    """2 slices x 4 devices, presented shuffled: each slice's devices
+    must end up contiguous, so the closed agent ring pays exactly
+    n_slices DCN hops (the minimum) instead of up to n_devices."""
+    from distributed_learning_tpu.parallel.multihost import (
+        order_devices_for_ring,
+    )
+
+    devs = [_FakeDev(p, p, p * 4 + i) for p in range(2) for i in range(4)]
+    rng = np.random.default_rng(0)
+    shuffled = [devs[i] for i in rng.permutation(len(devs))]
+    order = order_devices_for_ring(shuffled)
+    _assert_slices_contiguous(order)
+    assert _cross_slice_ring_edges(order) == 2
+    # Within a slice, device-id order (the ICI-adjacent order).
+    assert [d.id for d in order] == list(range(8))
+
+
+def test_ring_order_4x2_slices_stay_contiguous():
+    from distributed_learning_tpu.parallel.multihost import (
+        order_devices_for_ring,
+    )
+
+    devs = [_FakeDev(p, p, p * 2 + i) for p in range(4) for i in range(2)]
+    rng = np.random.default_rng(1)
+    shuffled = [devs[i] for i in rng.permutation(len(devs))]
+    order = order_devices_for_ring(shuffled)
+    _assert_slices_contiguous(order)
+    assert _cross_slice_ring_edges(order) == 4
+
+
+def test_ring_order_multiprocess_single_slice_groups_by_process():
+    """megascale-less multi-host (e.g. CPU two-process tests): slice_index
+    is None everywhere; grouping must fall back to process boundaries."""
+    from distributed_learning_tpu.parallel.multihost import (
+        order_devices_for_ring,
+    )
+
+    devs = [_FakeDev(p, None, p * 4 + i) for p in range(2) for i in range(4)]
+    rng = np.random.default_rng(2)
+    shuffled = [devs[i] for i in rng.permutation(len(devs))]
+    order = order_devices_for_ring(shuffled)
+    _assert_slices_contiguous(order)
+    assert _cross_slice_ring_edges(order) == 2
+
+
+def test_hybrid_agent_mesh_uses_ring_order():
+    """On the virtual 8-CPU backend the mesh must be the ordered device
+    list (one process, one slice -> plain id order)."""
+    from distributed_learning_tpu.parallel.multihost import (
+        hybrid_agent_mesh,
+        order_devices_for_ring,
+    )
+
+    mesh = hybrid_agent_mesh()
+    expect = order_devices_for_ring(jax.devices())
+    assert list(np.asarray(mesh.devices).ravel()) == expect
+    assert mesh.axis_names == ("agents",)
