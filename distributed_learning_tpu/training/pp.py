@@ -51,6 +51,7 @@ def make_pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     *,
     stage_axis: str = "stage",
+    param_specs: Any = None,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build ``apply(stage_params, microbatches) -> outputs``.
 
@@ -61,6 +62,15 @@ def make_pipeline_apply(
     ``(M, mb, ...)`` (replicated — each microbatch is small by
     construction, that is the point of microbatching).  Returns the
     ``(M, mb, ...)`` outputs of the full stack.
+
+    ``param_specs`` composes the pipeline with tensor parallelism on a
+    2D ``(stage, model)`` mesh: a pytree of ``PartitionSpec`` matching
+    ``stage_params`` (leading dim ``stage_axis``, plus each leaf's TP
+    axis), with ``stage_fn`` written megatron-style against the model
+    axis — partial products exit through a plain ``lax.psum``; the
+    shard_map transpose rules supply the Megatron f/g conjugates
+    automatically (see the note in ``training/tp.py``).  ``None`` keeps
+    the 1D behavior (every leaf ``P(stage_axis)``).
     """
     S = mesh.shape[stage_axis]
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
@@ -110,17 +120,21 @@ def make_pipeline_apply(
 
     @jax.jit
     def _apply(stage_params, microbatches):
+        specs = (
+            param_specs if param_specs is not None
+            else jax.tree.map(lambda _: pspec, stage_params)
+        )
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(pspec, P()),
+            in_specs=(specs, P()),
             out_specs=P(),
         )
         stage_params = jax.tree.map(
-            lambda a: jax.lax.with_sharding_constraint(
-                a, NamedSharding(mesh, pspec)
+            lambda a, s: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, s)
             ),
-            stage_params,
+            stage_params, specs,
         )
         return sharded(stage_params, microbatches)
 
@@ -133,6 +147,7 @@ def make_1f1b_train_step(
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
     *,
     stage_axis: str = "stage",
+    param_specs: Any = None,
 ) -> Callable[[Any, jax.Array, jax.Array], tuple]:
     """Build ``step(stage_params, microbatches, labels) -> (grads, loss)``
     under the 1F1B schedule.
@@ -152,6 +167,14 @@ def make_1f1b_train_step(
     cotangents hop ``s -> s-1``, both via ``lax.ppermute``; ticks total
     ``M + 2S - 2``.  A stage's backward recomputes its forward under
     ``jax.vjp`` from the stashed input, so the stash holds inputs only.
+
+    ``param_specs`` (a pytree of ``PartitionSpec`` matching
+    ``stage_params``) composes 1F1B with tensor parallelism on a
+    ``(stage, model)`` mesh exactly as in :func:`make_pipeline_apply`;
+    the returned grads carry the same specs.  A megatron ``stage_fn``
+    needs nothing beyond its ``lax.psum`` exit — its vjp hands back an
+    already-reduced activation cotangent for the stage-to-stage hop via
+    the automatic entry-cast transpose.
     """
     S = mesh.shape[stage_axis]
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
@@ -246,17 +269,21 @@ def make_1f1b_train_step(
 
     @jax.jit
     def step(stage_params, microbatches, labels):
+        specs = (
+            param_specs if param_specs is not None
+            else jax.tree.map(lambda _: pspec, stage_params)
+        )
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(pspec, P(), P()),
-            out_specs=(pspec, P()),
+            in_specs=(specs, P(), P()),
+            out_specs=(specs, P()),
         )
         stage_params = jax.tree.map(
-            lambda a: jax.lax.with_sharding_constraint(
-                a, NamedSharding(mesh, pspec)
+            lambda a, s: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, s)
             ),
-            stage_params,
+            stage_params, specs,
         )
         return sharded(stage_params, microbatches, labels)
 

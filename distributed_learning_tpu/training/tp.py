@@ -39,6 +39,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["transformer_tp_rules", "shard_transformer_params",
            "make_tp_train_step"]
 
+# NOTE on hand-written (shard_map) megatron regions: no explicit
+# Megatron f/g conjugate operators (arXiv:1909.08053 §3) are needed
+# here.  Under shard_map's varying-manual-axes tracking, a raw
+# ``lax.psum(partial, model_axis)`` at a region's exit transposes to the
+# identity broadcast, and the implicit invariant->varying cast at the
+# region's entry transposes to the cotangent ``psum`` — exactly the
+# f/g pair, inserted automatically.  Hand-rolling them double-counts:
+# an extra entry-psum scales every upstream gradient by the TP width
+# per pipeline stage (caught by tests/test_pp_tp.py's oracle check
+# during development).  Write the region with plain ``lax.psum`` and
+# let the transpose rules do the rest.
+
 
 def transformer_tp_rules(path: tuple, leaf, model_axis: str) -> P:
     """PartitionSpec for one TransformerLM parameter.

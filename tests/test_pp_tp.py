@@ -1,0 +1,170 @@
+"""Pipeline x tensor parallelism (training/pp.py + tp.tp_input_boundary):
+a (stage, model) 2D mesh where each pipeline stage is itself a
+megatron-split MLP — column-parallel up projection, row-parallel down
+projection, one psum per stage — pinned to the unsharded-stack
+exact-gradient oracle exactly like tests/test_pp.py pins the 1D case.
+
+This closes the last composition of the parallelism matrix: pp rides
+with tp the way dp x sp (spmd_lm), gossip x fsdp/tp, and dp x ep
+already compose.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_learning_tpu.training.pp import (
+    make_1f1b_train_step,
+    make_pipeline_apply,
+)
+
+S, NTP = 4, 2        # pipeline stages x tensor-parallel width
+D, H = 16, 32        # activation width, MLP hidden
+M, MB = 6, 4         # microbatches x microbatch size
+
+PARAM_SPECS = {
+    "w1": P("stage", None, "model"),   # column-parallel up
+    "b1": P("stage", "model"),         # bias lives on the split dim
+    "w2": P("stage", "model", None),   # row-parallel down
+}
+
+
+def _mesh():
+    return Mesh(
+        np.array(jax.devices()[: S * NTP]).reshape(S, NTP),
+        ("stage", "model"),
+    )
+
+
+def _params(seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "w1": jnp.asarray(
+            rng.normal(size=(S, D, H)).astype(np.float32) / np.sqrt(D)
+        ),
+        "b1": jnp.asarray(rng.normal(size=(S, H)).astype(np.float32) * 0.1),
+        "w2": jnp.asarray(
+            rng.normal(size=(S, H, D)).astype(np.float32) / np.sqrt(H)
+        ),
+    }
+
+
+def _stage_fn_tp(p, act):
+    """One megatron MLP stage (each model shard holds H/NTP hidden
+    columns).  Plain ``lax.psum`` at the exit is the whole story:
+    shard_map's varying-axes tracking transposes it to the identity and
+    the region entry to the cotangent psum — the Megatron f/g pair,
+    automatic (see the note in training/tp.py)."""
+    h = jnp.tanh(act @ p["w1"] + p["b1"])
+    return lax.psum(h @ p["w2"], "model")
+
+
+def _stage_ref(p, act):
+    return jnp.tanh(act @ p["w1"] + p["b1"]) @ p["w2"]
+
+
+def _reference(params, x):
+    out, _ = jax.lax.scan(lambda a, p: (_stage_ref(p, a), None), x, params)
+    return out
+
+
+def _loss_fn(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def _ref_loss(params, x, y):
+    out = jax.vmap(lambda mb: _reference(params, mb))(x)
+    return jnp.mean(jax.vmap(_loss_fn)(out, y))
+
+
+def _make_xy(seed, m=M):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(m, MB, D)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(m, MB, D)).astype(np.float32))
+    return x, y
+
+
+def test_pp_tp_forward_matches_unsharded_stack():
+    mesh = _mesh()
+    params = _params(0)
+    x, _ = _make_xy(1)
+    apply = make_pipeline_apply(
+        mesh, _stage_fn_tp, param_specs=PARAM_SPECS
+    )
+    with mesh:
+        got = apply(params, x)
+    expect = jax.vmap(lambda mb: _reference(params, mb))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               atol=2e-5)
+
+
+def test_pp_tp_1f1b_grads_and_loss_match_unsharded():
+    """2D-sharded 1F1B == jax.grad through the unsharded stack: each
+    stage's vjp hands back a fully-reduced activation cotangent (the
+    automatic entry-cast transpose) before the stage-to-stage
+    ppermute."""
+    mesh = _mesh()
+    params = _params(2)
+    x, y = _make_xy(3, m=12)  # M > 2S-1 exercises stash slot reuse
+
+    step = make_1f1b_train_step(
+        mesh, _stage_fn_tp, _loss_fn, param_specs=PARAM_SPECS
+    )
+    with mesh:
+        grads, loss = step(params, x, y)
+
+    np.testing.assert_allclose(float(loss), float(_ref_loss(params, x, y)),
+                               atol=1e-6)
+    ref_grads = jax.grad(_ref_loss)(params, x, y)
+    for k in grads:
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]), atol=2e-5,
+            err_msg=k,
+        )
+
+
+def test_pp_tp_autodiff_through_gpipe_matches():
+    """jax.grad THROUGH the 2D pipeline forward (GPipe autodiff path)
+    equals the oracle too — this is the check that catches any
+    double-reduction at the TP region boundaries (a hand-rolled extra
+    entry-psum scales stage s's grads by NTP^(S-1-s))."""
+    mesh = _mesh()
+    params = _params(6)
+    x, y = _make_xy(7)
+    apply = make_pipeline_apply(
+        mesh, _stage_fn_tp, param_specs=PARAM_SPECS
+    )
+
+    def loss_pp(p):
+        with mesh:
+            out = apply(p, x)
+        return jnp.mean(jax.vmap(_loss_fn)(out, y))
+
+    gp = jax.grad(loss_pp)(params)
+    rp = jax.grad(lambda p: _ref_loss(p, x, y))(params)
+    for k in gp:
+        np.testing.assert_allclose(
+            np.asarray(gp[k]), np.asarray(rp[k]), atol=2e-5, err_msg=k
+        )
+
+
+def test_pp_tp_trains_with_optax():
+    import optax
+
+    mesh = _mesh()
+    params = _params(4)
+    x, y = _make_xy(5)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = make_1f1b_train_step(
+        mesh, _stage_fn_tp, _loss_fn, param_specs=PARAM_SPECS
+    )
+    with mesh:
+        _, l0 = step(params, x, y)
+        for _ in range(8):
+            grads, loss = step(params, x, y)
+            updates, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+    assert float(loss) < float(l0)
