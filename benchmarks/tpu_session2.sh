@@ -1,20 +1,24 @@
 #!/usr/bin/env bash
-# Round-3 recovery session: the measurements still pending after the
-# first session's tunnel wedge, highest-value first so a short healthy
-# window still captures the top of the list.  Serialized (the tunneled
-# chip is single-process); every stage runs under `timeout` so one wedge
-# cannot eat the window.
+# TPU measurement session: every record still pending after the tunnel
+# wedges of rounds 2-3, highest-value first so a short healthy window
+# still captures the top of the list.  Serialized (the tunneled chip is
+# single-process); every stage runs under `timeout` so one wedge cannot
+# eat the window.
 #
 #   bash benchmarks/tpu_session2.sh [outdir]
 #
 # Stages:
-#   0. 60s liveness probe (tiny jit) — abort early on a dead tunnel
-#   1. flash-attention TFLOP/s, fwd + bwd (validates the Pallas kernels'
-#      first on-chip compile after the layout fix)
-#   2. WRN profile ablations (+ a profiler trace with top-ops summary)
-#   3. WRN accuracy stage (synthetic stand-in unless DLT_CIFAR_DIR)
-#   4. compression rounds/bytes at the TPU-sized dim (incl. atopk)
-#   5. publish everything captured into BASELINE.json
+#   0.  60s liveness probe (tiny jit) — abort early on a dead tunnel
+#   0b. bench.py — the HEADLINE number (driver-parity record; bench.py
+#       has its own probe, provisional bank, and deadline so a wedge
+#       mid-stage still leaves a record in the capture)
+#   1.  flash-attention TFLOP/s, fwd + bwd, incl. the upstream
+#       pallas-ops rival at the same shapes (the >= upstream bar)
+#   2.  WRN profile ablations (+ a profiler trace with top-ops summary)
+#   2c. LM training throughput (full vs flash) + decode (MHA vs GQA)
+#   3.  WRN accuracy stage (synthetic stand-in unless DLT_CIFAR_DIR)
+#   4.  compression rounds/bytes at the TPU-sized dim (incl. atopk)
+#   5.  publish everything captured into BASELINE.json
 set -uo pipefail
 cd "$(dirname "$0")/.." || exit 1
 OUT="${1:-benchmarks/results}"
@@ -29,8 +33,15 @@ if ! timeout 60 python -u -c \
   exit 3
 fi
 
-echo "== stage 1: flash attention fwd+bwd TFLOP/s" >&2
-BENCH_OUT="$CAPTURE" timeout 1800 python -m benchmarks.run_attention_only \
+echo "== stage 0b: headline gossip-SGD throughput (bench.py)" >&2
+timeout 3900 python -u bench.py > "$OUT/bench_$STAMP.out" \
+  2>"$OUT/bench_$STAMP.err" || echo "stage 0b rc=$?" >&2
+tail -1 "$OUT/bench_$STAMP.out" >> "$CAPTURE" 2>/dev/null || true
+
+echo "== stage 1: flash attention fwd+bwd TFLOP/s (+ upstream rival)" >&2
+# 3600s: the rival pass adds up to 12 compile+measure runs at 8k/32k on
+# top of the original sweep, and the 131k points are minutes each.
+BENCH_OUT="$CAPTURE" timeout 3600 python -m benchmarks.run_attention_only \
   2>"$OUT/attention_$STAMP.err" || echo "stage 1 rc=$?" >&2
 
 echo "== stage 2: WRN profile ablations" >&2

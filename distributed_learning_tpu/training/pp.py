@@ -46,6 +46,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_pipeline_apply", "make_1f1b_train_step"]
 
+
+def _check_param_specs(param_specs: Any, stage_axis: str) -> None:
+    """Every spec must lead with the stage axis.  A leaf spec that omits
+    it would hand each device the FULL stacked array, so ``a[0]`` picks
+    stage 0's parameters on every stage — shapes all match and the
+    forward silently computes garbage."""
+    for path, spec in jax.tree_util.tree_leaves_with_path(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    ):
+        if len(spec) == 0 or spec[0] != stage_axis:
+            raise ValueError(
+                f"param_specs at {jax.tree_util.keystr(path)} is {spec!r}: "
+                f"every spec must put {stage_axis!r} on the leading "
+                "(stacked-stage) dim, or each device would silently run "
+                "stage 0's parameters"
+            )
+
 def make_pipeline_apply(
     mesh: Mesh,
     stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -74,6 +91,8 @@ def make_pipeline_apply(
     """
     S = mesh.shape[stage_axis]
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    if param_specs is not None:
+        _check_param_specs(param_specs, stage_axis)
 
     def _check_stages(stage_params):
         for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
@@ -179,6 +198,8 @@ def make_1f1b_train_step(
     S = mesh.shape[stage_axis]
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    if param_specs is not None:
+        _check_param_specs(param_specs, stage_axis)
 
     def local(stage_params, mbs, labels):
         p = jax.tree.map(lambda a: a[0], stage_params)  # this device's stage

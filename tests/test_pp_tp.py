@@ -168,3 +168,21 @@ def test_pp_tp_trains_with_optax():
             updates, opt = tx.update(grads, opt, params)
             params = optax.apply_updates(params, updates)
     assert float(loss) < float(l0)
+
+
+def test_pp_param_specs_must_lead_with_stage_axis():
+    """A spec that forgets the leading stage dim would hand every device
+    the full stacked array and silently run stage 0's params everywhere;
+    both builders refuse it up front."""
+    import pytest
+
+    mesh = _mesh()
+    with pytest.raises(ValueError, match="leading"):
+        make_pipeline_apply(
+            mesh, _stage_fn_tp, param_specs={"w1": P(None, "model")}
+        )
+    with pytest.raises(ValueError, match="leading"):
+        make_1f1b_train_step(
+            mesh, _stage_fn_tp, _loss_fn,
+            param_specs={"w1": P(None, "model")}
+        )
