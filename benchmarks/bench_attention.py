@@ -41,14 +41,18 @@ def _time(fn, iters: int) -> float:
 
 
 def _qkv(T: int, B: int, H: int, D: int, *, heads_second: bool):
-    """bf16 inputs from the shared seed; (B, T, H, D) for our kernel,
-    (B, H, T, D) for upstream."""
+    """bf16 inputs from the shared seed — drawn once in our (B, T, H, D)
+    layout and TRANSPOSED for upstream's (B, H, T, D), so both kernels
+    see the same values and an output cross-check stays meaningful."""
     rng = np.random.default_rng(0)
-    shape = (B, H, T, D) if heads_second else (B, T, H, D)
     mk = lambda: jnp.asarray(
-        rng.normal(size=shape).astype(np.float32), dtype=jnp.bfloat16
+        rng.normal(size=(B, T, H, D)).astype(np.float32),
+        dtype=jnp.bfloat16,
     )
-    return mk(), mk(), mk()
+    q, k, v = mk(), mk(), mk()
+    if heads_second:
+        q, k, v = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    return q, k, v
 
 
 def _measure(
@@ -97,8 +101,8 @@ def _measure_upstream(T: int, *, B=1, H=8, D=128, iters=8, backward=False,
     """Same-shape rival: ``jax.experimental.pallas.ops.tpu.flash_attention``
     (the upstream TPU kernel shipped in site-packages), measured with the
     identical FLOPs accounting.  Its layout is (B, H, T, D) and its
-    default sm_scale is 1.0, so inputs are transposed and the 1/sqrt(D)
-    scale passed explicitly to compute the same function ours does."""
+    default sm_scale is 1.0, so the shared inputs are transposed and the
+    1/sqrt(D) scale passed explicitly — same values, same function."""
     from jax.experimental.pallas.ops.tpu import flash_attention as upstream
 
     q, k, v = _qkv(T, B, H, D, heads_second=True)

@@ -36,7 +36,16 @@ fi
 echo "== stage 0b: headline gossip-SGD throughput (bench.py)" >&2
 timeout 3900 python -u bench.py > "$OUT/bench_$STAMP.out" \
   2>"$OUT/bench_$STAMP.err" || echo "stage 0b rc=$?" >&2
-tail -1 "$OUT/bench_$STAMP.out" >> "$CAPTURE" 2>/dev/null || true
+# Append only a well-formed record: a garbage last line (e.g. a print
+# cut mid-write by the timeout) would make stage 5's publish abort and
+# lose the WHOLE session's records.
+python - "$OUT/bench_$STAMP.out" >> "$CAPTURE" <<'EOF' || true
+import json, sys
+lines = [l for l in open(sys.argv[1]) if l.strip()]
+rec = json.loads(lines[-1])
+assert "metric" in rec
+print(json.dumps(rec))
+EOF
 
 echo "== stage 1: flash attention fwd+bwd TFLOP/s (+ upstream rival)" >&2
 # 3600s: the rival pass adds up to 12 compile+measure runs at 8k/32k on

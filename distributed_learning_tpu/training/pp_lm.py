@@ -1,0 +1,198 @@
+"""Pipeline-parallel training for the flagship TransformerLM.
+
+``training/pp.py`` pipelines any uniform stage function; this module
+binds it to the real model: the LM's block stack (homogeneous by
+construction — ``models/transformer.py:377-384`` instantiates the same
+``_Block`` config ``num_layers`` times) is split into ``n_stages``
+groups whose stacked parameters shard over a ``stage`` mesh axis, while
+the thin non-uniform ends — token/position embeddings in front, final
+LayerNorm + vocab head behind — run replicated outside the pipeline and
+get their gradients through ordinary autodiff around it.  One
+``jax.grad`` therefore covers all three parameter groups: the pipeline
+interior backward is the reverse GPipe schedule (scan + ppermute
+transposes), and the ends are plain reverse-mode.
+
+Layout: per-stage params are the (S, L/S, ...) restacking of the
+``_Block_i`` subtrees; ``split_lm_params``/``merge_lm_params`` convert
+between this and the flax tree so a pipelined training run can be
+checkpointed or evaluated with the ordinary ``model.apply``/
+``generate`` paths at any point.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from distributed_learning_tpu.models.transformer import _Block
+from distributed_learning_tpu.training.fsdp import reject_dropout_model
+from distributed_learning_tpu.training.pp import make_pipeline_apply
+
+__all__ = [
+    "split_lm_params",
+    "merge_lm_params",
+    "stage_layout",
+    "make_lm_pipeline_train_step",
+]
+
+
+def stage_layout(stacked, n_stages: int):
+    """(L, ...) block stack -> (S, L/S, ...) per-stage groups — the
+    layout the train step and ``tx.init`` both consume."""
+    def fold(leaf):
+        L = leaf.shape[0]
+        if L % n_stages:
+            raise ValueError(
+                f"{L} blocks do not divide into {n_stages} stages"
+            )
+        return leaf.reshape((n_stages, L // n_stages) + leaf.shape[1:])
+
+    return jax.tree.map(fold, stacked)
+
+
+def _outer_keys(params) -> list:
+    return [k for k in params if not k.startswith("_Block_")]
+
+
+def split_lm_params(model, params) -> Tuple[Any, Any]:
+    """Flax param tree -> (outer, stacked).
+
+    ``outer`` holds the embeddings and the final LayerNorm + head;
+    ``stacked`` is the block subtrees restacked with a leading
+    ``num_layers`` axis (reshaped to (S, L/S, ...) by the step builder).
+    """
+    blocks = [params[f"_Block_{i}"] for i in range(model.num_layers)]
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *blocks)
+    outer = {k: params[k] for k in _outer_keys(params)}
+    return outer, stacked
+
+
+def merge_lm_params(model, outer, stacked, *, n_stages: int | None = None) -> Any:
+    """Inverse of :func:`split_lm_params`: rebuild the flax tree (e.g.
+    to checkpoint, evaluate, or ``generate`` mid-training).
+
+    Pass ``n_stages`` when ``stacked`` is in the step's (S, L/S, ...)
+    :func:`stage_layout`; omit it for ``split_lm_params``' (L, ...)
+    form.  Explicit because the two layouts are indistinguishable from
+    shapes alone whenever S == L.
+    """
+    L = model.num_layers
+
+    def unstack(leaf):
+        if n_stages is not None:
+            return leaf.reshape((L,) + leaf.shape[2:])
+        return leaf
+
+    flat = jax.tree.map(unstack, stacked)
+    params = dict(outer)
+    for i in range(model.num_layers):
+        params[f"_Block_{i}"] = jax.tree.map(lambda a: a[i], flat)
+    return params
+
+
+def make_lm_pipeline_train_step(
+    mesh: Mesh,
+    model,
+    tx: Any,
+    *,
+    stage_axis: str = "stage",
+) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
+    """Build ``step(outer, stages, opt_state, tok_mb, y_mb) ->
+    (outer, stages, opt_state, loss)``.
+
+    ``tok_mb``/``y_mb`` are (M, mb, T) int32 microbatched tokens /
+    pre-shifted targets (replicated; each microbatch is small by
+    construction).  ``stages`` is ``stage_layout(split_lm_params(...)[1],
+    S)`` — the (S, L/S, ...) form; ``opt_state = tx.init((outer,
+    stages))`` on that same layout.
+
+    Constraints: ``attn_impl`` must be "full" or "flash" (the
+    sequence-parallel impls bind their own mesh axis), ``dropout_rate``
+    0 (rng-less builder), and ``mlp`` "dense" — an MoE block's sown
+    load-balance aux cannot escape the pipeline's scan, so training an
+    MoE LM through this path would silently skip router balancing;
+    refuse instead (use spmd_lm / tp / fsdp for MoE).
+    """
+    import optax
+
+    reject_dropout_model(model)
+    if model.attn_impl not in ("full", "flash"):
+        raise ValueError(
+            f"pipeline stages need a mesh-free attention impl (full|flash),"
+            f" not {model.attn_impl!r}"
+        )
+    if model.mlp != "dense":
+        raise ValueError(
+            "mlp='moe' cannot train through the pipeline: the router's "
+            "load-balance aux is sown inside the stage scan where no "
+            "mutable collection can collect it, so balancing would be "
+            "silently skipped; use the spmd_lm/tp/fsdp paths for MoE"
+        )
+    S = mesh.shape[stage_axis]
+    L = model.num_layers
+    if L % S:
+        raise ValueError(f"num_layers {L} must divide into {S} stages")
+    L_per = L // S
+    use_rope = model.pos_emb == "rope"
+    d_model = model.num_heads * model.head_dim
+
+    block = _Block(
+        model.num_heads, model.head_dim, model.mlp_ratio,
+        model.attn_impl, model.seq_axis, model.dtype,
+        model.mlp, model.num_experts, model.moe_top_k,
+        model.attn_window, False, model.max_len,
+        use_rope, model.num_kv_heads, 0.0,
+    )
+
+    def stage_fn(p, act):
+        positions = jnp.arange(act.shape[-2]) if use_rope else None
+
+        def one(a, bp):
+            return block.apply({"params": bp}, a, positions), None
+
+        act, _ = lax.scan(one, act, p)
+        return act
+
+    pipe = make_pipeline_apply(mesh, stage_fn, stage_axis=stage_axis)
+
+    tok_embed = nn.Embed(model.vocab_size, d_model, dtype=model.dtype)
+    pos_embed = nn.Embed(model.max_len, d_model, dtype=model.dtype)
+    final_ln = nn.LayerNorm(dtype=model.dtype)
+    head = nn.Dense(model.vocab_size, dtype=model.dtype)
+
+    def loss_fn(outer, stages, tok_mb, y_mb):
+        T = tok_mb.shape[-1]
+        if not use_rope and T > model.max_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_len {model.max_len}"
+            )
+        x = tok_embed.apply({"params": outer["Embed_0"]}, tok_mb)
+        if not use_rope:
+            pos = pos_embed.apply(
+                {"params": outer["Embed_1"]}, jnp.arange(T)
+            )
+            x = x + pos[None, None]
+        out = pipe(stages, x)
+        out = final_ln.apply({"params": outer["LayerNorm_0"]}, out)
+        logits = head.apply(
+            {"params": outer["Dense_0"]}, out
+        ).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y_mb
+        ).mean()
+
+    @jax.jit
+    def step(outer, stages, opt_state, tok_mb, y_mb):
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            outer, stages, tok_mb, y_mb
+        )
+        updates, opt_state = tx.update(grads, opt_state, (outer, stages))
+        outer, stages = optax.apply_updates((outer, stages), updates)
+        return outer, stages, opt_state, loss
+
+    return step
