@@ -234,3 +234,16 @@ def test_parallelism_matrix_example():
     for m in re.finditer(r"loss ([\d.]+) -> ([\d.]+)", out):
         assert float(m.group(2)) < float(m.group(1)), out
     assert "parallelism matrix ok" in out
+
+
+def test_lm_pipeline_example():
+    """The pipelined-LM demo: trains through a REAL multi-stage mesh
+    (the script self-forces 8 virtual devices; the assertion pins it)
+    and the merged params generate the progression correctly."""
+    out = _run("lm_pipeline", "--steps", "220", "--gen", "6")
+    assert "over 4 pipeline stages" in out, out
+    m = re.search(r"correct_tokens: (\d+)/(\d+)", out)
+    assert m, out
+    assert int(m.group(1)) == int(m.group(2)) == 6, out
+    loss = float(re.search(r"final loss ([\d.]+)", out).group(1))
+    assert loss < 0.1, out

@@ -388,9 +388,12 @@ def test_moe_aux_rebalances_a_collapsed_router():
         jnp.zeros((d, 4)).at[:, 0].set(v)
     )
 
-    def aux_of(p):
+    @jax.jit
+    def _aux(p):
         _, st = model.apply({"params": p}, tok, mutable=["moe_stats"])
-        return float(collect_load_balance_loss(st))
+        return collect_load_balance_loss(st)
+
+    aux_of = lambda p: float(_aux(p))
 
     aux_start = aux_of(params)
     assert aux_start > 3.0  # collapsed: aux ~= E = 4
