@@ -163,11 +163,13 @@ def make_pipeline_apply(
 def make_1f1b_train_step(
     mesh: Mesh,
     stage_fn: Callable[[Any, jax.Array], jax.Array],
-    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array] | None = None,
     *,
     stage_axis: str = "stage",
     param_specs: Any = None,
-) -> Callable[[Any, jax.Array, jax.Array], tuple]:
+    head_fn: Callable[[Any, jax.Array, jax.Array], jax.Array] | None = None,
+    collect_input_grads: bool = False,
+) -> Callable[..., tuple]:
     """Build ``step(stage_params, microbatches, labels) -> (grads, loss)``
     under the 1F1B schedule.
 
@@ -194,14 +196,34 @@ def make_1f1b_train_step(
     needs nothing beyond its ``lax.psum`` exit — its vjp hands back an
     already-reduced activation cotangent for the stage-to-stage hop via
     the automatic entry-cast transpose.
+
+    Two extensions let a whole model (not just a uniform stack) train
+    under the schedule — ``training/pp_lm.py`` uses both:
+
+    * ``head_fn(head_params, last_stage_out, labels_mb) -> scalar``
+      replaces ``loss_fn`` with a TRAINABLE loss head (e.g. final
+      LayerNorm + vocab projection).  The step then takes ``head_params``
+      (replicated) after ``stage_params`` and returns their gradient
+      after the stage grads: the last stage seeds each microbatch's
+      backward through the head's vjp and accumulates the head grads on
+      the same tick.  Exactly one of ``loss_fn``/``head_fn`` must be
+      given.
+    * ``collect_input_grads=True`` also returns ``d_microbatches`` — at
+      stage 0 each microbatch's backward produces the cotangent of the
+      PIPELINE INPUT, which the caller chains into whatever produced the
+      microbatches (an embedding's vjp) so front parameters train too.
+
+    Returns ``(grads[, head_grads][, d_microbatches], loss)``.
     """
+    if (loss_fn is None) == (head_fn is None):
+        raise ValueError("exactly one of loss_fn / head_fn is required")
     S = mesh.shape[stage_axis]
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_bwd = [(i, (i - 1) % S) for i in range(S)]
     if param_specs is not None:
         _check_param_specs(param_specs, stage_axis)
 
-    def local(stage_params, mbs, labels):
+    def local(stage_params, head_params, mbs, labels):
         p = jax.tree.map(lambda a: a[0], stage_params)  # this device's stage
         idx = lax.axis_index(stage_axis)
         is_last = idx == S - 1
@@ -222,11 +244,19 @@ def make_1f1b_train_step(
             zero_act,                                   # bwd cotangent in
             var(jnp.zeros((B,) + mbs.shape[1:], mbs.dtype)),  # input stash
             jax.tree.map(lambda a: var(jnp.zeros_like(a)), p),  # grad acc
+            # head-grad accumulator (zeros tree even when unused: the
+            # scan carry must be static in structure)
+            jax.tree.map(lambda a: var(jnp.zeros_like(a)), head_params),
+            # input-cotangent buffer (1-slot dummy when not collected)
+            var(jnp.zeros(
+                ((M if collect_input_grads else 1),) + mbs.shape[1:],
+                mbs.dtype,
+            )),
             var(jnp.zeros((), jnp.float32)),            # loss acc
         )
 
         def tick(carry, t):
-            fwd_in, bwd_in, stash, gacc, lacc = carry
+            fwd_in, bwd_in, stash, gacc, hacc, dmbs, lacc = carry
             mf = t - idx
             mb = t - (2 * S - 2 - idx)
             fwd_valid = (mf >= 0) & (mf < M)
@@ -259,8 +289,29 @@ def make_1f1b_train_step(
             y_mb = lax.dynamic_index_in_dim(
                 labels, jnp.clip(mb, 0, M - 1), axis=0, keepdims=False
             )
-            lval, lpb = jax.vjp(lambda o: loss_fn(o, y_mb), out)
-            (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
+            if head_fn is not None:
+                # pvary the (replicated) head params BEFORE the vjp: the
+                # implicit invariant->varying cast would otherwise sit
+                # inside it and transpose to a psum over stages — dhp
+                # would then silently contain every OTHER stage's
+                # nonsense head-gradient (their `out` is not the final
+                # activation) before the is_last mask can drop it.
+                hp_var = jax.tree.map(
+                    lambda a: lax.pvary(a, stage_axis), head_params
+                )
+                lval, lpb = jax.vjp(
+                    lambda hp, o: head_fn(hp, o, y_mb), hp_var, out
+                )
+                dhp, seed = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
+                hacc = jax.tree.map(
+                    lambda h, d: h + jnp.where(
+                        bwd_valid & is_last, d, jnp.zeros_like(d)
+                    ),
+                    hacc, dhp,
+                )
+            else:
+                lval, lpb = jax.vjp(lambda o: loss_fn(o, y_mb), out)
+                (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
             cot = jnp.where(bwd_valid,
                             jnp.where(is_last, seed, bwd_in),
                             jnp.zeros_like(bwd_in))
@@ -269,6 +320,20 @@ def make_1f1b_train_step(
                 lambda g, d: g + jnp.where(bwd_valid, d, jnp.zeros_like(d)),
                 gacc, dp,
             )
+            if collect_input_grads:
+                # At stage 0 the backward's dact IS the cotangent of the
+                # pipeline input for microbatch mb; bank it (masked
+                # read-modify-write, like the stash).
+                slot_i = jnp.clip(mb, 0, M - 1)
+                old_i = lax.dynamic_index_in_dim(
+                    dmbs, slot_i, keepdims=False
+                )
+                dmbs = lax.dynamic_update_index_in_dim(
+                    dmbs,
+                    jnp.where((idx == 0) & bwd_valid,
+                              dact.astype(dmbs.dtype), old_i),
+                    slot_i, axis=0,
+                )
             lacc = lacc + jnp.where(
                 bwd_valid & is_last, lval.astype(jnp.float32) / M, 0.0
             )
@@ -278,27 +343,43 @@ def make_1f1b_train_step(
                 stage_axis, perm_fwd,
             )
             bwd_next = lax.ppermute(dact, stage_axis, perm_bwd)
-            return (fwd_next, bwd_next, stash, gacc, lacc), None
+            return (fwd_next, bwd_next, stash, gacc, hacc, dmbs, lacc), None
 
         ticks = jnp.arange(M + 2 * S - 2)
-        (_, _, _, gacc, lacc), _ = lax.scan(tick, carry0, ticks)
+        (_, _, _, gacc, hacc, dmbs, lacc), _ = lax.scan(tick, carry0, ticks)
         grads = jax.tree.map(lambda g: g[None], gacc)  # (1, ...) local slice
         loss = lax.psum(lacc, stage_axis)  # only the last stage contributes
-        return grads, loss
+        outs = [grads]
+        if head_fn is not None:
+            # Only the last stage accumulated; the psum both totals and
+            # makes the tree replicated for the P() out-spec.
+            outs.append(jax.tree.map(
+                lambda h: lax.psum(h, stage_axis), hacc
+            ))
+        if collect_input_grads:
+            outs.append(lax.psum(dmbs, stage_axis))  # stage 0 only
+        outs.append(loss)
+        return tuple(outs)
 
     pspec = P(stage_axis)
 
     @jax.jit
-    def step(stage_params, microbatches, labels):
+    def _step(stage_params, head_params, microbatches, labels):
         specs = (
             param_specs if param_specs is not None
             else jax.tree.map(lambda _: pspec, stage_params)
         )
+        out_specs = [specs]
+        if head_fn is not None:
+            out_specs.append(jax.tree.map(lambda _: P(), head_params))
+        if collect_input_grads:
+            out_specs.append(P())
+        out_specs.append(P())
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(specs, P(), P()),
-            out_specs=(specs, P()),
+            in_specs=(specs, P(), P(), P()),
+            out_specs=tuple(out_specs),
         )
         stage_params = jax.tree.map(
             lambda a, s: jax.lax.with_sharding_constraint(
@@ -306,6 +387,13 @@ def make_1f1b_train_step(
             ),
             stage_params, specs,
         )
-        return sharded(stage_params, microbatches, labels)
+        return sharded(stage_params, head_params, microbatches, labels)
+
+    if head_fn is not None:
+        return _step
+
+    @jax.jit  # re-jitted so callers keep .lower()/.compile() access
+    def step(stage_params, microbatches, labels):
+        return _step(stage_params, {}, microbatches, labels)
 
     return step

@@ -6,11 +6,20 @@ construction — ``models/transformer.py:377-384`` instantiates the same
 ``_Block`` config ``num_layers`` times) is split into ``n_stages``
 groups whose stacked parameters shard over a ``stage`` mesh axis, while
 the thin non-uniform ends — token/position embeddings in front, final
-LayerNorm + vocab head behind — run replicated outside the pipeline and
-get their gradients through ordinary autodiff around it.  One
-``jax.grad`` therefore covers all three parameter groups: the pipeline
-interior backward is the reverse GPipe schedule (scan + ppermute
-transposes), and the ends are plain reverse-mode.
+LayerNorm + vocab head behind — run replicated outside the pipeline.
+
+Two schedules, same gradients (pinned per param group by
+``tests/test_pp_lm.py``):
+
+* :func:`make_lm_pipeline_train_step` — GPipe: one ``jax.grad`` wraps
+  embed -> pipeline -> head, so the ends get ordinary reverse-mode and
+  the interior backward is the reverse pipeline (activation memory
+  grows with the microbatch count);
+* :func:`make_lm_1f1b_train_step` — 1F1B (O(stages) activation stash):
+  the head rides the generic schedule's ``head_fn`` (its grads
+  accumulate at the last stage, one microbatch per tick) and the
+  embeddings chain through ``collect_input_grads`` — stage 0's input
+  cotangents feed an explicit embedding vjp.
 
 Layout: per-stage params are the (S, L/S, ...) restacking of the
 ``_Block_i`` subtrees; ``split_lm_params``/``merge_lm_params`` convert
@@ -26,18 +35,23 @@ from typing import Any, Callable, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import optax
 from jax import lax
 from jax.sharding import Mesh
 
 from distributed_learning_tpu.models.transformer import _Block
 from distributed_learning_tpu.training.fsdp import reject_dropout_model
-from distributed_learning_tpu.training.pp import make_pipeline_apply
+from distributed_learning_tpu.training.pp import (
+    make_1f1b_train_step,
+    make_pipeline_apply,
+)
 
 __all__ = [
     "split_lm_params",
     "merge_lm_params",
     "stage_layout",
     "make_lm_pipeline_train_step",
+    "make_lm_1f1b_train_step",
 ]
 
 
@@ -95,6 +109,94 @@ def merge_lm_params(model, outer, stacked, *, n_stages: int | None = None) -> An
     return params
 
 
+class _LMParts:
+    """Everything both step builders share: validation, the per-stage
+    block scan, and the embed/head closures over the model config."""
+
+    def __init__(self, mesh: Mesh, model, stage_axis: str):
+        reject_dropout_model(model)
+        if model.attn_impl not in ("full", "flash"):
+            raise ValueError(
+                f"pipeline stages need a mesh-free attention impl "
+                f"(full|flash), not {model.attn_impl!r}"
+            )
+        if model.mlp != "dense":
+            raise ValueError(
+                "mlp='moe' cannot train through the pipeline: the router's "
+                "load-balance aux is sown inside the stage scan where no "
+                "mutable collection can collect it, so balancing would be "
+                "silently skipped; use the spmd_lm/tp/fsdp paths for MoE"
+            )
+        self.S = mesh.shape[stage_axis]
+        L = model.num_layers
+        if L % self.S:
+            raise ValueError(
+                f"num_layers {L} must divide into {self.S} stages"
+            )
+        self.model = model
+        self.use_rope = model.pos_emb == "rope"
+        d_model = model.num_heads * model.head_dim
+
+        block = _Block(
+            model.num_heads, model.head_dim, model.mlp_ratio,
+            model.attn_impl, model.seq_axis, model.dtype,
+            model.mlp, model.num_experts, model.moe_top_k,
+            model.attn_window, False, model.max_len,
+            self.use_rope, model.num_kv_heads, 0.0,
+        )
+        use_rope = self.use_rope
+
+        def stage_fn(p, act):
+            positions = jnp.arange(act.shape[-2]) if use_rope else None
+
+            def one(a, bp):
+                return block.apply({"params": bp}, a, positions), None
+
+            act, _ = lax.scan(one, act, p)
+            return act
+
+        self.stage_fn = stage_fn
+        self.tok_embed = nn.Embed(model.vocab_size, d_model,
+                                  dtype=model.dtype)
+        self.pos_embed = nn.Embed(model.max_len, d_model,
+                                  dtype=model.dtype)
+        self.final_ln = nn.LayerNorm(dtype=model.dtype)
+        self.head = nn.Dense(model.vocab_size, dtype=model.dtype)
+
+    def embed(self, embed_params, tok_mb):
+        T = tok_mb.shape[-1]
+        if not self.use_rope and T > self.model.max_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_len {self.model.max_len}"
+            )
+        x = self.tok_embed.apply(
+            {"params": embed_params["Embed_0"]}, tok_mb
+        )
+        if not self.use_rope:
+            pos = self.pos_embed.apply(
+                {"params": embed_params["Embed_1"]}, jnp.arange(T)
+            )
+            x = x + pos[None, None]
+        return x
+
+    def head_loss(self, head_params, out, y_mb):
+        out = self.final_ln.apply(
+            {"params": head_params["LayerNorm_0"]}, out
+        )
+        logits = self.head.apply(
+            {"params": head_params["Dense_0"]}, out
+        ).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y_mb
+        ).mean()
+
+    @staticmethod
+    def split_outer(outer):
+        ep = {k: v for k, v in outer.items() if k.startswith("Embed")}
+        hp = {k: v for k, v in outer.items() if not k.startswith("Embed")}
+        return ep, hp
+
+
 def make_lm_pipeline_train_step(
     mesh: Mesh,
     model,
@@ -103,7 +205,9 @@ def make_lm_pipeline_train_step(
     stage_axis: str = "stage",
 ) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
     """Build ``step(outer, stages, opt_state, tok_mb, y_mb) ->
-    (outer, stages, opt_state, loss)``.
+    (outer, stages, opt_state, loss)`` — GPipe schedule, backward by
+    autodiff (activation memory O(microbatches); the 1F1B variant below
+    holds O(stages)).
 
     ``tok_mb``/``y_mb`` are (M, mb, T) int32 microbatched tokens /
     pre-shifted targets (replicated; each microbatch is small by
@@ -118,79 +222,61 @@ def make_lm_pipeline_train_step(
     MoE LM through this path would silently skip router balancing;
     refuse instead (use spmd_lm / tp / fsdp for MoE).
     """
-    import optax
 
-    reject_dropout_model(model)
-    if model.attn_impl not in ("full", "flash"):
-        raise ValueError(
-            f"pipeline stages need a mesh-free attention impl (full|flash),"
-            f" not {model.attn_impl!r}"
-        )
-    if model.mlp != "dense":
-        raise ValueError(
-            "mlp='moe' cannot train through the pipeline: the router's "
-            "load-balance aux is sown inside the stage scan where no "
-            "mutable collection can collect it, so balancing would be "
-            "silently skipped; use the spmd_lm/tp/fsdp paths for MoE"
-        )
-    S = mesh.shape[stage_axis]
-    L = model.num_layers
-    if L % S:
-        raise ValueError(f"num_layers {L} must divide into {S} stages")
-    L_per = L // S
-    use_rope = model.pos_emb == "rope"
-    d_model = model.num_heads * model.head_dim
-
-    block = _Block(
-        model.num_heads, model.head_dim, model.mlp_ratio,
-        model.attn_impl, model.seq_axis, model.dtype,
-        model.mlp, model.num_experts, model.moe_top_k,
-        model.attn_window, False, model.max_len,
-        use_rope, model.num_kv_heads, 0.0,
-    )
-
-    def stage_fn(p, act):
-        positions = jnp.arange(act.shape[-2]) if use_rope else None
-
-        def one(a, bp):
-            return block.apply({"params": bp}, a, positions), None
-
-        act, _ = lax.scan(one, act, p)
-        return act
-
-    pipe = make_pipeline_apply(mesh, stage_fn, stage_axis=stage_axis)
-
-    tok_embed = nn.Embed(model.vocab_size, d_model, dtype=model.dtype)
-    pos_embed = nn.Embed(model.max_len, d_model, dtype=model.dtype)
-    final_ln = nn.LayerNorm(dtype=model.dtype)
-    head = nn.Dense(model.vocab_size, dtype=model.dtype)
+    parts = _LMParts(mesh, model, stage_axis)
+    pipe = make_pipeline_apply(mesh, parts.stage_fn, stage_axis=stage_axis)
 
     def loss_fn(outer, stages, tok_mb, y_mb):
-        T = tok_mb.shape[-1]
-        if not use_rope and T > model.max_len:
-            raise ValueError(
-                f"sequence length {T} exceeds max_len {model.max_len}"
-            )
-        x = tok_embed.apply({"params": outer["Embed_0"]}, tok_mb)
-        if not use_rope:
-            pos = pos_embed.apply(
-                {"params": outer["Embed_1"]}, jnp.arange(T)
-            )
-            x = x + pos[None, None]
-        out = pipe(stages, x)
-        out = final_ln.apply({"params": outer["LayerNorm_0"]}, out)
-        logits = head.apply(
-            {"params": outer["Dense_0"]}, out
-        ).astype(jnp.float32)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, y_mb
-        ).mean()
+        ep, hp = parts.split_outer(outer)
+        out = pipe(stages, parts.embed(ep, tok_mb))
+        return parts.head_loss(hp, out, y_mb)
 
     @jax.jit
     def step(outer, stages, opt_state, tok_mb, y_mb):
         loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
             outer, stages, tok_mb, y_mb
         )
+        updates, opt_state = tx.update(grads, opt_state, (outer, stages))
+        outer, stages = optax.apply_updates((outer, stages), updates)
+        return outer, stages, opt_state, loss
+
+    return step
+
+
+def make_lm_1f1b_train_step(
+    mesh: Mesh,
+    model,
+    tx: Any,
+    *,
+    stage_axis: str = "stage",
+) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
+    """The same contract as :func:`make_lm_pipeline_train_step`, under
+    the hand-scheduled 1F1B pipeline (O(stages) activation stash).
+
+    Composition of the generic schedule's two extensions: the final
+    LayerNorm + vocab head ride as the 1F1B ``head_fn`` (their grads
+    accumulate at the last stage, one microbatch per tick), and the
+    embeddings chain through ``collect_input_grads`` — stage 0's input
+    cotangents feed the embedding's vjp, so every parameter group
+    trains, with the same per-group gradients as the GPipe/autodiff
+    builder (pinned by tests/test_pp_lm.py).
+    """
+
+    parts = _LMParts(mesh, model, stage_axis)
+    inner = make_1f1b_train_step(
+        mesh, parts.stage_fn,
+        head_fn=parts.head_loss,
+        collect_input_grads=True,
+        stage_axis=stage_axis,
+    )
+
+    @jax.jit
+    def step(outer, stages, opt_state, tok_mb, y_mb):
+        ep, hp = parts.split_outer(outer)
+        x, emb_vjp = jax.vjp(lambda e: parts.embed(e, tok_mb), ep)
+        g_stages, g_head, d_x, loss = inner(stages, hp, x, y_mb)
+        (g_embed,) = emb_vjp(d_x)
+        grads = ({**g_embed, **g_head}, g_stages)
         updates, opt_state = tx.update(grads, opt_state, (outer, stages))
         outer, stages = optax.apply_updates((outer, stages), updates)
         return outer, stages, opt_state, loss

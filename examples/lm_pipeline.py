@@ -39,6 +39,7 @@ from distributed_learning_tpu.models.transformer import (
     generate,
 )
 from distributed_learning_tpu.training.pp_lm import (
+    make_lm_1f1b_train_step,
     make_lm_pipeline_train_step,
     merge_lm_params,
     split_lm_params,
@@ -52,6 +53,10 @@ def main() -> None:
     ap.add_argument("--stages", type=int, default=4)
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--gen", type=int, default=6)
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b"),
+                    default="gpipe",
+                    help="gpipe: autodiff backward, O(M) activations; "
+                         "1f1b: hand-scheduled, O(S) activation stash")
     args = ap.parse_args()
     V = args.vocab
     S = min(args.stages, len(jax.devices()))
@@ -74,16 +79,18 @@ def main() -> None:
 
     tx = optax.adam(5e-3)
     opt = tx.init((outer, stages))
-    step = make_lm_pipeline_train_step(mesh, model, tx)
+    build = (make_lm_1f1b_train_step if args.schedule == "1f1b"
+             else make_lm_pipeline_train_step)
+    step = build(mesh, model, tx)
 
     loss = None
     with mesh:
         for i in range(args.steps):
             outer, stages, opt, loss = step(outer, stages, opt, x, y)
     print(
-        f"trained {args.steps} steps over {S} pipeline stages "
-        f"({model.num_layers} blocks, {model.num_layers // S} per stage), "
-        f"final loss {float(loss):.4f}" if loss is not None else
+        f"trained {args.steps} steps ({args.schedule}) over {S} pipeline "
+        f"stages ({model.num_layers} blocks, {model.num_layers // S} per "
+        f"stage), final loss {float(loss):.4f}" if loss is not None else
         f"0 training steps ({S} stages); generating from init"
     )
 
