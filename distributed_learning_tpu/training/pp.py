@@ -47,6 +47,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["make_pipeline_apply", "make_1f1b_train_step"]
 
 
+def _manual_axes(stage_axis: str, param_specs: Any) -> frozenset:
+    """The mesh axes the pipeline body handles with explicit collectives:
+    the stage axis plus every axis a param spec shards over (the TP axes
+    whose psums live inside ``stage_fn``).  Any OTHER axis on the mesh
+    stays in GSPMD auto mode — shard the microbatch dim over it and the
+    partitioner runs data-parallel replicas of the whole pipeline,
+    inserting the gradient reductions itself (dp x pp, or dp x pp x tp,
+    from shardings alone)."""
+    axes = {stage_axis}
+    if param_specs is not None:
+        for spec in jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda x: isinstance(x, P)
+        ):
+            for entry in spec:
+                if entry is None:
+                    continue
+                if isinstance(entry, (tuple, list)):
+                    axes.update(entry)
+                else:
+                    axes.add(entry)
+    return frozenset(axes)
+
+
 def _check_param_specs(param_specs: Any, stage_axis: str) -> None:
     """Every spec must lead with the stage axis.  A leaf spec that omits
     it would hand each device the FULL stacked array, so ``a[0]`` picks
@@ -148,6 +171,7 @@ def make_pipeline_apply(
             mesh=mesh,
             in_specs=(specs, P()),
             out_specs=P(),
+            axis_names=_manual_axes(stage_axis, param_specs),
         )
         stage_params = jax.tree.map(
             lambda a, s: jax.lax.with_sharding_constraint(
@@ -290,19 +314,39 @@ def make_1f1b_train_step(
                 labels, jnp.clip(mb, 0, M - 1), axis=0, keepdims=False
             )
             if head_fn is not None:
-                # pvary the (replicated) head params BEFORE the vjp: the
-                # implicit invariant->varying cast would otherwise sit
-                # inside it and transpose to a psum over stages — dhp
-                # would then silently contain every OTHER stage's
-                # nonsense head-gradient (their `out` is not the final
-                # activation) before the is_last mask can drop it.
-                hp_var = jax.tree.map(
-                    lambda a: lax.pvary(a, stage_axis), head_params
+                # Cast the (replicated) head params to stage-varying
+                # BEFORE the vjp: the implicit invariant->varying cast
+                # would otherwise sit inside it and transpose to a psum
+                # over stages — dhp would then silently contain every
+                # OTHER stage's nonsense head-gradient (their `out` is
+                # not the final activation) before the is_last mask can
+                # drop it.  The cond then skips the head fwd+vjp (an
+                # LM's largest matmul) on the S-1 stages whose result
+                # the mask would discard anyway; head_fn must therefore
+                # be collective-free.
+                hp_var = jax.tree.map(var, head_params)
+
+                def _head(ops):
+                    o, y = ops
+                    lv, lpb = jax.vjp(
+                        lambda hp, oo: head_fn(hp, oo, y), hp_var, o
+                    )
+                    dh, sd = lpb(var(jnp.full((), 1.0 / M, lv.dtype)))
+                    return lv.astype(jnp.float32), dh, sd
+
+                def _skip(ops):
+                    o, _ = ops
+                    return (
+                        var(jnp.zeros((), jnp.float32)),
+                        jax.tree.map(
+                            lambda a: var(jnp.zeros_like(a)), hp_var
+                        ),
+                        var(jnp.zeros_like(o)),
+                    )
+
+                lval, dhp, seed = lax.cond(
+                    is_last, _head, _skip, (out, y_mb)
                 )
-                lval, lpb = jax.vjp(
-                    lambda hp, o: head_fn(hp, o, y_mb), hp_var, out
-                )
-                dhp, seed = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
                 hacc = jax.tree.map(
                     lambda h, d: h + jnp.where(
                         bwd_valid & is_last, d, jnp.zeros_like(d)
@@ -380,6 +424,7 @@ def make_1f1b_train_step(
             mesh=mesh,
             in_specs=(specs, P(), P(), P()),
             out_specs=tuple(out_specs),
+            axis_names=_manual_axes(stage_axis, param_specs),
         )
         stage_params = jax.tree.map(
             lambda a, s: jax.lax.with_sharding_constraint(
