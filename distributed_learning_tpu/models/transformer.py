@@ -395,6 +395,8 @@ def generate(
     *,
     key: jax.Array | None = None,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ) -> jax.Array:
     """Autoregressive generation with a KV cache: prefill the prompt in
     one pass, then one jitted single-token step per new token under
@@ -402,10 +404,14 @@ def generate(
 
     ``prompt`` is (B, Tp) int32; returns (B, steps) generated tokens.
     ``temperature=0`` is greedy argmax; otherwise tokens are sampled
-    from ``softmax(logits / temperature)`` (``key`` required).  The
-    decode-mode model reuses the TRAINING parameters unchanged — the
-    cache is a flax ``cache`` collection threaded through the scan, so
-    the whole loop compiles to one program with static shapes.
+    from ``softmax(logits / temperature)`` (``key`` required), with the
+    candidate set optionally truncated FIRST by ``top_k`` (keep the k
+    highest-logit tokens) and/or ``top_p`` (nucleus sampling,
+    arXiv:1904.09751: the smallest set whose cumulative probability
+    reaches p — the top token always survives).  The decode-mode model
+    reuses the TRAINING parameters unchanged — the cache is a flax
+    ``cache`` collection threaded through the scan, so the whole loop
+    compiles to one program with static shapes.
     """
     B, Tp = prompt.shape
     if Tp + steps > model.max_len:
@@ -415,26 +421,58 @@ def generate(
         )
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if (top_k is not None or top_p is not None) and temperature <= 0.0:
+        raise ValueError(
+            "top_k/top_p shape the SAMPLING distribution; greedy decoding "
+            "(temperature=0) ignores them — pass temperature > 0"
+        )
+    if top_k is not None and not 1 <= top_k <= model.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={model.vocab_size}], "
+            f"got {top_k}"
+        )
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     run = _generate_runner(model.clone(decode=True), steps,
-                           float(temperature))
+                           float(temperature),
+                           None if top_k is None else int(top_k),
+                           None if top_p is None else float(top_p))
     return run(params, prompt, key)
 
 
 @functools.lru_cache(maxsize=64)
-def _generate_runner(dec: TransformerLM, steps: int, temperature: float):
+def _generate_runner(dec: TransformerLM, steps: int, temperature: float,
+                     top_k: int | None = None, top_p: float | None = None):
     """The jitted prefill+scan program for one (model, steps,
-    temperature) configuration.  Cached by the module's (frozen,
-    hashable) dataclass identity so repeated :func:`generate` calls with
-    the same settings reuse the compile instead of re-tracing — jit
-    caches by function object, and a closure built inside ``generate``
-    would be fresh every call."""
+    temperature, top_k, top_p) configuration.  Cached by the module's
+    (frozen, hashable) dataclass identity so repeated :func:`generate`
+    calls with the same settings reuse the compile instead of
+    re-tracing — jit caches by function object, and a closure built
+    inside ``generate`` would be fresh every call."""
 
     def pick(logits, k, dtype):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(dtype)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1
-        ).astype(dtype)
+        scaled = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        if top_p is not None:
+            # Nucleus cutoff on the (possibly top_k-truncated) logits:
+            # rank tokens by probability, keep every token whose
+            # cumulative mass BEFORE it is < p (so the top token always
+            # survives), and mask the rest via the kept-set's smallest
+            # logit — all static shapes.
+            srt = jnp.sort(scaled, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # (cum - probs) is the EXCLUSIVE prefix sum: < p keeps every
+            # token whose predecessors haven't reached the nucleus yet,
+            # so n_keep >= 1 always.
+            n_keep = jnp.sum((cum - probs) < top_p, axis=-1, keepdims=True)
+            thresh = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
+            scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
+        return jax.random.categorical(k, scaled, axis=-1).astype(dtype)
 
     @jax.jit
     def _run(params, prompt, key):

@@ -92,8 +92,15 @@ def make_pipeline_apply(
     *,
     stage_axis: str = "stage",
     param_specs: Any = None,
+    remat_stage: bool = False,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build ``apply(stage_params, microbatches) -> outputs``.
+
+    ``remat_stage=True`` wraps the stage in ``jax.checkpoint``: the
+    GPipe autodiff backward then recomputes each stage's internals from
+    its input instead of storing every intermediate per tick — the
+    standard FLOPs-for-HBM trade for deep stages (the 1F1B builder
+    already recomputes from its stash, so it has no such knob).
 
     ``stage_fn(params_for_one_stage, act) -> act`` applies one stage's
     layer group; activations keep one shape throughout (the transformer
@@ -116,6 +123,8 @@ def make_pipeline_apply(
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     if param_specs is not None:
         _check_param_specs(param_specs, stage_axis)
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def _check_stages(stage_params):
         for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):

@@ -203,6 +203,7 @@ def make_lm_pipeline_train_step(
     tx: Any,
     *,
     stage_axis: str = "stage",
+    remat_stage: bool = False,
 ) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
     """Build ``step(outer, stages, opt_state, tok_mb, y_mb) ->
     (outer, stages, opt_state, loss)`` — GPipe schedule, backward by
@@ -224,7 +225,8 @@ def make_lm_pipeline_train_step(
     """
 
     parts = _LMParts(mesh, model, stage_axis)
-    pipe = make_pipeline_apply(mesh, parts.stage_fn, stage_axis=stage_axis)
+    pipe = make_pipeline_apply(mesh, parts.stage_fn, stage_axis=stage_axis,
+                               remat_stage=remat_stage)
 
     def loss_fn(outer, stages, tok_mb, y_mb):
         ep, hp = parts.split_outer(outer)

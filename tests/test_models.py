@@ -548,3 +548,55 @@ def test_transformer_decode_past_cache_is_loud():
             {"params": params, **state}, nxt, mutable=["cache"]
         )
         assert np.isnan(np.asarray(logits)).all()
+
+
+def test_generate_top_k_top_p_sampling():
+    """Truncated sampling: top_k=1 equals greedy for any key; a tiny
+    top_p nucleus also collapses to greedy; full-vocab settings stay
+    reproducible under a fixed key; invalid combos refuse."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from distributed_learning_tpu.models.transformer import (
+        TransformerLM,
+        generate,
+    )
+
+    model = TransformerLM(vocab_size=32, num_layers=1, num_heads=2,
+                          head_dim=8, max_len=32)
+    rng = np.random.default_rng(6)
+    prompt = jnp.asarray(rng.integers(0, 32, size=(2, 5)), jnp.int32)
+    params = model.init(jax.random.key(6), prompt)["params"]
+
+    greedy = generate(model, params, prompt, 6)
+    k1 = generate(model, params, prompt, 6, key=jax.random.key(1),
+                  temperature=1.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+    p_tiny = generate(model, params, prompt, 6, key=jax.random.key(2),
+                      temperature=1.0, top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(p_tiny), np.asarray(greedy))
+
+    # Reproducible and in-vocab with both truncations active.
+    s1 = generate(model, params, prompt, 6, key=jax.random.key(3),
+                  temperature=0.8, top_k=8, top_p=0.9)
+    s2 = generate(model, params, prompt, 6, key=jax.random.key(3),
+                  temperature=0.8, top_k=8, top_p=0.9)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert ((np.asarray(s1) >= 0) & (np.asarray(s1) < 32)).all()
+    # top_p=1.0 must equal plain temperature sampling (no truncation).
+    full = generate(model, params, prompt, 6, key=jax.random.key(4),
+                    temperature=1.0)
+    p_one = generate(model, params, prompt, 6, key=jax.random.key(4),
+                     temperature=1.0, top_p=1.0)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(p_one))
+
+    with pytest.raises(ValueError, match="temperature"):
+        generate(model, params, prompt, 2, top_k=4)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, key=jax.random.key(0),
+                 temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, key=jax.random.key(0),
+                 temperature=1.0, top_k=0)
