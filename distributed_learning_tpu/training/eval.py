@@ -1,0 +1,83 @@
+"""LM evaluation: next-token cross entropy and perplexity.
+
+The classification side evaluates through the trainer's ``metric_fn``
+(accuracy — the reference's only metric, ``logreg_model_titanic.py:27``);
+language models report perplexity.  One jitted scan over batches keeps
+eval device-resident at any corpus size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["lm_cross_entropy", "perplexity"]
+
+
+@functools.lru_cache(maxsize=64)
+def _ce_runner(model, n_chunks: int):
+    """Jitted scan for one (model, chunk-count) configuration — cached
+    by the module's frozen-dataclass identity (the `_generate_runner`
+    pattern) so per-epoch evals reuse the compile instead of
+    re-tracing a fresh closure every call."""
+
+    @jax.jit
+    def run(params, toks):
+        def one(carry, batch):
+            logits = model.apply({"params": params}, batch)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], batch[:, 1:]
+            )
+            return carry + jnp.sum(ce), None
+
+        total, _ = jax.lax.scan(one, jnp.zeros((), jnp.float32), toks)
+        return total
+
+    return run
+
+
+def lm_cross_entropy(
+    model,
+    params,
+    tokens: jax.Array,
+    *,
+    batch_size: Optional[int] = None,
+) -> Tuple[float, int]:
+    """Mean next-token cross entropy of ``model`` on ``tokens``.
+
+    ``tokens`` is (N, T) int32; position ``t`` is scored against the
+    model's prediction from positions ``<= t-1`` (the standard shifted
+    objective: T-1 scored positions per sequence).  With ``batch_size``
+    the sequences are processed in jitted scan chunks (N must divide);
+    otherwise one batch.  Returns ``(mean_ce_nats, n_positions)``.
+    """
+    N, T = tokens.shape
+    if T < 2:
+        raise ValueError(f"need sequences of length >= 2, got T={T}")
+    b = N if batch_size is None else int(batch_size)
+    if b < 1:
+        raise ValueError(f"batch_size must be >= 1, got {b}")
+    if N % b:
+        raise ValueError(f"N={N} must divide by batch_size={b}")
+
+    total = _ce_runner(model, N // b)(
+        params, tokens.reshape(N // b, b, T)
+    )
+    return float(total) / (N * (T - 1)), N * (T - 1)
+
+
+def perplexity(
+    model,
+    params,
+    tokens: jax.Array,
+    *,
+    batch_size: Optional[int] = None,
+) -> float:
+    """``exp(mean next-token cross entropy)`` — bounded above by
+    ``vocab_size`` for any calibrated model (uniform logits hit it)."""
+    ce, _ = lm_cross_entropy(model, params, tokens, batch_size=batch_size)
+    return float(jnp.exp(ce))

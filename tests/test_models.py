@@ -600,3 +600,57 @@ def test_generate_top_k_top_p_sampling():
     with pytest.raises(ValueError, match="top_k"):
         generate(model, params, prompt, 2, key=jax.random.key(0),
                  temperature=1.0, top_k=0)
+
+
+def test_lm_perplexity_eval():
+    """Eval helper: batched CE equals the direct computation; a
+    zero-logit (uniform) model's perplexity is exactly vocab_size; a
+    trained model's perplexity drops below it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_learning_tpu.models.transformer import TransformerLM
+    from distributed_learning_tpu.training.eval import (
+        lm_cross_entropy,
+        perplexity,
+    )
+
+    V = 16
+    model = TransformerLM(vocab_size=V, num_layers=1, num_heads=2,
+                          head_dim=8, max_len=16)
+    rng = np.random.default_rng(7)
+    toks = jnp.asarray(rng.integers(0, V, (8, 16)), jnp.int32)
+    params = model.init(jax.random.key(7), toks)["params"]
+
+    ce_all, n = lm_cross_entropy(model, params, toks)
+    ce_b, n2 = lm_cross_entropy(model, params, toks, batch_size=2)
+    assert n == n2 == 8 * 15
+    np.testing.assert_allclose(ce_all, ce_b, rtol=1e-6)
+    logits = model.apply({"params": params}, toks)
+    direct = float(optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], toks[:, 1:]
+    ).mean())
+    np.testing.assert_allclose(ce_all, direct, rtol=1e-6)
+
+    # Uniform model: zero every param that feeds the head -> logits 0.
+    zeroed = jax.tree.map(jnp.zeros_like, params)
+    np.testing.assert_allclose(
+        perplexity(model, zeroed, toks), V, rtol=1e-5
+    )
+
+    # A short training run beats uniform on its own training data.
+    tx = optax.adam(5e-3)
+    opt = tx.init(params)
+    def loss_fn(p):
+        lg = model.apply({"params": p}, toks)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg[:, :-1], toks[:, 1:]
+        ).mean()
+    p = params
+    for _ in range(30):
+        g = jax.grad(loss_fn)(p)
+        up, opt = tx.update(g, opt, p)
+        p = optax.apply_updates(p, up)
+    assert perplexity(model, p, toks) < V
