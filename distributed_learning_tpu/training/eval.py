@@ -19,11 +19,10 @@ __all__ = ["lm_cross_entropy", "perplexity"]
 
 
 @functools.lru_cache(maxsize=64)
-def _ce_runner(model, n_chunks: int):
-    """Jitted scan for one (model, chunk-count) configuration — cached
-    by the module's frozen-dataclass identity (the `_generate_runner`
-    pattern) so per-epoch evals reuse the compile instead of
-    re-tracing a fresh closure every call."""
+def _ce_runner(model):
+    """Jitted scan, cached per model (the `_generate_runner` pattern) so
+    per-epoch evals reuse the compile instead of re-tracing a fresh
+    closure every call; jit itself specializes per input shape."""
 
     @jax.jit
     def run(params, toks):
@@ -64,7 +63,7 @@ def lm_cross_entropy(
     if N % b:
         raise ValueError(f"N={N} must divide by batch_size={b}")
 
-    total = _ce_runner(model, N // b)(
+    total = _ce_runner(model)(
         params, tokens.reshape(N // b, b, T)
     )
     return float(total) / (N * (T - 1)), N * (T - 1)

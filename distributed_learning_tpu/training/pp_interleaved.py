@@ -1,0 +1,395 @@
+"""Interleaved-1F1B pipeline parallelism (virtual pipeline stages).
+
+Megatron-LM's interleaved schedule (arXiv:2104.04473 §2.2): each of the
+``S`` devices hosts ``V`` chunks of the layer stack instead of one, so
+virtual stage ``v`` (of ``S*V``) lives on device ``v mod S`` — the
+pipeline's fill/drain bubble shrinks by ~``V`` because a device starts
+working after ``S`` hops of a (shorter) chunk instead of one hop of its
+whole (taller) stage.  Activations still hop a +1 ring and cotangents a
+-1 ring; the only new machinery is WHICH (chunk, microbatch, direction)
+a device runs at each tick.
+
+That question is answered ahead of time: :func:`build_schedule` runs a
+greedy list scheduler (backward-first — the 1F1B memory policy) over the
+exact dependency graph and emits static per-tick tables; the SPMD
+executor (:func:`make_interleaved_1f1b_train_step`) is a ``lax.scan``
+over those tables — every shape static, every decision a gather.
+
+The same exact-gradient contract as ``training/pp.py``: grads equal the
+unsharded stack's (tests/test_pp_interleaved.py), with ``V = 1``
+reproducing plain 1F1B tick-for-tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["build_schedule", "make_interleaved_1f1b_train_step"]
+
+
+@dataclasses.dataclass(frozen=True)
+class _Schedule:
+    """Static tick tables, all shaped (ticks, S) unless noted.
+
+    ``op``: 0 idle, 1 forward, 2 backward.  ``chunk``: which of the
+    device's V chunks.  ``mb``: microbatch index.  ``recv_f_*`` /
+    ``recv_b_*``: where THIS tick's incoming activation / cotangent
+    message (sent by the neighbor at tick t-1) must be filed —
+    (valid, chunk, slot).  ``slots``: stash depth (max in-flight per
+    chunk, measured on the simulated schedule).
+    """
+
+    op: np.ndarray
+    chunk: np.ndarray
+    mb: np.ndarray
+    recv_f_valid: np.ndarray
+    recv_f_chunk: np.ndarray
+    recv_f_slot: np.ndarray
+    recv_b_valid: np.ndarray
+    recv_b_chunk: np.ndarray
+    recv_b_slot: np.ndarray
+    slots: int
+    ticks: int
+
+
+def build_schedule(S: int, V: int, M: int) -> _Schedule:
+    """Greedy backward-first list schedule for S devices x V chunks x M
+    microbatches.
+
+    Dependencies (virtual stage ``v = c*S + d``):
+
+    * fwd(v, m) needs fwd(v-1, m) completed at an EARLIER tick (the
+      activation hops between ticks); fwd(0, m) is always ready.
+    * bwd(v, m) needs fwd(v, m) (same device, may be the same tick at
+      the LAST virtual stage only — it seeds from the loss) and
+      bwd(v+1, m) at an earlier tick.
+
+    Policy per device per tick: run the ready backward with the
+    smallest (mb, chunk) if any (1F1B drains eagerly to bound the
+    stash), else the ready forward with the smallest (chunk, mb) —
+    chunk-minor forward order is what lets later chunks start before
+    earlier chunks finish every microbatch (the interleave).
+    """
+    SV = S * V
+    fwd_done = -np.ones((SV, M), np.int64)  # tick at which fwd finished
+    bwd_done = -np.ones((SV, M), np.int64)
+    op_rows, chunk_rows, mb_rows = [], [], []
+    t = 0
+    total = 2 * SV * M
+    done = 0
+    max_ticks = 8 * (M + 2 * SV) + 64  # generous safety net
+    while done < total and t < max_ticks:
+        op_r = np.zeros(S, np.int64)
+        ch_r = np.zeros(S, np.int64)
+        mb_r = np.zeros(S, np.int64)
+        for d in range(S):
+            picked = None
+            # Backward first (smallest mb drains the oldest in-flight).
+            for m in range(M):
+                for c in range(V):
+                    v = c * S + d
+                    if bwd_done[v, m] >= 0:
+                        continue
+                    if fwd_done[v, m] < 0:
+                        continue
+                    if v == SV - 1:
+                        # Loss-seeded: needs its OWN fwd at an earlier
+                        # tick (the executor recomputes from the stash,
+                        # so same-tick fwd+bwd fusion is not modeled).
+                        if fwd_done[v, m] >= t:
+                            continue
+                    else:
+                        if bwd_done[v + 1, m] < 0 or bwd_done[v + 1, m] >= t:
+                            continue
+                    picked = (2, c, m)
+                    break
+                if picked:
+                    break
+            if picked is None:
+                for c in range(V):
+                    for m in range(M):
+                        v = c * S + d
+                        if fwd_done[v, m] >= 0:
+                            continue
+                        if v > 0 and (
+                            fwd_done[v - 1, m] < 0 or fwd_done[v - 1, m] >= t
+                        ):
+                            continue
+                        picked = (1, c, m)
+                        break
+                    if picked:
+                        break
+            if picked is not None:
+                o, c, m = picked
+                v = c * S + d
+                op_r[d], ch_r[d], mb_r[d] = o, c, m
+                if o == 1:
+                    fwd_done[v, m] = t
+                else:
+                    bwd_done[v, m] = t
+                done += 1
+        op_rows.append(op_r)
+        chunk_rows.append(ch_r)
+        mb_rows.append(mb_r)
+        t += 1
+    if done < total:
+        raise RuntimeError(
+            f"schedule did not complete: {done}/{total} ops in {t} ticks"
+        )
+
+    op = np.stack(op_rows)
+    chunk = np.stack(chunk_rows)
+    mb = np.stack(mb_rows)
+    ticks = op.shape[0]
+
+    # Buffer depth: the stash holds (fwd done -> bwd pending), the
+    # fwd-in buffer (producer's fwd+1 -> this stage's fwd), the cot-in
+    # buffer (downstream bwd+1 -> this stage's bwd).  All three windows
+    # advance in microbatch order under the bwd-first policy, so a
+    # depth of the max in-flight count makes m % slots collision-free;
+    # take the max over all three lifetimes.
+    slots = 1
+    for v in range(SV):
+        starts = {
+            "stash": fwd_done[v],
+            "fin": (fwd_done[v - 1] + 1) if v > 0 else None,
+            "bin": (bwd_done[v + 1] + 1) if v < SV - 1 else None,
+        }
+        ends = {"stash": bwd_done[v], "fin": fwd_done[v],
+                "bin": bwd_done[v]}
+        for name, st in starts.items():
+            if st is None:
+                continue
+            en = ends[name]
+            for tt in range(ticks):
+                inflight = int(((st <= tt) & (st >= 0)
+                                & ((en > tt) | (en < 0))).sum())
+                slots = max(slots, inflight)
+
+    # A consumable message produced at the final tick would never be
+    # filed; the schedule's structure (the last ops are v=0 backwards /
+    # last-stage forwards, both send-masked) should make this
+    # impossible — assert it rather than assume it.
+    for d in range(S):
+        if op[-1, d] == 1:
+            assert chunk[-1, d] * S + d == SV - 1, (
+                "final-tick forward would lose its activation"
+            )
+        if op[-1, d] == 2:
+            assert chunk[-1, d] * S + d == 0, (
+                "final-tick backward would lose its cotangent"
+            )
+
+    # Receive routing: the message device d-1 SENT at tick t-1 (its fwd
+    # output, unless its virtual stage was the last) arrives at d for
+    # filing at tick t; symmetrically for cotangents from d+1.
+    rfv = np.zeros((ticks, S), bool)
+    rfc = np.zeros((ticks, S), np.int64)
+    rfs = np.zeros((ticks, S), np.int64)
+    rbv = np.zeros((ticks, S), bool)
+    rbc = np.zeros((ticks, S), np.int64)
+    rbs = np.zeros((ticks, S), np.int64)
+    for t_ in range(1, ticks):
+        for d in range(S):
+            src = (d - 1) % S
+            if op[t_ - 1, src] == 1:
+                v_src = chunk[t_ - 1, src] * S + src
+                if v_src < SV - 1 and (v_src + 1) % S == d:
+                    rfv[t_, d] = True
+                    rfc[t_, d] = (v_src + 1) // S
+                    rfs[t_, d] = mb[t_ - 1, src] % slots
+            src_b = (d + 1) % S
+            if op[t_ - 1, src_b] == 2:
+                v_src = chunk[t_ - 1, src_b] * S + src_b
+                if v_src > 0 and (v_src - 1) % S == d:
+                    rbv[t_, d] = True
+                    rbc[t_, d] = (v_src - 1) // S
+                    rbs[t_, d] = mb[t_ - 1, src_b] % slots
+    return _Schedule(op, chunk, mb, rfv, rfc, rfs, rbv, rbc, rbs,
+                     slots, ticks)
+
+
+def make_interleaved_1f1b_train_step(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    n_chunks: int,
+    n_microbatches: int,
+    *,
+    stage_axis: str = "stage",
+) -> Callable[[Any, jax.Array, jax.Array], Tuple[Any, jax.Array]]:
+    """Build ``step(stage_params, microbatches, labels) -> (grads, loss)``
+    under the interleaved schedule.
+
+    ``stage_params`` is a pytree with leading dims ``(S, V, ...)`` — dim
+    0 shards over ``stage_axis``, dim 1 is the device's chunks in
+    virtual-stage order (chunk ``c`` of device ``d`` is virtual stage
+    ``c*S + d``); ``stage_fn(chunk_params, act) -> act`` applies ONE
+    chunk.  ``microbatches``/``labels`` are ``(M, mb, ...)`` replicated
+    with ``M = n_microbatches`` (static: the schedule is precomputed).
+    Gradients come back in the same (S, V, ...) layout; ``loss`` is the
+    mean microbatch loss, exactly as ``make_1f1b_train_step``.
+    """
+    S = mesh.shape[stage_axis]
+    V = int(n_chunks)
+    M = int(n_microbatches)
+    SV = S * V
+    sched = build_schedule(S, V, M)
+    K = sched.slots
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+    # Per-tick table rows become scan inputs (replicated small ints).
+    xs = tuple(
+        jnp.asarray(a) for a in (
+            sched.op, sched.chunk, sched.mb,
+            sched.recv_f_valid, sched.recv_f_chunk, sched.recv_f_slot,
+            sched.recv_b_valid, sched.recv_b_chunk, sched.recv_b_slot,
+        )
+    )
+
+    def local(stage_params, mbs, labels):
+        p = jax.tree.map(lambda a: a[0], stage_params)  # (V, ...) chunks
+        idx = lax.axis_index(stage_axis)
+
+        def var(x):
+            if stage_axis in getattr(jax.typeof(x), "vma", ()):
+                return x
+            return lax.pcast(x, (stage_axis,), to="varying")
+
+        act_shape = mbs.shape[1:]
+        zero_act = var(jnp.zeros(act_shape, mbs.dtype))
+        zbuf = var(jnp.zeros((V * K,) + act_shape, mbs.dtype))
+        carry0 = (
+            zero_act,                                    # incoming act
+            zero_act,                                    # incoming cot
+            zbuf,                                        # input stash
+            zbuf,                                        # fwd-in buffer
+            zbuf,                                        # cot-in buffer
+            jax.tree.map(lambda a: var(jnp.zeros_like(a)), p),  # gacc
+            var(jnp.zeros((), jnp.float32)),             # loss acc
+        )
+
+        def chunk_params(c):
+            return jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                p,
+            )
+
+        def buf_read(buf, c, s):
+            return lax.dynamic_index_in_dim(buf, c * K + s, 0,
+                                            keepdims=False)
+
+        def buf_write(buf, c, s, val):
+            return lax.dynamic_update_index_in_dim(buf, val, c * K + s, 0)
+
+        def tick(carry, x):
+            (op_r, ch_r, mb_r, rfv_r, rfc_r, rfs_r, rbv_r, rbc_r,
+             rbs_r) = x
+            act_in, cot_in, stash, fbuf, bbuf, gacc, lacc = carry
+
+            # 1) File the messages that arrived this tick.
+            fbuf = jnp.where(
+                rfv_r[idx],
+                buf_write(fbuf, rfc_r[idx], rfs_r[idx], act_in),
+                fbuf,
+            )
+            bbuf = jnp.where(
+                rbv_r[idx],
+                buf_write(bbuf, rbc_r[idx], rbs_r[idx], cot_in),
+                bbuf,
+            )
+
+            o = op_r[idx]
+            c = ch_r[idx]
+            m = mb_r[idx]
+            v = c * S + idx
+            slot = m % K
+            pc = chunk_params(c)
+
+            def do_fwd(_):
+                mb_t = lax.dynamic_index_in_dim(mbs, m, 0, keepdims=False)
+                a_in = jnp.where(v == 0, mb_t, buf_read(fbuf, c, slot))
+                out = stage_fn(pc, a_in)
+                new_stash = buf_write(stash, c, slot, a_in)
+                # The last virtual stage's output feeds only its own
+                # (stash-recomputed) backward — nothing to send.
+                send = jnp.where(v == SV - 1, jnp.zeros_like(out), out)
+                return (new_stash, gacc, lacc, send,
+                        jnp.zeros_like(zero_act))
+
+            def do_bwd(_):
+                a_in = buf_read(stash, c, slot)
+                out, pb = jax.vjp(stage_fn, pc, a_in)
+                y_m = lax.dynamic_index_in_dim(labels, m, 0,
+                                               keepdims=False)
+                lval, lpb = jax.vjp(lambda oo: loss_fn(oo, y_m), out)
+                (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
+                cot = jnp.where(v == SV - 1, seed, buf_read(bbuf, c, slot))
+                dp, dact = pb(cot.astype(out.dtype))
+                new_gacc = jax.tree.map(
+                    lambda g, d: lax.dynamic_update_index_in_dim(
+                        g,
+                        lax.dynamic_index_in_dim(g, c, 0, keepdims=False)
+                        + d,
+                        c, 0,
+                    ),
+                    gacc, dp,
+                )
+                new_lacc = lacc + jnp.where(
+                    v == SV - 1, lval.astype(jnp.float32) / M, 0.0
+                )
+                # Virtual stage 0's cotangent leaves the pipeline.
+                send = jnp.where(v == 0, jnp.zeros_like(dact), dact)
+                return (stash, new_gacc, new_lacc,
+                        jnp.zeros_like(zero_act), send)
+
+            def do_idle(_):
+                return (stash, gacc, lacc, jnp.zeros_like(zero_act),
+                        jnp.zeros_like(zero_act))
+
+            stash, gacc, lacc, act_out, cot_out = lax.switch(
+                o, (do_idle, do_fwd, do_bwd), None
+            )
+            act_next = lax.ppermute(act_out, stage_axis, perm_fwd)
+            cot_next = lax.ppermute(cot_out, stage_axis, perm_bwd)
+            return (act_next, cot_next, stash, fbuf, bbuf, gacc,
+                    lacc), None
+
+        (_, _, _, _, _, gacc, lacc), _ = lax.scan(tick, carry0, xs)
+        grads = jax.tree.map(lambda g: g[None], gacc)
+        loss = lax.psum(lacc, stage_axis)
+        return grads, loss
+
+    pspec = P(stage_axis)
+
+    @jax.jit
+    def step(stage_params, microbatches, labels):
+        if microbatches.shape[0] != M:
+            raise ValueError(
+                f"schedule was built for {M} microbatches, got "
+                f"{microbatches.shape[0]}"
+            )
+        sharded = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(pspec, P(), P()),
+            out_specs=(pspec, P()),
+            axis_names=frozenset({stage_axis}),
+        )
+        stage_params = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, pspec)
+            ),
+            stage_params,
+        )
+        return sharded(stage_params, microbatches, labels)
+
+    return step
