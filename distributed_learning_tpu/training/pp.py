@@ -70,6 +70,39 @@ def _manual_axes(stage_axis: str, param_specs: Any) -> frozenset:
     return frozenset(axes)
 
 
+def head_seed(head_fn, var, head_params, out, y_mb, M, is_last):
+    """Loss-head fwd+vjp for one microbatch, shared by the plain and
+    interleaved 1F1B executors: returns ``(lval_f32, dhp, seed)`` with
+    zeros when ``is_last`` is False.
+
+    Two subtleties live here ON PURPOSE (so they cannot drift apart):
+    the replicated head params are cast to stage-varying BEFORE the vjp
+    — the implicit invariant->varying cast would otherwise sit inside
+    it and transpose to a psum over stages, silently summing every
+    other stage's nonsense head-gradient — and the whole fwd+vjp runs
+    under a ``lax.cond`` so only the op that really is the last virtual
+    stage pays the vocab-projection FLOPs (``head_fn`` must therefore
+    be collective-free).
+    """
+    hp_var = jax.tree.map(var, head_params)
+
+    def _head(ops):
+        o, y = ops
+        lv, lpb = jax.vjp(lambda hp, oo: head_fn(hp, oo, y), hp_var, o)
+        dh, sd = lpb(var(jnp.full((), 1.0 / M, lv.dtype)))
+        return lv.astype(jnp.float32), dh, sd
+
+    def _skip(ops):
+        o, _ = ops
+        return (
+            var(jnp.zeros((), jnp.float32)),
+            jax.tree.map(lambda a: var(jnp.zeros_like(a)), hp_var),
+            var(jnp.zeros_like(o)),
+        )
+
+    return lax.cond(is_last, _head, _skip, (out, y_mb))
+
+
 def _check_param_specs(param_specs: Any, stage_axis: str) -> None:
     """Every spec must lead with the stage axis.  A leaf spec that omits
     it would hand each device the FULL stacked array, so ``a[0]`` picks
@@ -323,45 +356,15 @@ def make_1f1b_train_step(
                 labels, jnp.clip(mb, 0, M - 1), axis=0, keepdims=False
             )
             if head_fn is not None:
-                # Cast the (replicated) head params to stage-varying
-                # BEFORE the vjp: the implicit invariant->varying cast
-                # would otherwise sit inside it and transpose to a psum
-                # over stages — dhp would then silently contain every
-                # OTHER stage's nonsense head-gradient (their `out` is
-                # not the final activation) before the is_last mask can
-                # drop it.  The cond then skips the head fwd+vjp (an
-                # LM's largest matmul) on the S-1 stages whose result
-                # the mask would discard anyway; head_fn must therefore
-                # be collective-free.
-                hp_var = jax.tree.map(var, head_params)
-
-                def _head(ops):
-                    o, y = ops
-                    lv, lpb = jax.vjp(
-                        lambda hp, oo: head_fn(hp, oo, y), hp_var, o
-                    )
-                    dh, sd = lpb(var(jnp.full((), 1.0 / M, lv.dtype)))
-                    return lv.astype(jnp.float32), dh, sd
-
-                def _skip(ops):
-                    o, _ = ops
-                    return (
-                        var(jnp.zeros((), jnp.float32)),
-                        jax.tree.map(
-                            lambda a: var(jnp.zeros_like(a)), hp_var
-                        ),
-                        var(jnp.zeros_like(o)),
-                    )
-
-                lval, dhp, seed = lax.cond(
-                    is_last, _head, _skip, (out, y_mb)
+                # See head_seed's docstring for the two vma/cond
+                # subtleties; the extra bwd_valid mask matters HERE
+                # because this schedule runs the bwd path on every tick
+                # (validity is a runtime mask, not a table decision).
+                lval, dhp, seed = head_seed(
+                    head_fn, var, head_params, out, y_mb, M,
+                    bwd_valid & is_last,
                 )
-                hacc = jax.tree.map(
-                    lambda h, d: h + jnp.where(
-                        bwd_valid & is_last, d, jnp.zeros_like(d)
-                    ),
-                    hacc, dhp,
-                )
+                hacc = jax.tree.map(lambda h, d: h + d, hacc, dhp)
             else:
                 lval, lpb = jax.vjp(lambda o: loss_fn(o, y_mb), out)
                 (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))

@@ -31,6 +31,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_learning_tpu.training.pp import head_seed
+
 __all__ = ["build_schedule", "make_interleaved_1f1b_train_step"]
 
 
@@ -173,6 +175,26 @@ def build_schedule(S: int, V: int, M: int) -> _Schedule:
                                 & ((en > tt) | (en < 0))).sum())
                 slots = max(slots, inflight)
 
+    # Collision-freedom of the m % slots mapping over every buffer
+    # lifetime (the greedy policy's microbatch-monotonicity makes the
+    # alive sets contiguous, but that is a property of the CURRENT
+    # policy — assert it on the simulated run rather than assume it
+    # survives a future policy tweak).
+    for v in range(SV):
+        lifetimes = [(fwd_done[v], bwd_done[v])]
+        if v > 0:
+            lifetimes.append((fwd_done[v - 1] + 1, fwd_done[v]))
+        if v < SV - 1:
+            lifetimes.append((bwd_done[v + 1] + 1, bwd_done[v]))
+        for st, en in lifetimes:
+            for tt in range(ticks):
+                alive = np.nonzero(
+                    (st <= tt) & (st >= 0) & ((en > tt) | (en < 0))
+                )[0]
+                assert len({int(m_) % slots for m_ in alive}) == len(
+                    alive
+                ), f"slot collision at v={v} tick={tt}"
+
     # A consumable message produced at the final tick would never be
     # filed; the schedule's structure (the last ops are v=0 backwards /
     # last-stage forwards, both send-masked) should make this
@@ -219,12 +241,14 @@ def build_schedule(S: int, V: int, M: int) -> _Schedule:
 def make_interleaved_1f1b_train_step(
     mesh: Mesh,
     stage_fn: Callable[[Any, jax.Array], jax.Array],
-    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array] | None = None,
+    *,
     n_chunks: int,
     n_microbatches: int,
-    *,
     stage_axis: str = "stage",
-) -> Callable[[Any, jax.Array, jax.Array], Tuple[Any, jax.Array]]:
+    head_fn: Callable[[Any, jax.Array, jax.Array], jax.Array] | None = None,
+    collect_input_grads: bool = False,
+) -> Callable[..., tuple]:
     """Build ``step(stage_params, microbatches, labels) -> (grads, loss)``
     under the interleaved schedule.
 
@@ -236,7 +260,16 @@ def make_interleaved_1f1b_train_step(
     with ``M = n_microbatches`` (static: the schedule is precomputed).
     Gradients come back in the same (S, V, ...) layout; ``loss`` is the
     mean microbatch loss, exactly as ``make_1f1b_train_step``.
+
+    ``head_fn`` and ``collect_input_grads`` carry the same contracts as
+    ``make_1f1b_train_step``'s extensions (trainable loss head seeded at
+    the LAST virtual stage; stage-0 input cotangents returned for an
+    embedding vjp), so ``training/pp_lm.py`` can bind the TransformerLM
+    to this schedule too.  Returns
+    ``(grads[, head_grads][, d_microbatches], loss)``.
     """
+    if (loss_fn is None) == (head_fn is None):
+        raise ValueError("exactly one of loss_fn / head_fn is required")
     S = mesh.shape[stage_axis]
     V = int(n_chunks)
     M = int(n_microbatches)
@@ -255,7 +288,7 @@ def make_interleaved_1f1b_train_step(
         )
     )
 
-    def local(stage_params, mbs, labels):
+    def local(stage_params, head_params, mbs, labels):
         p = jax.tree.map(lambda a: a[0], stage_params)  # (V, ...) chunks
         idx = lax.axis_index(stage_axis)
 
@@ -274,6 +307,13 @@ def make_interleaved_1f1b_train_step(
             zbuf,                                        # fwd-in buffer
             zbuf,                                        # cot-in buffer
             jax.tree.map(lambda a: var(jnp.zeros_like(a)), p),  # gacc
+            # head-grad accumulator + input-cotangent buffer (dummies
+            # when unused: the scan carry structure must be static)
+            jax.tree.map(lambda a: var(jnp.zeros_like(a)), head_params),
+            var(jnp.zeros(
+                ((M if collect_input_grads else 1),) + act_shape,
+                mbs.dtype,
+            )),
             var(jnp.zeros((), jnp.float32)),             # loss acc
         )
 
@@ -293,7 +333,8 @@ def make_interleaved_1f1b_train_step(
         def tick(carry, x):
             (op_r, ch_r, mb_r, rfv_r, rfc_r, rfs_r, rbv_r, rbc_r,
              rbs_r) = x
-            act_in, cot_in, stash, fbuf, bbuf, gacc, lacc = carry
+            (act_in, cot_in, stash, fbuf, bbuf, gacc, hacc, dmbs,
+             lacc) = carry
 
             # 1) File the messages that arrived this tick.
             fbuf = jnp.where(
@@ -322,7 +363,7 @@ def make_interleaved_1f1b_train_step(
                 # The last virtual stage's output feeds only its own
                 # (stash-recomputed) backward — nothing to send.
                 send = jnp.where(v == SV - 1, jnp.zeros_like(out), out)
-                return (new_stash, gacc, lacc, send,
+                return (new_stash, gacc, hacc, dmbs, lacc, send,
                         jnp.zeros_like(zero_act))
 
             def do_bwd(_):
@@ -330,8 +371,21 @@ def make_interleaved_1f1b_train_step(
                 out, pb = jax.vjp(stage_fn, pc, a_in)
                 y_m = lax.dynamic_index_in_dim(labels, m, 0,
                                                keepdims=False)
-                lval, lpb = jax.vjp(lambda oo: loss_fn(oo, y_m), out)
-                (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
+                if head_fn is not None:
+                    # Shared with pp.py's 1F1B (see head_seed's
+                    # docstring for the vma-cast and cond subtleties);
+                    # here the schedule table already guarantees this
+                    # op is a valid backward, so v == SV-1 is the whole
+                    # predicate and dhp is zeros on every other op.
+                    lval, dhp, seed = head_seed(
+                        head_fn, var, head_params, out, y_m, M,
+                        v == SV - 1,
+                    )
+                    new_hacc = jax.tree.map(lambda h, d: h + d, hacc, dhp)
+                else:
+                    lval, lpb = jax.vjp(lambda oo: loss_fn(oo, y_m), out)
+                    (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
+                    new_hacc = hacc
                 cot = jnp.where(v == SV - 1, seed, buf_read(bbuf, c, slot))
                 dp, dact = pb(cot.astype(out.dtype))
                 new_gacc = jax.tree.map(
@@ -343,45 +397,82 @@ def make_interleaved_1f1b_train_step(
                     ),
                     gacc, dp,
                 )
+                if collect_input_grads:
+                    old_i = lax.dynamic_index_in_dim(dmbs, m, 0,
+                                                     keepdims=False)
+                    new_dmbs = lax.dynamic_update_index_in_dim(
+                        dmbs,
+                        jnp.where(v == 0, dact.astype(dmbs.dtype), old_i),
+                        m, 0,
+                    )
+                else:
+                    new_dmbs = dmbs
                 new_lacc = lacc + jnp.where(
                     v == SV - 1, lval.astype(jnp.float32) / M, 0.0
                 )
                 # Virtual stage 0's cotangent leaves the pipeline.
                 send = jnp.where(v == 0, jnp.zeros_like(dact), dact)
-                return (stash, new_gacc, new_lacc,
+                return (stash, new_gacc, new_hacc, new_dmbs, new_lacc,
                         jnp.zeros_like(zero_act), send)
 
             def do_idle(_):
-                return (stash, gacc, lacc, jnp.zeros_like(zero_act),
+                return (stash, gacc, hacc, dmbs, lacc,
+                        jnp.zeros_like(zero_act),
                         jnp.zeros_like(zero_act))
 
-            stash, gacc, lacc, act_out, cot_out = lax.switch(
+            stash, gacc, hacc, dmbs, lacc, act_out, cot_out = lax.switch(
                 o, (do_idle, do_fwd, do_bwd), None
             )
             act_next = lax.ppermute(act_out, stage_axis, perm_fwd)
             cot_next = lax.ppermute(cot_out, stage_axis, perm_bwd)
-            return (act_next, cot_next, stash, fbuf, bbuf, gacc,
-                    lacc), None
+            return (act_next, cot_next, stash, fbuf, bbuf, gacc, hacc,
+                    dmbs, lacc), None
 
-        (_, _, _, _, _, gacc, lacc), _ = lax.scan(tick, carry0, xs)
+        (_, _, _, _, _, gacc, hacc, dmbs, lacc), _ = lax.scan(
+            tick, carry0, xs
+        )
         grads = jax.tree.map(lambda g: g[None], gacc)
         loss = lax.psum(lacc, stage_axis)
-        return grads, loss
+        outs = [grads]
+        if head_fn is not None:
+            outs.append(jax.tree.map(
+                lambda h: lax.psum(h, stage_axis), hacc
+            ))
+        if collect_input_grads:
+            outs.append(lax.psum(dmbs, stage_axis))
+        outs.append(loss)
+        return tuple(outs)
 
     pspec = P(stage_axis)
 
     @jax.jit
-    def step(stage_params, microbatches, labels):
+    def _step(stage_params, head_params, microbatches, labels):
         if microbatches.shape[0] != M:
             raise ValueError(
                 f"schedule was built for {M} microbatches, got "
                 f"{microbatches.shape[0]}"
             )
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            stage_params
+        ):
+            if leaf.ndim < 2 or leaf.shape[1] != V:
+                raise ValueError(
+                    f"stage_params at {jax.tree_util.keystr(path)} has "
+                    f"shape {getattr(leaf, 'shape', None)}; expected "
+                    f"leading (S, V={V}, ...) — a mismatched chunk dim "
+                    "would silently train only some chunks"
+                )
+        out_specs = [pspec]
+        if head_fn is not None:
+            out_specs.append(jax.tree.map(lambda _: P(), head_params))
+        if collect_input_grads:
+            out_specs.append(P())
+        out_specs.append(P())
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(pspec, P(), P()),
-            out_specs=(pspec, P()),
+            in_specs=(pspec, P(), P(), P()),
+            out_specs=tuple(out_specs),
             axis_names=frozenset({stage_axis}),
         )
         stage_params = jax.tree.map(
@@ -390,6 +481,13 @@ def make_interleaved_1f1b_train_step(
             ),
             stage_params,
         )
-        return sharded(stage_params, microbatches, labels)
+        return sharded(stage_params, head_params, microbatches, labels)
+
+    if head_fn is not None:
+        return _step
+
+    @jax.jit
+    def step(stage_params, microbatches, labels):
+        return _step(stage_params, {}, microbatches, labels)
 
     return step

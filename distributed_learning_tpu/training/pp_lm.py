@@ -50,8 +50,10 @@ __all__ = [
     "split_lm_params",
     "merge_lm_params",
     "stage_layout",
+    "interleaved_stage_layout",
     "make_lm_pipeline_train_step",
     "make_lm_1f1b_train_step",
+    "make_lm_interleaved_train_step",
 ]
 
 
@@ -65,6 +67,25 @@ def stage_layout(stacked, n_stages: int):
                 f"{L} blocks do not divide into {n_stages} stages"
             )
         return leaf.reshape((n_stages, L // n_stages) + leaf.shape[1:])
+
+    return jax.tree.map(fold, stacked)
+
+
+def interleaved_stage_layout(stacked, n_stages: int, n_chunks: int):
+    """(L, ...) block stack -> (S, V, L/(S*V), ...) chunk groups for the
+    interleaved schedule: chunk ``c`` of device ``d`` holds the blocks
+    of virtual stage ``v = c*S + d`` (``training/pp_interleaved.py``'s
+    placement), i.e. leaf[d, c, l] = block ``(c*S + d)*Lc + l``."""
+    S, V = n_stages, n_chunks
+
+    def fold(leaf):
+        L = leaf.shape[0]
+        if L % (S * V):
+            raise ValueError(
+                f"{L} blocks do not divide into {S} stages x {V} chunks"
+            )
+        Lc = L // (S * V)
+        return leaf.reshape((V, S, Lc) + leaf.shape[1:]).swapaxes(0, 1)
 
     return jax.tree.map(fold, stacked)
 
@@ -86,18 +107,24 @@ def split_lm_params(model, params) -> Tuple[Any, Any]:
     return outer, stacked
 
 
-def merge_lm_params(model, outer, stacked, *, n_stages: int | None = None) -> Any:
+def merge_lm_params(model, outer, stacked, *, n_stages: int | None = None,
+                    n_chunks: int | None = None) -> Any:
     """Inverse of :func:`split_lm_params`: rebuild the flax tree (e.g.
     to checkpoint, evaluate, or ``generate`` mid-training).
 
     Pass ``n_stages`` when ``stacked`` is in the step's (S, L/S, ...)
-    :func:`stage_layout`; omit it for ``split_lm_params``' (L, ...)
-    form.  Explicit because the two layouts are indistinguishable from
-    shapes alone whenever S == L.
+    :func:`stage_layout`, and additionally ``n_chunks`` for the
+    interleaved (S, V, L/(S*V), ...) :func:`interleaved_stage_layout`;
+    omit both for ``split_lm_params``' (L, ...) form.  Explicit because
+    the layouts are indistinguishable from shapes alone whenever S == L.
     """
     L = model.num_layers
 
     def unstack(leaf):
+        if n_chunks is not None:
+            # (S, V, Lc, ...) -> (V, S, Lc, ...) -> (L, ...): C-order
+            # flattening of [c, d, l] is block (c*S + d)*Lc + l.
+            return leaf.swapaxes(0, 1).reshape((L,) + leaf.shape[3:])
         if n_stages is not None:
             return leaf.reshape((L,) + leaf.shape[2:])
         return leaf
@@ -245,6 +272,24 @@ def make_lm_pipeline_train_step(
     return step
 
 
+def _lm_chained_step(parts, inner, tx):
+    """The embed-vjp -> inner-schedule -> grad-merge -> optimizer
+    sequence shared by every head_fn-based LM step builder."""
+
+    @jax.jit
+    def step(outer, stages, opt_state, tok_mb, y_mb):
+        ep, hp = parts.split_outer(outer)
+        x, emb_vjp = jax.vjp(lambda e: parts.embed(e, tok_mb), ep)
+        g_stages, g_head, d_x, loss = inner(stages, hp, x, y_mb)
+        (g_embed,) = emb_vjp(d_x)
+        grads = ({**g_embed, **g_head}, g_stages)
+        updates, opt_state = tx.update(grads, opt_state, (outer, stages))
+        outer, stages = optax.apply_updates((outer, stages), updates)
+        return outer, stages, opt_state, loss
+
+    return step
+
+
 def make_lm_1f1b_train_step(
     mesh: Mesh,
     model,
@@ -271,16 +316,42 @@ def make_lm_1f1b_train_step(
         collect_input_grads=True,
         stage_axis=stage_axis,
     )
+    return _lm_chained_step(parts, inner, tx)
 
-    @jax.jit
-    def step(outer, stages, opt_state, tok_mb, y_mb):
-        ep, hp = parts.split_outer(outer)
-        x, emb_vjp = jax.vjp(lambda e: parts.embed(e, tok_mb), ep)
-        g_stages, g_head, d_x, loss = inner(stages, hp, x, y_mb)
-        (g_embed,) = emb_vjp(d_x)
-        grads = ({**g_embed, **g_head}, g_stages)
-        updates, opt_state = tx.update(grads, opt_state, (outer, stages))
-        outer, stages = optax.apply_updates((outer, stages), updates)
-        return outer, stages, opt_state, loss
 
-    return step
+def make_lm_interleaved_train_step(
+    mesh: Mesh,
+    model,
+    tx: Any,
+    n_chunks: int,
+    n_microbatches: int,
+    *,
+    stage_axis: str = "stage",
+) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
+    """The LM under the INTERLEAVED 1F1B schedule
+    (``training/pp_interleaved.py``): same contract as
+    :func:`make_lm_1f1b_train_step`, but ``stages`` is
+    ``interleaved_stage_layout(..., S, n_chunks)`` — each device hosts
+    ``n_chunks`` virtual-stage chunks, shrinking the pipeline bubble.
+    ``n_microbatches`` is static (the schedule is precomputed for it);
+    ``tok_mb``/``y_mb`` must carry exactly that many microbatches.
+    """
+    from distributed_learning_tpu.training.pp_interleaved import (
+        make_interleaved_1f1b_train_step,
+    )
+
+    parts = _LMParts(mesh, model, stage_axis)
+    if model.num_layers % (parts.S * n_chunks):
+        raise ValueError(
+            f"num_layers {model.num_layers} must divide into "
+            f"{parts.S} stages x {n_chunks} chunks"
+        )
+    inner = make_interleaved_1f1b_train_step(
+        mesh, parts.stage_fn,
+        n_chunks=n_chunks,
+        n_microbatches=n_microbatches,
+        head_fn=parts.head_loss,
+        collect_input_grads=True,
+        stage_axis=stage_axis,
+    )
+    return _lm_chained_step(parts, inner, tx)
