@@ -155,38 +155,27 @@ def build_schedule(S: int, V: int, M: int) -> _Schedule:
     # fwd-in buffer (producer's fwd+1 -> this stage's fwd), the cot-in
     # buffer (downstream bwd+1 -> this stage's bwd).  All three windows
     # advance in microbatch order under the bwd-first policy, so a
-    # depth of the max in-flight count makes m % slots collision-free;
-    # take the max over all three lifetimes.
+    # depth of the max in-flight count makes m % slots collision-free.
+    # One pass measures the depth; a second pass over the SAME windows
+    # asserts collision-freedom against the final depth (monotonicity
+    # is a property of the CURRENT greedy policy — check the simulated
+    # run rather than assume it survives a policy tweak).
+    def _lifetimes(v):
+        yield fwd_done[v], bwd_done[v]                        # stash
+        if v > 0:
+            yield fwd_done[v - 1] + 1, fwd_done[v]            # fwd-in
+        if v < SV - 1:
+            yield bwd_done[v + 1] + 1, bwd_done[v]            # cot-in
+
     slots = 1
     for v in range(SV):
-        starts = {
-            "stash": fwd_done[v],
-            "fin": (fwd_done[v - 1] + 1) if v > 0 else None,
-            "bin": (bwd_done[v + 1] + 1) if v < SV - 1 else None,
-        }
-        ends = {"stash": bwd_done[v], "fin": fwd_done[v],
-                "bin": bwd_done[v]}
-        for name, st in starts.items():
-            if st is None:
-                continue
-            en = ends[name]
+        for st, en in _lifetimes(v):
             for tt in range(ticks):
                 inflight = int(((st <= tt) & (st >= 0)
                                 & ((en > tt) | (en < 0))).sum())
                 slots = max(slots, inflight)
-
-    # Collision-freedom of the m % slots mapping over every buffer
-    # lifetime (the greedy policy's microbatch-monotonicity makes the
-    # alive sets contiguous, but that is a property of the CURRENT
-    # policy — assert it on the simulated run rather than assume it
-    # survives a future policy tweak).
     for v in range(SV):
-        lifetimes = [(fwd_done[v], bwd_done[v])]
-        if v > 0:
-            lifetimes.append((fwd_done[v - 1] + 1, fwd_done[v]))
-        if v < SV - 1:
-            lifetimes.append((bwd_done[v + 1] + 1, bwd_done[v]))
-        for st, en in lifetimes:
+        for st, en in _lifetimes(v):
             for tt in range(ticks):
                 alive = np.nonzero(
                     (st <= tt) & (st >= 0) & ((en > tt) | (en < 0))

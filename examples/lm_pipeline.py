@@ -39,7 +39,9 @@ from distributed_learning_tpu.models.transformer import (
     generate,
 )
 from distributed_learning_tpu.training.pp_lm import (
+    interleaved_stage_layout,
     make_lm_1f1b_train_step,
+    make_lm_interleaved_train_step,
     make_lm_pipeline_train_step,
     merge_lm_params,
     split_lm_params,
@@ -53,10 +55,13 @@ def main() -> None:
     ap.add_argument("--stages", type=int, default=4)
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--gen", type=int, default=6)
-    ap.add_argument("--schedule", choices=("gpipe", "1f1b"),
+    ap.add_argument("--schedule",
+                    choices=("gpipe", "1f1b", "interleaved"),
                     default="gpipe",
                     help="gpipe: autodiff backward, O(M) activations; "
-                         "1f1b: hand-scheduled, O(S) activation stash")
+                         "1f1b: hand-scheduled, O(S) activation stash; "
+                         "interleaved: 2 virtual chunks per stage "
+                         "(smaller bubble)")
     args = ap.parse_args()
     V = args.vocab
     S = min(args.stages, len(jax.devices()))
@@ -74,14 +79,21 @@ def main() -> None:
 
     params = model.init(jax.random.key(0), x[0])["params"]
     outer, stacked = split_lm_params(model, params)
-    stages = stage_layout(stacked, S)
+    VC = 2 if args.schedule == "interleaved" else None  # virtual chunks
+    stages = (interleaved_stage_layout(stacked, S, VC) if VC
+              else stage_layout(stacked, S))
     mesh = Mesh(np.array(jax.devices()[:S]), ("stage",))
 
     tx = optax.adam(5e-3)
     opt = tx.init((outer, stages))
-    build = (make_lm_1f1b_train_step if args.schedule == "1f1b"
-             else make_lm_pipeline_train_step)
-    step = build(mesh, model, tx)
+    if args.schedule == "interleaved":
+        step = make_lm_interleaved_train_step(
+            mesh, model, tx, n_chunks=VC, n_microbatches=x.shape[0]
+        )
+    else:
+        build = (make_lm_1f1b_train_step if args.schedule == "1f1b"
+                 else make_lm_pipeline_train_step)
+        step = build(mesh, model, tx)
 
     loss = None
     with mesh:
@@ -94,7 +106,8 @@ def main() -> None:
         f"0 training steps ({S} stages); generating from init"
     )
 
-    merged = merge_lm_params(model, outer, stages, n_stages=S)
+    merged = merge_lm_params(model, outer, stages, n_stages=S,
+                             n_chunks=VC)
     start = 3
     prompt = jnp.asarray(((start + np.arange(5)) % V)[None], jnp.int32)
     toks = np.asarray(generate(model, merged, prompt, args.gen))[0]

@@ -247,3 +247,13 @@ def test_lm_pipeline_example():
     assert int(m.group(1)) == int(m.group(2)) == 6, out
     loss = float(re.search(r"final loss ([\d.]+)", out).group(1))
     assert loss < 0.1, out
+
+
+def test_lm_pipeline_interleaved_example():
+    """The interleaved-schedule variant of the pipelined-LM demo learns
+    the progression too (2 virtual chunks per stage)."""
+    out = _run("lm_pipeline", "--schedule", "interleaved",
+               "--steps", "220", "--gen", "6")
+    m = re.search(r"correct_tokens: (\d+)/(\d+)", out)
+    assert m, out
+    assert int(m.group(1)) == int(m.group(2)) == 6, out
