@@ -31,7 +31,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_learning_tpu.training.pp import head_seed
+from distributed_learning_tpu.training.pp import (
+    _check_param_specs,
+    _manual_axes,
+    head_seed,
+)
 
 __all__ = ["build_schedule", "make_interleaved_1f1b_train_step"]
 
@@ -235,6 +239,7 @@ def make_interleaved_1f1b_train_step(
     n_chunks: int,
     n_microbatches: int,
     stage_axis: str = "stage",
+    param_specs: Any = None,
     head_fn: Callable[[Any, jax.Array, jax.Array], jax.Array] | None = None,
     collect_input_grads: bool = False,
 ) -> Callable[..., tuple]:
@@ -250,6 +255,10 @@ def make_interleaved_1f1b_train_step(
     Gradients come back in the same (S, V, ...) layout; ``loss`` is the
     mean microbatch loss, exactly as ``make_1f1b_train_step``.
 
+    ``param_specs`` composes with tensor parallelism exactly as in
+    ``make_1f1b_train_step`` (per-leaf specs with the leading stage
+    axis, megatron chunk fns exiting through a plain ``lax.psum``), and
+    any mesh axis outside the manual set stays GSPMD-auto (dp).
     ``head_fn`` and ``collect_input_grads`` carry the same contracts as
     ``make_1f1b_train_step``'s extensions (trainable loss head seeded at
     the LAST virtual stage; stage-0 input cotangents returned for an
@@ -263,6 +272,23 @@ def make_interleaved_1f1b_train_step(
     V = int(n_chunks)
     M = int(n_microbatches)
     SV = S * V
+    if param_specs is not None:
+        _check_param_specs(param_specs, stage_axis)
+        # The chunk dim (dim 1) must stay unsharded: the executor
+        # dynamic-indexes it per tick, and a sharded chunk dim shrinks
+        # to local size 1 inside shard_map — the index silently clamps
+        # to chunk 0 and every virtual stage runs the wrong parameters
+        # (reproduced in review: plausible loss, garbage gradients).
+        for path, spec in jax.tree_util.tree_leaves_with_path(
+            param_specs, is_leaf=lambda x: isinstance(x, P)
+        ):
+            if len(spec) > 1 and spec[1] is not None:
+                raise ValueError(
+                    f"param_specs at {jax.tree_util.keystr(path)} is "
+                    f"{spec!r}: dim 1 is the chunk dim and must be "
+                    "None (unsharded) — sharding it would make every "
+                    "chunk index clamp to 0 inside shard_map"
+                )
     sched = build_schedule(S, V, M)
     K = sched.slots
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
@@ -451,7 +477,11 @@ def make_interleaved_1f1b_train_step(
                     f"leading (S, V={V}, ...) — a mismatched chunk dim "
                     "would silently train only some chunks"
                 )
-        out_specs = [pspec]
+        specs = (
+            param_specs if param_specs is not None
+            else jax.tree.map(lambda _: pspec, stage_params)
+        )
+        out_specs = [specs]
         if head_fn is not None:
             out_specs.append(jax.tree.map(lambda _: P(), head_params))
         if collect_input_grads:
@@ -460,15 +490,15 @@ def make_interleaved_1f1b_train_step(
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(pspec, P(), P(), P()),
+            in_specs=(specs, P(), P(), P()),
             out_specs=tuple(out_specs),
-            axis_names=frozenset({stage_axis}),
+            axis_names=_manual_axes(stage_axis, param_specs),
         )
         stage_params = jax.tree.map(
-            lambda a: jax.lax.with_sharding_constraint(
-                a, NamedSharding(mesh, pspec)
+            lambda a, sp: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, sp)
             ),
-            stage_params,
+            stage_params, specs,
         )
         return sharded(stage_params, head_params, microbatches, labels)
 
