@@ -70,7 +70,8 @@ def _manual_axes(stage_axis: str, param_specs: Any) -> frozenset:
     return frozenset(axes)
 
 
-def head_seed(head_fn, var, head_params, out, y_mb, M, is_last):
+def head_seed(head_fn, var, head_params, out, y_mb, M, is_last,
+              var_full=None):
     """Loss-head fwd+vjp for one microbatch, shared by the plain and
     interleaved 1F1B executors: returns ``(lval_f32, dhp, seed)`` with
     zeros when ``is_last`` is False.
@@ -81,9 +82,24 @@ def head_seed(head_fn, var, head_params, out, y_mb, M, is_last):
     it and transpose to a psum over stages, silently summing every
     other stage's nonsense head-gradient — and the whole fwd+vjp runs
     under a ``lax.cond`` so only the op that really is the last virtual
-    stage pays the vocab-projection FLOPs (``head_fn`` must therefore
-    be collective-free).
+    stage pays the vocab-projection FLOPs.  ``head_fn`` must therefore
+    use no collectives over the STAGE axis (the cond branches per
+    stage); collectives over the extra sequence axes are fine — and
+    under pp x sp the loss must END in one (``lax.pmean(..., seq)``)
+    so the scalar is sequence-invariant.
+
+    ``var_full`` (defaults to ``var``) casts the ``_skip`` branch's
+    seed zeros to match the activation's full varying set under pp x sp.
+    The head params deliberately stay on the stage-only cast: over any
+    EXTRA (sequence) axis they remain invariant, so the implicit cast
+    inside the vjp transposes to a psum over that axis — which is the
+    correct total of the per-token-shard head gradients.  (Over the
+    stage axis that same mechanism would sum other stages' garbage,
+    hence the explicit stage cast — the two axes want opposite
+    treatment.)
     """
+    if var_full is None:
+        var_full = var
     hp_var = jax.tree.map(var, head_params)
 
     def _head(ops):
@@ -97,7 +113,7 @@ def head_seed(head_fn, var, head_params, out, y_mb, M, is_last):
         return (
             var(jnp.zeros((), jnp.float32)),
             jax.tree.map(lambda a: var(jnp.zeros_like(a)), hp_var),
-            var(jnp.zeros_like(o)),
+            var_full(jnp.zeros_like(o)),
         )
 
     return lax.cond(is_last, _head, _skip, (out, y_mb))
@@ -126,6 +142,8 @@ def make_pipeline_apply(
     stage_axis: str = "stage",
     param_specs: Any = None,
     remat_stage: bool = False,
+    extra_manual_axes: tuple = (),
+    microbatch_spec: P = P(),
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build ``apply(stage_params, microbatches) -> outputs``.
 
@@ -134,6 +152,13 @@ def make_pipeline_apply(
     its input instead of storing every intermediate per tick — the
     standard FLOPs-for-HBM trade for deep stages (the 1F1B builder
     already recomputes from its stash, so it has no such knob).
+
+    ``extra_manual_axes``/``microbatch_spec`` compose the pipeline with
+    SEQUENCE parallelism: name the sequence axis manual and shard the
+    microbatches' token dim over it (e.g. ``("seq",)`` with
+    ``P(None, None, "seq")``), and ``stage_fn`` may use in-stage
+    sequence collectives (ring attention's ppermute) against that axis
+    while activations still hop the stage ring.
 
     ``stage_fn(params_for_one_stage, act) -> act`` applies one stage's
     layer group; activations keep one shape throughout (the transformer
@@ -211,9 +236,10 @@ def make_pipeline_apply(
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(specs, P()),
-            out_specs=P(),
-            axis_names=_manual_axes(stage_axis, param_specs),
+            in_specs=(specs, microbatch_spec),
+            out_specs=microbatch_spec,
+            axis_names=_manual_axes(stage_axis, param_specs)
+            | frozenset(extra_manual_axes),
         )
         stage_params = jax.tree.map(
             lambda a, s: jax.lax.with_sharding_constraint(
@@ -235,6 +261,8 @@ def make_1f1b_train_step(
     param_specs: Any = None,
     head_fn: Callable[[Any, jax.Array, jax.Array], jax.Array] | None = None,
     collect_input_grads: bool = False,
+    extra_manual_axes: tuple = (),
+    microbatch_spec: P = P(),
 ) -> Callable[..., tuple]:
     """Build ``step(stage_params, microbatches, labels) -> (grads, loss)``
     under the 1F1B schedule.
@@ -279,10 +307,27 @@ def make_1f1b_train_step(
       PIPELINE INPUT, which the caller chains into whatever produced the
       microbatches (an embedding's vjp) so front parameters train too.
 
+    ``extra_manual_axes``/``microbatch_spec`` compose 1F1B with
+    sequence parallelism exactly as in :func:`make_pipeline_apply`;
+    params stay replicated over the extra axes (their token-shard
+    gradient totals arrive through the invariant-param transpose), and
+    ``loss_fn``/``head_fn`` must return a value already reduced over
+    them (e.g. end in ``lax.pmean(..., seq_axis)``).  ``microbatch_spec``
+    applies to BOTH ``microbatches`` and ``labels`` — under pp x sp the
+    labels must carry the same rank and token-dim layout as the
+    activations (e.g. shifted targets (M, mb, T); per-sequence rank-2
+    labels would be rejected by shard_map against the rank-3 spec).
     Returns ``(grads[, head_grads][, d_microbatches], loss)``.
     """
     if (loss_fn is None) == (head_fn is None):
         raise ValueError("exactly one of loss_fn / head_fn is required")
+    if collect_input_grads and extra_manual_axes:
+        raise ValueError(
+            "collect_input_grads with extra_manual_axes is not "
+            "supported: the input cotangents are sharded over the extra "
+            "axes and the collected buffer's replication contract "
+            "cannot hold"
+        )
     S = mesh.shape[stage_axis]
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_bwd = [(i, (i - 1) % S) for i in range(S)]
@@ -296,20 +341,36 @@ def make_1f1b_train_step(
         M = mbs.shape[0]
         B = min(M, 2 * S - 1)  # max in-flight per stage is 2(S-1)+1
 
-        def var(x):
-            # Idempotent: grad-accumulator zeros derive from the (sharded,
-            # already-varying) params, while activation/stash zeros derive
-            # from the replicated microbatches and need the cast.
-            if stage_axis in getattr(jax.typeof(x), "vma", ()):
-                return x
-            return lax.pcast(x, (stage_axis,), to="varying")
+        def _cast(axes):
+            def f(x):
+                # Idempotent: add only the axes the value lacks.
+                missing = tuple(
+                    a for a in axes
+                    if a not in getattr(jax.typeof(x), "vma", ())
+                )
+                return lax.pcast(x, missing, to="varying") if missing else x
+            return f
 
-        zero_act = var(jnp.zeros_like(mbs[0]))
+        # Stage-only cast for the loss path (the loss is reduced over
+        # the extra axes by contract); full cast for everything the
+        # activations touch — under pp x sp the act-derived carries and
+        # the parameter-gradient accumulators are sequence-varying
+        # (per-shard partials), and the scan carry must say so up front.
+        var = _cast((stage_axis,))
+        var_full = _cast((stage_axis,) + tuple(extra_manual_axes))
+
+        zero_act = var_full(jnp.zeros_like(mbs[0]))
         carry0 = (
             zero_act,                                   # fwd activation in
             zero_act,                                   # bwd cotangent in
-            var(jnp.zeros((B,) + mbs.shape[1:], mbs.dtype)),  # input stash
-            jax.tree.map(lambda a: var(jnp.zeros_like(a)), p),  # grad acc
+            var_full(
+                jnp.zeros((B,) + mbs.shape[1:], mbs.dtype)
+            ),                                          # input stash
+            # Grad accumulators stay on the STAGE-only cast: the
+            # params enter seq-invariant, so the vjp's implicit-cast
+            # transpose hands back dp/dhp already psum'd over the extra
+            # axes (the correct per-token-shard total).
+            jax.tree.map(lambda a: var(jnp.zeros_like(a)), p),
             # head-grad accumulator (zeros tree even when unused: the
             # scan carry must be static in structure)
             jax.tree.map(lambda a: var(jnp.zeros_like(a)), head_params),
@@ -362,7 +423,7 @@ def make_1f1b_train_step(
                 # (validity is a runtime mask, not a table decision).
                 lval, dhp, seed = head_seed(
                     head_fn, var, head_params, out, y_mb, M,
-                    bwd_valid & is_last,
+                    bwd_valid & is_last, var_full=var_full,
                 )
                 hacc = jax.tree.map(lambda h, d: h + d, hacc, dhp)
             else:
@@ -403,6 +464,20 @@ def make_1f1b_train_step(
 
         ticks = jnp.arange(M + 2 * S - 2)
         (_, _, _, gacc, hacc, dmbs, lacc), _ = lax.scan(tick, carry0, ticks)
+        # Normally a no-op: dp/dhp arrive pre-reduced over the extra
+        # axes (invariant-param transpose).  A stage_fn that explicitly
+        # pvaries its params opts out of that; total its partials here.
+        for ax in extra_manual_axes:
+            gacc = jax.tree.map(
+                lambda g: lax.psum(g, ax)
+                if ax in getattr(jax.typeof(g), "vma", ()) else g,
+                gacc,
+            )
+            hacc = jax.tree.map(
+                lambda h: lax.psum(h, ax)
+                if ax in getattr(jax.typeof(h), "vma", ()) else h,
+                hacc,
+            )
         grads = jax.tree.map(lambda g: g[None], gacc)  # (1, ...) local slice
         loss = lax.psum(lacc, stage_axis)  # only the last stage contributes
         outs = [grads]
@@ -434,9 +509,10 @@ def make_1f1b_train_step(
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(specs, P(), P(), P()),
+            in_specs=(specs, P(), microbatch_spec, microbatch_spec),
             out_specs=tuple(out_specs),
-            axis_names=_manual_axes(stage_axis, param_specs),
+            axis_names=_manual_axes(stage_axis, param_specs)
+            | frozenset(extra_manual_axes),
         )
         stage_params = jax.tree.map(
             lambda a, s: jax.lax.with_sharding_constraint(
