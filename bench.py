@@ -436,12 +436,16 @@ def main():
         "value": round(sps, 2),
         "unit": "samples/sec",
         "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+        "provisional": False,
         "config": f"{n_agents} agents x batch {batch}, bf16, rbg dropout, "
                   "mix 1/epoch",
     }
-    # The measurement is final: stand the deadline down BEFORE printing
-    # so a last-moment fire can neither double-print nor catch the
-    # record mid-swap.
+    # Bank the completed headline FIRST (one dict, one schema): a
+    # deadline that fires anywhere past this line emits THIS
+    # measurement, never the inferior provisional record.  Then stand
+    # the deadline down before printing so a last-moment fire can
+    # neither double-print nor catch the record mid-swap.
+    _BEST_RECORD.update(result)
     cancel_deadline()
     print(json.dumps(result))
 

@@ -175,6 +175,43 @@ def _rival_pass(T: int, iters: int, ours_best, ours_grad) -> None:
         emit(rec)
 
 
+def quick() -> None:
+    """ONE post-fix forward point (plus the bwd if time allows the
+    second compile) at the measured-best config — sized so a ~10-minute
+    healthy tunnel window still yields a post-fix TFLOP/s record before
+    the full sweep (VERDICT r4 next-#5; ``tpu_session2.sh`` stage 1a).
+    Off-TPU this smoke-runs tiny interpreted shapes like ``run``."""
+    on_tpu = platform() == "tpu"
+    if not on_tpu and not smoke():
+        return
+    interpret = not on_tpu
+    T, bq, bk, iters = (32768, 256, 512, 4) if on_tpu else (256, 128, 128, 1)
+    for backward in (False, True):
+        name = "grad_" if backward else ""
+        try:
+            tflops, dt = _measure(T, bq, bk, iters=iters,
+                                  interpret=interpret, backward=backward)
+        except Exception as e:
+            emit({
+                "metric": f"flash_attention_quick_{name}T{T}",
+                "value": None,
+                "unit": "TFLOP/s",
+                "vs_baseline": None,
+                "error": f"{type(e).__name__}: {str(e)[:120]}",
+            })
+            continue
+        emit({
+            "metric": f"flash_attention_quick_{name}T{T}",
+            "value": round(tflops, 2),
+            "unit": "TFLOP/s",
+            "vs_baseline": None,
+            "config": f"B1 H8 D128 bf16, block_q={bq} block_k={bk}, "
+                      "post-native-dtype-fix quick point",
+            "seconds_per_call": round(dt, 4),
+            "fraction_of_v5e_peak": round(tflops / V5E_BF16_PEAK_TFLOPS, 3),
+        })
+
+
 def run() -> None:
     on_tpu = platform() == "tpu"
     if not on_tpu and not smoke():

@@ -128,6 +128,54 @@ def _measure_decode(
     return B * (steps - 1) / decode_dt, dt
 
 
+def _measure_decode_tp(
+    T_prompt: int, steps: int, *, B: int, vocab: int, num_layers: int,
+    num_heads: int, head_dim: int, num_kv_heads=None,
+) -> tuple[float, float]:
+    """Like :func:`_measure_decode` but through the tensor-parallel
+    path on a (data=1, model=2) mesh — prefill-subtracted steady-state
+    rate with the KV cache head-sharded."""
+    from jax.sharding import Mesh
+
+    from distributed_learning_tpu.models import TransformerLM
+    from distributed_learning_tpu.training.tp import (
+        make_tp_generate,
+        shard_transformer_params,
+    )
+
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=num_layers, num_heads=num_heads,
+        head_dim=head_dim, max_len=T_prompt + steps, attn_impl="full",
+        num_kv_heads=num_kv_heads, dtype=jnp.bfloat16,
+    )
+    mesh = Mesh(
+        np.array(jax.devices()[:2]).reshape(1, 2), ("data", "model")
+    )
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, vocab, size=(B, T_prompt)), jnp.int32
+    )
+    params = shard_transformer_params(
+        jax.jit(model.init)(jax.random.key(0), prompt)["params"], mesh
+    )
+    gen = make_tp_generate(mesh, model)
+    for n in (1, steps):
+        sync(gen(params, prompt, n))
+    t0 = time.perf_counter()
+    sync(gen(params, prompt, 1))
+    dt_prefill = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sync(gen(params, prompt, steps))
+    dt = time.perf_counter() - t0
+    decode_dt = dt - dt_prefill
+    if decode_dt <= 0.1 * dt_prefill:
+        raise RuntimeError(
+            f"decode window not resolvable: total {dt:.4f}s vs prefill "
+            f"{dt_prefill:.4f}s"
+        )
+    return B * (steps - 1) / decode_dt, dt
+
+
 def run() -> None:
     full = full_scale()
     if full:
@@ -203,6 +251,51 @@ def run() -> None:
                 "KV-cache decode"
             ),
             "seconds_total": round(dt, 3),
+            "platform": platform(),
+        })
+
+    # Tensor-parallel decode (training/tp.py::make_tp_generate): the
+    # head-sharded KV-cache serving path on a (data, model) mesh.  Needs
+    # >= 2 devices — the tunneled chip is single-device, so on it this
+    # emits a skip record; the 8-virtual-device CPU smoke run rot-guards
+    # the path, and a pod slice would measure it for real.
+    n_dev = len(jax.devices())
+    if n_dev >= 2:
+        try:
+            toks, dt = _measure_decode_tp(
+                *(dec_cases[0][1:]), B=kw["B"], vocab=kw["vocab"],
+                num_layers=kw["num_layers"], num_heads=kw["num_heads"],
+                head_dim=kw["head_dim"],
+                num_kv_heads=kw["num_heads"] // 2 or None,
+            )
+            emit({
+                "metric": "lm_decode_tp_tokens_per_sec",
+                "value": round(toks, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": None,
+                "config": (
+                    f"(data=1, model=2) mesh, head-sharded KV cache, "
+                    f"B{kw['B']} L{kw['num_layers']} "
+                    f"H{kw['num_heads']}x{kw['head_dim']}"
+                ),
+                "seconds_total": round(dt, 3),
+                "platform": platform(),
+            })
+        except Exception as e:
+            emit({
+                "metric": "lm_decode_tp_tokens_per_sec",
+                "value": None,
+                "unit": "tokens/sec",
+                "vs_baseline": None,
+                "error": f"{type(e).__name__}: {str(e)[:120]}",
+            })
+    else:
+        emit({
+            "metric": "lm_decode_tp_tokens_per_sec",
+            "value": None,
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "config": "skipped: single device (TP decode needs >= 2)",
             "platform": platform(),
         })
 

@@ -64,7 +64,55 @@ def _np_grad(w, X, y):
 
 
 def run_reference(Xs, ys, iters):
-    """Drive the reference asyncio backend through the notebook recipe."""
+    """Drive the reference asyncio backend through the notebook recipe,
+    in a SUBPROCESS: the reference tree is untrusted public content, so
+    its module-level code never runs in the measuring process — and its
+    asyncio event loop cannot leak state into ours.  Wall-clock is
+    timed inside the child around the run itself (not the interpreter
+    spawn), keeping the comparison fair."""
+    import os
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        inp, out = os.path.join(td, "in.npz"), os.path.join(td, "out.npz")
+        np.savez(inp, Xs=Xs, ys=ys, iters=iters)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "from benchmarks.bench_northstar import _reference_child; "
+                 f"_reference_child({inp!r}, {out!r})"],
+                env=env, capture_output=True, text=True,
+                timeout=900,  # the reference's asyncio loop can stall;
+                              # a hang must surface as an error record
+            )
+        except subprocess.TimeoutExpired as e:
+            raise RuntimeError(
+                f"reference subprocess hung past 900s: "
+                f"{(e.stderr or b'')[-500:]}"
+            ) from e
+        if proc.returncode:
+            raise RuntimeError(
+                f"reference subprocess failed: {proc.stderr[-2000:]}"
+            )
+        rec = np.load(out)
+        return rec["ws"], float(rec["elapsed"])
+
+
+def _reference_child(in_path: str, out_path: str) -> None:
+    """Subprocess body for :func:`run_reference` (child-only import of
+    the reference package)."""
+    rec = np.load(in_path)
+    Xs, ys, iters = rec["Xs"], rec["ys"], int(rec["iters"])
+    ws, elapsed = _run_reference_inproc(Xs, ys, iters)
+    np.savez(out_path, ws=ws, elapsed=elapsed)
+
+
+def _run_reference_inproc(Xs, ys, iters):
     sys.path.insert(0, "/root/reference")
     from utils.consensus_asyncio import ConsensusAgent, ConsensusNetwork
 

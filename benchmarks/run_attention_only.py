@@ -1,10 +1,17 @@
 """Run only the flash-attention benchmark (fwd + bwd TFLOP/s).
 
 Split out of ``run_all`` so the recovery session can put the kernels'
-first on-chip validation ahead of the longer stages.
+first on-chip validation ahead of the longer stages.  ``--quick`` runs
+the single post-fix point (``bench_attention.quick``) instead of the
+full sweep — the <=10-minute record for short healthy windows.
 """
+
+import sys
 
 from benchmarks import bench_attention
 
 if __name__ == "__main__":
-    bench_attention.run()
+    if "--quick" in sys.argv[1:]:
+        bench_attention.quick()
+    else:
+        bench_attention.run()

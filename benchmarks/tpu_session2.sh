@@ -47,6 +47,12 @@ assert "metric" in rec
 print(json.dumps(rec))
 EOF
 
+echo "== stage 1a: flash attention QUICK post-fix point" >&2
+# One fwd+bwd record at the measured-best config in <=10 min: even if
+# the tunnel wedges mid-sweep below, the post-fix kernel has a number.
+BENCH_OUT="$CAPTURE" timeout 900 python -m benchmarks.run_attention_only \
+  --quick 2>"$OUT/attention_quick_$STAMP.err" || echo "stage 1a rc=$?" >&2
+
 echo "== stage 1: flash attention fwd+bwd TFLOP/s (+ upstream rival)" >&2
 # 3600s: the rival pass adds up to 12 compile+measure runs at 8k/32k on
 # top of the original sweep, and the 131k points are minutes each.

@@ -38,7 +38,7 @@ from distributed_learning_tpu.ops.ring_attention import (
     ulysses_attention,
 )
 
-__all__ = ["TransformerLM", "generate"]
+__all__ = ["TransformerLM", "generate", "sample_fn", "validate_sampling"]
 
 
 def _rope(x, positions, *, base: float = 10000.0):
@@ -413,10 +413,23 @@ def generate(
     ``cache`` collection threaded through the scan, so the whole loop
     compiles to one program with static shapes.
     """
-    B, Tp = prompt.shape
-    if Tp + steps > model.max_len:
+    validate_sampling(model, prompt.shape[1], steps, key, temperature,
+                      top_k, top_p)
+    run = _generate_runner(model.clone(decode=True), steps,
+                           float(temperature),
+                           None if top_k is None else int(top_k),
+                           None if top_p is None else float(top_p))
+    return run(params, prompt, key)
+
+
+def validate_sampling(model: "TransformerLM", prompt_len: int, steps: int,
+                      key, temperature: float, top_k: int | None,
+                      top_p: float | None) -> None:
+    """The :func:`generate` argument contract, shared with the
+    tensor-parallel decode path."""
+    if prompt_len + steps > model.max_len:
         raise ValueError(
-            f"prompt ({Tp}) + steps ({steps}) exceeds max_len "
+            f"prompt ({prompt_len}) + steps ({steps}) exceeds max_len "
             f"{model.max_len}"
         )
     if temperature > 0.0 and key is None:
@@ -433,22 +446,15 @@ def generate(
         )
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    run = _generate_runner(model.clone(decode=True), steps,
-                           float(temperature),
-                           None if top_k is None else int(top_k),
-                           None if top_p is None else float(top_p))
-    return run(params, prompt, key)
 
 
-@functools.lru_cache(maxsize=64)
-def _generate_runner(dec: TransformerLM, steps: int, temperature: float,
-                     top_k: int | None = None, top_p: float | None = None):
-    """The jitted prefill+scan program for one (model, steps,
-    temperature, top_k, top_p) configuration.  Cached by the module's
-    (frozen, hashable) dataclass identity so repeated :func:`generate`
-    calls with the same settings reuse the compile instead of
-    re-tracing — jit caches by function object, and a closure built
-    inside ``generate`` would be fresh every call."""
+def sample_fn(temperature: float, top_k: int | None = None,
+              top_p: float | None = None):
+    """Build ``pick(logits, key, dtype) -> token`` for one sampling
+    configuration — greedy argmax at temperature 0, else temperature/
+    top-k/nucleus sampling.  Shared by :func:`generate` and the
+    tensor-parallel decode path (``training/tp.py::make_tp_generate``)
+    so the two cannot drift."""
 
     def pick(logits, k, dtype):
         if temperature <= 0.0:
@@ -473,6 +479,21 @@ def _generate_runner(dec: TransformerLM, steps: int, temperature: float,
             thresh = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
             scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
         return jax.random.categorical(k, scaled, axis=-1).astype(dtype)
+
+    return pick
+
+
+@functools.lru_cache(maxsize=64)
+def _generate_runner(dec: TransformerLM, steps: int, temperature: float,
+                     top_k: int | None = None, top_p: float | None = None):
+    """The jitted prefill+scan program for one (model, steps,
+    temperature, top_k, top_p) configuration.  Cached by the module's
+    (frozen, hashable) dataclass identity so repeated :func:`generate`
+    calls with the same settings reuse the compile instead of
+    re-tracing — jit caches by function object, and a closure built
+    inside ``generate`` would be fresh every call."""
+
+    pick = sample_fn(temperature, top_k, top_p)
 
     @jax.jit
     def _run(params, prompt, key):

@@ -47,6 +47,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["make_pipeline_apply", "make_1f1b_train_step"]
 
 
+def _varying_cast(axes: tuple):
+    """Idempotent invariant->varying cast: adds only the vma axes the
+    value lacks (``lax.pcast`` rejects re-casting an already-varying
+    axis, and values derived from sharded inputs arrive pre-varying)."""
+    def f(x):
+        missing = tuple(
+            a for a in axes
+            if a not in getattr(jax.typeof(x), "vma", ())
+        )
+        return lax.pcast(x, missing, to="varying") if missing else x
+    return f
+
+
 def _manual_axes(stage_axis: str, param_specs: Any) -> frozenset:
     """The mesh axes the pipeline body handles with explicit collectives:
     the stage axis plus every axis a param spec shards over (the TP axes
@@ -144,6 +157,7 @@ def make_pipeline_apply(
     remat_stage: bool = False,
     extra_manual_axes: tuple = (),
     microbatch_spec: P = P(),
+    stage_aux: bool = False,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build ``apply(stage_params, microbatches) -> outputs``.
 
@@ -176,6 +190,21 @@ def make_pipeline_apply(
     shard_map transpose rules supply the Megatron f/g conjugates
     automatically (see the note in ``training/tp.py``).  ``None`` keeps
     the 1D behavior (every leaf ``P(stage_axis)``).
+
+    ``stage_aux=True`` changes the stage contract to ``stage_fn(p, act)
+    -> (act, aux_scalar)`` and the return to ``(outputs, aux)`` where
+    ``aux`` is the mean of the per-(stage, microbatch) scalars — bubble
+    ticks (whose activations are garbage) are masked out, so ``aux``
+    is exactly ``mean_m mean_s aux(s, m)``: with each stage reporting
+    the mean over ITS blocks, that is the per-layer mean of the whole
+    stack, the same statistic ``models/moe.py``'s
+    ``collect_load_balance_loss`` yields on an unpipelined model.
+    Differentiable — add ``coef * aux`` to the loss and autodiff does
+    the rest (this is how ``training/pp_lm.py`` trains MoE routers
+    through the GPipe schedule).  Under pp x sp the aux is additionally
+    averaged over the extra axes (each sequence shard routed only its
+    local tokens — the per-shard mean convention of
+    ``training/spmd_lm.py``).
     """
     S = mesh.shape[stage_axis]
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
@@ -197,17 +226,28 @@ def make_pipeline_apply(
         p = jax.tree.map(lambda a: a[0], stage_params)  # this device's stage
         idx = lax.axis_index(stage_axis)
         M = mbs.shape[0]
-        act0 = jnp.zeros_like(mbs[0])
-        act0 = lax.pcast(act0, (stage_axis,), to="varying")
+        var_full = _varying_cast((stage_axis,) + tuple(extra_manual_axes))
+        act0 = var_full(jnp.zeros_like(mbs[0]))
+        aux0 = var_full(jnp.zeros((), jnp.float32))
 
-        def tick(act, t):
+        def tick(carry, t):
+            act, aux_acc = carry
             # Stage 0 ingests microbatch t during the fill window; other
             # stages keep the activation that just arrived.
             mb_t = lax.dynamic_index_in_dim(
                 mbs, jnp.minimum(t, M - 1), axis=0, keepdims=False
             )
             act = jnp.where((idx == 0) & (t < M), mb_t, act)
-            out = stage_fn(p, act)
+            if stage_aux:
+                out, aux = stage_fn(p, act)
+                # Stage s holds microbatch t-s this tick; outside [0, M)
+                # it is bubble garbage whose aux must not count.
+                mf = t - idx
+                aux_acc = aux_acc + jnp.where(
+                    (mf >= 0) & (mf < M), aux.astype(jnp.float32), 0.0
+                )
+            else:
+                out = stage_fn(p, act)
             # The LAST stage's fresh output is a finished microbatch
             # (valid for ticks t >= S-1); replicate it for collection.
             done = lax.psum(
@@ -215,11 +255,19 @@ def make_pipeline_apply(
                 stage_axis,
             )
             act = lax.ppermute(out, stage_axis, perm_fwd)
-            return act, done
+            return (act, aux_acc), done
 
-        _, dones = lax.scan(tick, act0, jnp.arange(M + S - 1))
+        (_, aux_acc), dones = lax.scan(
+            tick, (act0, aux0), jnp.arange(M + S - 1)
+        )
         # Microbatch m finishes at tick m + S - 1.
-        return dones[S - 1:]
+        outs = dones[S - 1:]
+        if not stage_aux:
+            return outs
+        aux = lax.psum(aux_acc, stage_axis) / (S * M)
+        for ax in extra_manual_axes:
+            aux = lax.pmean(aux, ax)
+        return outs, aux
 
     pspec = P(stage_axis)
 
@@ -237,7 +285,9 @@ def make_pipeline_apply(
             local,
             mesh=mesh,
             in_specs=(specs, microbatch_spec),
-            out_specs=microbatch_spec,
+            out_specs=(
+                (microbatch_spec, P()) if stage_aux else microbatch_spec
+            ),
             axis_names=_manual_axes(stage_axis, param_specs)
             | frozenset(extra_manual_axes),
         )
@@ -263,6 +313,7 @@ def make_1f1b_train_step(
     collect_input_grads: bool = False,
     extra_manual_axes: tuple = (),
     microbatch_spec: P = P(),
+    stage_aux_coef: float | None = None,
 ) -> Callable[..., tuple]:
     """Build ``step(stage_params, microbatches, labels) -> (grads, loss)``
     under the 1F1B schedule.
@@ -317,17 +368,25 @@ def make_1f1b_train_step(
     labels must carry the same rank and token-dim layout as the
     activations (e.g. shifted targets (M, mb, T); per-sequence rank-2
     labels would be rejected by shard_map against the rank-3 spec).
+    With BOTH extensions active the returned ``d_microbatches`` carries
+    ``microbatch_spec`` (each sequence shard's slice of the input
+    cotangent) — the caller's embedding vjp consumes the sharded global
+    array in GSPMD-auto mode, which is exactly how ``pp_lm`` chains it.
     Returns ``(grads[, head_grads][, d_microbatches], loss)``.
+
+    ``stage_aux_coef`` changes the stage contract to ``stage_fn(p, act)
+    -> (act, aux_scalar)`` and adds ``coef * mean_{m,s} aux`` (mean
+    over microbatches and stages; additionally over the extra axes — the
+    per-shard convention of ``training/spmd_lm.py``) to the objective:
+    the backward seeds each stage's aux cotangent with the constant
+    ``coef / (M * S * prod(extra))`` on the same tick as its main
+    backward, so the aux's activation-cotangent rides the ordinary
+    reverse ring through earlier stages and every parameter group sees
+    the exact gradient of the regularized loss (pinned by
+    tests/test_pp_lm_moe.py).  The returned ``loss`` includes the term.
     """
     if (loss_fn is None) == (head_fn is None):
         raise ValueError("exactly one of loss_fn / head_fn is required")
-    if collect_input_grads and extra_manual_axes:
-        raise ValueError(
-            "collect_input_grads with extra_manual_axes is not "
-            "supported: the input cotangents are sharded over the extra "
-            "axes and the collected buffer's replication contract "
-            "cannot hold"
-        )
     S = mesh.shape[stage_axis]
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_bwd = [(i, (i - 1) % S) for i in range(S)]
@@ -341,23 +400,13 @@ def make_1f1b_train_step(
         M = mbs.shape[0]
         B = min(M, 2 * S - 1)  # max in-flight per stage is 2(S-1)+1
 
-        def _cast(axes):
-            def f(x):
-                # Idempotent: add only the axes the value lacks.
-                missing = tuple(
-                    a for a in axes
-                    if a not in getattr(jax.typeof(x), "vma", ())
-                )
-                return lax.pcast(x, missing, to="varying") if missing else x
-            return f
-
         # Stage-only cast for the loss path (the loss is reduced over
         # the extra axes by contract); full cast for everything the
         # activations touch — under pp x sp the act-derived carries and
         # the parameter-gradient accumulators are sequence-varying
         # (per-shard partials), and the scan carry must say so up front.
-        var = _cast((stage_axis,))
-        var_full = _cast((stage_axis,) + tuple(extra_manual_axes))
+        var = _varying_cast((stage_axis,))
+        var_full = _varying_cast((stage_axis,) + tuple(extra_manual_axes))
 
         zero_act = var_full(jnp.zeros_like(mbs[0]))
         carry0 = (
@@ -374,16 +423,18 @@ def make_1f1b_train_step(
             # head-grad accumulator (zeros tree even when unused: the
             # scan carry must be static in structure)
             jax.tree.map(lambda a: var(jnp.zeros_like(a)), head_params),
-            # input-cotangent buffer (1-slot dummy when not collected)
-            var(jnp.zeros(
+            # input-cotangent buffer (1-slot dummy when not collected;
+            # full cast — under pp x sp each shard banks ITS slice)
+            var_full(jnp.zeros(
                 ((M if collect_input_grads else 1),) + mbs.shape[1:],
                 mbs.dtype,
             )),
             var(jnp.zeros((), jnp.float32)),            # loss acc
+            var_full(jnp.zeros((), jnp.float32)),       # stage-aux acc
         )
 
         def tick(carry, t):
-            fwd_in, bwd_in, stash, gacc, hacc, dmbs, lacc = carry
+            fwd_in, bwd_in, stash, gacc, hacc, dmbs, lacc, aacc = carry
             mf = t - idx
             mb = t - (2 * S - 2 - idx)
             fwd_valid = (mf >= 0) & (mf < M)
@@ -395,6 +446,8 @@ def make_1f1b_train_step(
             )
             act_in = jnp.where((idx == 0) & fwd_valid, mb_t, fwd_in)
             fwd_out = stage_fn(p, act_in)
+            if stage_aux_coef is not None:
+                fwd_out, _ = fwd_out  # aux is banked on the bwd recompute
             # Stash this tick's INPUT for the later backward; masked
             # read-modify-write so drain-phase ticks cannot clobber a
             # slot whose activation is still awaiting its backward.
@@ -413,6 +466,11 @@ def make_1f1b_train_step(
                 lax.dynamic_index_in_dim(stash, bslot, keepdims=False),
             )
             out, pb = jax.vjp(stage_fn, p, a_bwd)
+            if stage_aux_coef is not None:
+                out, aux = out
+                aacc = aacc + jnp.where(
+                    bwd_valid, aux.astype(jnp.float32), 0.0
+                )
             y_mb = lax.dynamic_index_in_dim(
                 labels, jnp.clip(mb, 0, M - 1), axis=0, keepdims=False
             )
@@ -432,7 +490,22 @@ def make_1f1b_train_step(
             cot = jnp.where(bwd_valid,
                             jnp.where(is_last, seed, bwd_in),
                             jnp.zeros_like(bwd_in))
-            dp, dact = pb(cot.astype(out.dtype))
+            if stage_aux_coef is not None:
+                # The aux term's whole backward: every stage seeds the
+                # constant d(loss)/d(aux_{m,s}) alongside its main
+                # cotangent — the resulting dact carries the aux's
+                # upstream dependence through the same reverse ring.
+                denom = M * S
+                for ax in extra_manual_axes:
+                    denom *= lax.axis_size(ax)
+                aux_ct = var_full(jnp.where(
+                    bwd_valid,
+                    jnp.asarray(stage_aux_coef / denom, aux.dtype),
+                    jnp.zeros((), aux.dtype),
+                ))
+                dp, dact = pb((cot.astype(out.dtype), aux_ct))
+            else:
+                dp, dact = pb(cot.astype(out.dtype))
             gacc = jax.tree.map(
                 lambda g, d: g + jnp.where(bwd_valid, d, jnp.zeros_like(d)),
                 gacc, dp,
@@ -460,10 +533,13 @@ def make_1f1b_train_step(
                 stage_axis, perm_fwd,
             )
             bwd_next = lax.ppermute(dact, stage_axis, perm_bwd)
-            return (fwd_next, bwd_next, stash, gacc, hacc, dmbs, lacc), None
+            return (fwd_next, bwd_next, stash, gacc, hacc, dmbs, lacc,
+                    aacc), None
 
         ticks = jnp.arange(M + 2 * S - 2)
-        (_, _, _, gacc, hacc, dmbs, lacc), _ = lax.scan(tick, carry0, ticks)
+        (_, _, _, gacc, hacc, dmbs, lacc, aacc), _ = lax.scan(
+            tick, carry0, ticks
+        )
         # Normally a no-op: dp/dhp arrive pre-reduced over the extra
         # axes (invariant-param transpose).  A stage_fn that explicitly
         # pvaries its params opts out of that; total its partials here.
@@ -480,6 +556,11 @@ def make_1f1b_train_step(
             )
         grads = jax.tree.map(lambda g: g[None], gacc)  # (1, ...) local slice
         loss = lax.psum(lacc, stage_axis)  # only the last stage contributes
+        if stage_aux_coef is not None:
+            aux = lax.psum(aacc, stage_axis) / (S * M)
+            for ax in extra_manual_axes:
+                aux = lax.pmean(aux, ax)
+            loss = loss + stage_aux_coef * aux
         outs = [grads]
         if head_fn is not None:
             # Only the last stage accumulated; the psum both totals and
@@ -504,7 +585,9 @@ def make_1f1b_train_step(
         if head_fn is not None:
             out_specs.append(jax.tree.map(lambda _: P(), head_params))
         if collect_input_grads:
-            out_specs.append(P())
+            # Under pp x sp each shard returns its slice of the input
+            # cotangent — same layout as the microbatches themselves.
+            out_specs.append(microbatch_spec)
         out_specs.append(P())
         sharded = jax.shard_map(
             local,

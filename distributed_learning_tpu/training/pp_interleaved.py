@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_learning_tpu.training.pp import (
     _check_param_specs,
     _manual_axes,
+    _varying_cast,
     head_seed,
 )
 
@@ -171,22 +172,26 @@ def build_schedule(S: int, V: int, M: int) -> _Schedule:
         if v < SV - 1:
             yield bwd_done[v + 1] + 1, bwd_done[v]            # cot-in
 
+    # Vectorized over ticks (the per-tick Python loops here used to
+    # dominate build time at production scale): alive[tt, m] says
+    # window m is in flight at tick tt.
+    tts = np.arange(ticks)[:, None]                           # (ticks, 1)
+    alive_mats = []
     slots = 1
     for v in range(SV):
         for st, en in _lifetimes(v):
-            for tt in range(ticks):
-                inflight = int(((st <= tt) & (st >= 0)
-                                & ((en > tt) | (en < 0))).sum())
-                slots = max(slots, inflight)
-    for v in range(SV):
-        for st, en in _lifetimes(v):
-            for tt in range(ticks):
-                alive = np.nonzero(
-                    (st <= tt) & (st >= 0) & ((en > tt) | (en < 0))
-                )[0]
-                assert len({int(m_) % slots for m_ in alive}) == len(
-                    alive
-                ), f"slot collision at v={v} tick={tt}"
+            alive = (
+                (st[None, :] <= tts) & (st[None, :] >= 0)
+                & ((en[None, :] > tts) | (en[None, :] < 0))
+            )                                                 # (ticks, M)
+            alive_mats.append((v, alive))
+            slots = max(slots, int(alive.sum(axis=1).max(initial=0)))
+    mods = np.arange(M) % slots
+    for v, alive in alive_mats:
+        for r in range(slots):
+            assert alive[:, mods == r].sum(axis=1).max(initial=0) <= 1, (
+                f"slot collision at v={v} (residue {r})"
+            )
 
     # A consumable message produced at the final tick would never be
     # filed; the schedule's structure (the last ops are v=0 backwards /
@@ -242,6 +247,9 @@ def make_interleaved_1f1b_train_step(
     param_specs: Any = None,
     head_fn: Callable[[Any, jax.Array, jax.Array], jax.Array] | None = None,
     collect_input_grads: bool = False,
+    extra_manual_axes: tuple = (),
+    microbatch_spec: P = P(),
+    stage_aux_coef: float | None = None,
 ) -> Callable[..., tuple]:
     """Build ``step(stage_params, microbatches, labels) -> (grads, loss)``
     under the interleaved schedule.
@@ -265,6 +273,29 @@ def make_interleaved_1f1b_train_step(
     embedding vjp), so ``training/pp_lm.py`` can bind the TransformerLM
     to this schedule too.  Returns
     ``(grads[, head_grads][, d_microbatches], loss)``.
+
+    ``extra_manual_axes``/``microbatch_spec`` compose the schedule with
+    sequence parallelism and ``stage_aux_coef`` regularizes an
+    aux-returning ``stage_fn`` (``(act, aux_scalar)``), both under
+    exactly the contracts of ``pp.make_1f1b_train_step``; the aux
+    normalization divides by the VIRTUAL stage count ``S*V`` (each
+    chunk reports the mean over its own blocks).
+
+    Executor note: with ``extra_manual_axes`` the per-tick op dispatch
+    switches from ``lax.switch`` to an UNCONDITIONAL fwd+bwd with
+    masked commits (the plain-1F1B structure).  This is load-bearing,
+    not style: a ``ppermute`` (ring attention's K/V rotation) inside a
+    switch branch is executed only by the stage rows whose table entry
+    picks that branch, and collective-permute rendezvouses globally —
+    the stage rows that took the other branch never arrive, which
+    deadlocks (or silently mispairs messages when another branch's
+    permute happens to fill the slot; both reproduced on the CPU
+    backend).  Group-wise collectives (``psum``/``pmean``, e.g. the
+    head's seq reduction or a TP stage's exits) rendezvous per replica
+    group and stay sound inside stage-divergent branches, which is why
+    the default switch path keeps working for pp x tp.  The masked
+    path costs one extra stage forward per tick — the price of keeping
+    every device's collective sequence identical.
     """
     if (loss_fn is None) == (head_fn is None):
         raise ValueError("exactly one of loss_fn / head_fn is required")
@@ -307,14 +338,16 @@ def make_interleaved_1f1b_train_step(
         p = jax.tree.map(lambda a: a[0], stage_params)  # (V, ...) chunks
         idx = lax.axis_index(stage_axis)
 
-        def var(x):
-            if stage_axis in getattr(jax.typeof(x), "vma", ()):
-                return x
-            return lax.pcast(x, (stage_axis,), to="varying")
+        # Same split as pp.py's 1F1B: activation-derived values are
+        # varying over the extra (sequence) axes too, while the grad
+        # accumulators stay stage-only (dp arrives pre-reduced through
+        # the invariant-param transpose).
+        var = _varying_cast((stage_axis,))
+        var_full = _varying_cast((stage_axis,) + tuple(extra_manual_axes))
 
         act_shape = mbs.shape[1:]
-        zero_act = var(jnp.zeros(act_shape, mbs.dtype))
-        zbuf = var(jnp.zeros((V * K,) + act_shape, mbs.dtype))
+        zero_act = var_full(jnp.zeros(act_shape, mbs.dtype))
+        zbuf = var_full(jnp.zeros((V * K,) + act_shape, mbs.dtype))
         carry0 = (
             zero_act,                                    # incoming act
             zero_act,                                    # incoming cot
@@ -325,11 +358,12 @@ def make_interleaved_1f1b_train_step(
             # head-grad accumulator + input-cotangent buffer (dummies
             # when unused: the scan carry structure must be static)
             jax.tree.map(lambda a: var(jnp.zeros_like(a)), head_params),
-            var(jnp.zeros(
+            var_full(jnp.zeros(
                 ((M if collect_input_grads else 1),) + act_shape,
                 mbs.dtype,
             )),
             var(jnp.zeros((), jnp.float32)),             # loss acc
+            var_full(jnp.zeros((), jnp.float32)),        # stage-aux acc
         )
 
         def chunk_params(c):
@@ -349,7 +383,7 @@ def make_interleaved_1f1b_train_step(
             (op_r, ch_r, mb_r, rfv_r, rfc_r, rfs_r, rbv_r, rbc_r,
              rbs_r) = x
             (act_in, cot_in, stash, fbuf, bbuf, gacc, hacc, dmbs,
-             lacc) = carry
+             lacc, aacc) = carry
 
             # 1) File the messages that arrived this tick.
             fbuf = jnp.where(
@@ -374,16 +408,23 @@ def make_interleaved_1f1b_train_step(
                 mb_t = lax.dynamic_index_in_dim(mbs, m, 0, keepdims=False)
                 a_in = jnp.where(v == 0, mb_t, buf_read(fbuf, c, slot))
                 out = stage_fn(pc, a_in)
+                if stage_aux_coef is not None:
+                    out, _ = out  # aux is banked on the bwd recompute
                 new_stash = buf_write(stash, c, slot, a_in)
                 # The last virtual stage's output feeds only its own
                 # (stash-recomputed) backward — nothing to send.
                 send = jnp.where(v == SV - 1, jnp.zeros_like(out), out)
-                return (new_stash, gacc, hacc, dmbs, lacc, send,
+                return (new_stash, gacc, hacc, dmbs, lacc, aacc, send,
                         jnp.zeros_like(zero_act))
 
             def do_bwd(_):
                 a_in = buf_read(stash, c, slot)
                 out, pb = jax.vjp(stage_fn, pc, a_in)
+                if stage_aux_coef is not None:
+                    out, aux = out
+                    new_aacc = aacc + aux.astype(jnp.float32)
+                else:
+                    new_aacc = aacc
                 y_m = lax.dynamic_index_in_dim(labels, m, 0,
                                                keepdims=False)
                 if head_fn is not None:
@@ -394,7 +435,7 @@ def make_interleaved_1f1b_train_step(
                     # predicate and dhp is zeros on every other op.
                     lval, dhp, seed = head_seed(
                         head_fn, var, head_params, out, y_m, M,
-                        v == SV - 1,
+                        v == SV - 1, var_full=var_full,
                     )
                     new_hacc = jax.tree.map(lambda h, d: h + d, hacc, dhp)
                 else:
@@ -402,7 +443,16 @@ def make_interleaved_1f1b_train_step(
                     (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
                     new_hacc = hacc
                 cot = jnp.where(v == SV - 1, seed, buf_read(bbuf, c, slot))
-                dp, dact = pb(cot.astype(out.dtype))
+                if stage_aux_coef is not None:
+                    denom = M * SV
+                    for ax in extra_manual_axes:
+                        denom *= lax.axis_size(ax)
+                    aux_ct = var_full(
+                        jnp.asarray(stage_aux_coef / denom, aux.dtype)
+                    )
+                    dp, dact = pb((cot.astype(out.dtype), aux_ct))
+                else:
+                    dp, dact = pb(cot.astype(out.dtype))
                 new_gacc = jax.tree.map(
                     lambda g, d: lax.dynamic_update_index_in_dim(
                         g,
@@ -428,26 +478,156 @@ def make_interleaved_1f1b_train_step(
                 # Virtual stage 0's cotangent leaves the pipeline.
                 send = jnp.where(v == 0, jnp.zeros_like(dact), dact)
                 return (stash, new_gacc, new_hacc, new_dmbs, new_lacc,
-                        jnp.zeros_like(zero_act), send)
+                        new_aacc, jnp.zeros_like(zero_act), send)
 
             def do_idle(_):
-                return (stash, gacc, hacc, dmbs, lacc,
+                return (stash, gacc, hacc, dmbs, lacc, aacc,
                         jnp.zeros_like(zero_act),
                         jnp.zeros_like(zero_act))
 
-            stash, gacc, hacc, dmbs, lacc, act_out, cot_out = lax.switch(
+            (stash, gacc, hacc, dmbs, lacc, aacc, act_out,
+             cot_out) = lax.switch(
                 o, (do_idle, do_fwd, do_bwd), None
             )
             act_next = lax.ppermute(act_out, stage_axis, perm_fwd)
             cot_next = lax.ppermute(cot_out, stage_axis, perm_bwd)
             return (act_next, cot_next, stash, fbuf, bbuf, gacc, hacc,
-                    dmbs, lacc), None
+                    dmbs, lacc, aacc), None
 
-        (_, _, _, _, _, gacc, hacc, dmbs, lacc), _ = lax.scan(
-            tick, carry0, xs
+        def tick_masked(carry, x):
+            # The extra-axes executor: both micro-steps run EVERY tick
+            # with masked commits, so in-stage global-rendezvous
+            # collectives (ring attention's ppermute) stay aligned
+            # across stage rows — see the builder docstring.  Same
+            # table, same commits, no lax.switch.
+            (op_r, ch_r, mb_r, rfv_r, rfc_r, rfs_r, rbv_r, rbc_r,
+             rbs_r) = x
+            (act_in, cot_in, stash, fbuf, bbuf, gacc, hacc, dmbs,
+             lacc, aacc) = carry
+
+            fbuf = jnp.where(
+                rfv_r[idx],
+                buf_write(fbuf, rfc_r[idx], rfs_r[idx], act_in),
+                fbuf,
+            )
+            bbuf = jnp.where(
+                rbv_r[idx],
+                buf_write(bbuf, rbc_r[idx], rbs_r[idx], cot_in),
+                bbuf,
+            )
+
+            o = op_r[idx]
+            c = ch_r[idx]
+            m = mb_r[idx]
+            v = c * S + idx
+            slot = m % K
+            pc = chunk_params(c)
+            is_f = o == 1
+            is_b = o == 2
+
+            # --- forward micro-step (committed only when is_f) ---
+            mb_t = lax.dynamic_index_in_dim(mbs, m, 0, keepdims=False)
+            a_in = jnp.where(v == 0, mb_t, buf_read(fbuf, c, slot))
+            out_f = stage_fn(pc, a_in)
+            if stage_aux_coef is not None:
+                out_f, _ = out_f  # aux is banked on the bwd recompute
+            stash = jnp.where(
+                is_f, buf_write(stash, c, slot, a_in), stash
+            )
+            act_out = jnp.where(
+                is_f & (v != SV - 1), out_f, jnp.zeros_like(out_f)
+            )
+
+            # --- backward micro-step (committed only when is_b; the
+            # stash write above cannot clobber it — a tick is fwd OR
+            # bwd, so when is_b the stash kept its old slot) ---
+            a_b = buf_read(stash, c, slot)
+            out_b, pb = jax.vjp(stage_fn, pc, a_b)
+            if stage_aux_coef is not None:
+                out_b, aux = out_b
+                aacc = aacc + jnp.where(
+                    is_b, aux.astype(jnp.float32), 0.0
+                )
+            y_m = lax.dynamic_index_in_dim(labels, m, 0, keepdims=False)
+            if head_fn is not None:
+                lval, dhp, seed = head_seed(
+                    head_fn, var, head_params, out_b, y_m, M,
+                    is_b & (v == SV - 1), var_full=var_full,
+                )
+                hacc = jax.tree.map(lambda h, d: h + d, hacc, dhp)
+            else:
+                lval, lpb = jax.vjp(lambda oo: loss_fn(oo, y_m), out_b)
+                (seed,) = lpb(var(jnp.full((), 1.0 / M, lval.dtype)))
+            cot = jnp.where(
+                is_b,
+                jnp.where(v == SV - 1, seed, buf_read(bbuf, c, slot)),
+                jnp.zeros_like(out_b),
+            )
+            if stage_aux_coef is not None:
+                denom = M * SV
+                for ax in extra_manual_axes:
+                    denom *= lax.axis_size(ax)
+                aux_ct = var_full(jnp.where(
+                    is_b,
+                    jnp.asarray(stage_aux_coef / denom, aux.dtype),
+                    jnp.zeros((), aux.dtype),
+                ))
+                dp, dact = pb((cot.astype(out_b.dtype), aux_ct))
+            else:
+                dp, dact = pb(cot.astype(out_b.dtype))
+            gacc = jax.tree.map(
+                lambda g, d: lax.dynamic_update_index_in_dim(
+                    g,
+                    lax.dynamic_index_in_dim(g, c, 0, keepdims=False)
+                    + jnp.where(is_b, d, jnp.zeros_like(d)),
+                    c, 0,
+                ),
+                gacc, dp,
+            )
+            if collect_input_grads:
+                old_i = lax.dynamic_index_in_dim(dmbs, m, 0,
+                                                 keepdims=False)
+                dmbs = lax.dynamic_update_index_in_dim(
+                    dmbs,
+                    jnp.where(is_b & (v == 0),
+                              dact.astype(dmbs.dtype), old_i),
+                    m, 0,
+                )
+            lacc = lacc + jnp.where(
+                is_b & (v == SV - 1), lval.astype(jnp.float32) / M, 0.0
+            )
+            cot_out = jnp.where(
+                is_b & (v != 0), dact, jnp.zeros_like(dact)
+            )
+
+            act_next = lax.ppermute(act_out, stage_axis, perm_fwd)
+            cot_next = lax.ppermute(cot_out, stage_axis, perm_bwd)
+            return (act_next, cot_next, stash, fbuf, bbuf, gacc, hacc,
+                    dmbs, lacc, aacc), None
+
+        (_, _, _, _, _, gacc, hacc, dmbs, lacc, aacc), _ = lax.scan(
+            tick_masked if extra_manual_axes else tick, carry0, xs
         )
+        # Safety net (normally a no-op — see pp.py): total any grad
+        # partials a pvarying stage_fn left unreduced over the extras.
+        for ax in extra_manual_axes:
+            gacc = jax.tree.map(
+                lambda g: lax.psum(g, ax)
+                if ax in getattr(jax.typeof(g), "vma", ()) else g,
+                gacc,
+            )
+            hacc = jax.tree.map(
+                lambda h: lax.psum(h, ax)
+                if ax in getattr(jax.typeof(h), "vma", ()) else h,
+                hacc,
+            )
         grads = jax.tree.map(lambda g: g[None], gacc)
         loss = lax.psum(lacc, stage_axis)
+        if stage_aux_coef is not None:
+            aux = lax.psum(aacc, stage_axis) / (SV * M)
+            for ax in extra_manual_axes:
+                aux = lax.pmean(aux, ax)
+            loss = loss + stage_aux_coef * aux
         outs = [grads]
         if head_fn is not None:
             outs.append(jax.tree.map(
@@ -485,14 +665,15 @@ def make_interleaved_1f1b_train_step(
         if head_fn is not None:
             out_specs.append(jax.tree.map(lambda _: P(), head_params))
         if collect_input_grads:
-            out_specs.append(P())
+            out_specs.append(microbatch_spec)
         out_specs.append(P())
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(specs, P(), P(), P()),
+            in_specs=(specs, P(), microbatch_spec, microbatch_spec),
             out_specs=tuple(out_specs),
-            axis_names=_manual_axes(stage_axis, param_specs),
+            axis_names=_manual_axes(stage_axis, param_specs)
+            | frozenset(extra_manual_axes),
         )
         stage_params = jax.tree.map(
             lambda a, sp: jax.lax.with_sharding_constraint(

@@ -98,7 +98,18 @@ def make_gossip_lm_step(
             if aux is not None:
                 # Each seq shard routed only its local tokens; dividing
                 # by the axis size makes the psum'd term the coefficient
-                # times the MEAN aux across shards.
+                # times the MEAN aux across shards.  NOTE this is the
+                # PER-SHARD approximation of the Switch statistic, not
+                # the global-batch ``E * sum(f_e * P_e)`` the fsdp/tp
+                # paths compute on unsharded tokens (a mean of per-shard
+                # products is not the product of global means) — the
+                # same convention as the pp x sp paths (``training/
+                # pp.py``), chosen because routing itself is per-shard
+                # here: capacity drops apply within each shard's tokens,
+                # so the per-shard statistic is the one the router
+                # actually experiences.  Coefficients tuned on one
+                # builder family transfer to the other only up to this
+                # distinction.
                 loss = loss + moe_aux_coef * aux / lax.axis_size(seq_axis)
             return loss
 

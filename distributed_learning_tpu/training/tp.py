@@ -30,6 +30,7 @@ parallel LM step over a ``(data, model)`` mesh: batch sharded over
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Tuple
 
 import jax
@@ -37,7 +38,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["transformer_tp_rules", "shard_transformer_params",
-           "make_tp_train_step"]
+           "make_tp_train_step", "make_tp_generate"]
 
 # NOTE on hand-written (shard_map) megatron regions: no explicit
 # Megatron f/g conjugate operators (arXiv:1909.08053 §3) are needed
@@ -219,3 +220,133 @@ def make_tp_train_step(
         return constrain_params(params), constrain_opt(opt_state, params), loss
 
     return step
+
+
+@functools.lru_cache(maxsize=32)
+def _tp_generate_runner(dec, steps: int, temperature: float,
+                        top_k, top_p, mesh: Mesh,
+                        data_axis: str, model_axis: str):
+    """Jitted tensor-parallel prefill+scan decode program, cached like
+    ``models/transformer.py::_generate_runner`` (flax modules and Mesh
+    are both hashable)."""
+    from distributed_learning_tpu.models.transformer import sample_fn
+
+    pick = sample_fn(temperature, top_k, top_p)
+    n_model = mesh.shape[model_axis]
+    n_data = mesh.shape[data_axis]
+
+    def constrain_cache(state):
+        """Pin the KV cache to the head split every step: ``key``/
+        ``value`` are (B, L, Hkv, Dh) — batch over data, heads over
+        model (replicated when Hkv doesn't divide, mirroring
+        ``_divisible_or_replicated``); the index/pos counters
+        replicate.  Without the constraint the scan carry is at the
+        partitioner's mercy and a single all-gather choice would
+        replicate the cache — the memory TP decode exists to shard."""
+        def place(path, leaf):
+            name = getattr(path[-1], "key", None)
+            if name in ("key", "value") and leaf.ndim == 4:
+                heads_ok = leaf.shape[2] % n_model == 0
+                batch_ok = leaf.shape[0] % n_data == 0
+                spec = P(
+                    data_axis if batch_ok else None,
+                    None,
+                    model_axis if heads_ok else None,
+                    None,
+                )
+            else:
+                spec = P()
+            return jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec)
+            )
+
+        return jax.tree_util.tree_map_with_path(place, state)
+
+    def constrain_params(params):
+        def place(path, leaf):
+            spec = _divisible_or_replicated(
+                transformer_tp_rules(path, leaf, model_axis),
+                leaf, mesh, model_axis,
+            )
+            return jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec)
+            )
+
+        return jax.tree_util.tree_map_with_path(place, params)
+
+    @jax.jit
+    def _run(params, prompt, key):
+        params = constrain_params(params)
+        if prompt.shape[0] % n_data == 0:
+            prompt = jax.lax.with_sharding_constraint(
+                prompt, NamedSharding(mesh, P(data_axis, None))
+            )
+        logits, state = dec.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        state = constrain_cache(state)
+        key0 = key if key is not None else jax.random.key(0)
+        k_first, k_scan = jax.random.split(key0)
+        tok = pick(logits[:, -1], k_first, prompt.dtype)
+
+        def step(carry, k_t):
+            cache, tok = carry
+            logits, st = dec.apply(
+                {"params": params, "cache": cache["cache"]},
+                tok[:, None], mutable=["cache"],
+            )
+            st = constrain_cache(st)
+            nxt = pick(logits[:, -1], k_t, tok.dtype)
+            return (st, nxt), tok
+
+        keys = jax.random.split(k_scan, steps)
+        _, toks = jax.lax.scan(step, (state, tok), keys)
+        return toks.T
+
+    return _run
+
+
+def make_tp_generate(
+    mesh: Mesh,
+    model: Any,
+    *,
+    data_axis: str = "data",
+    model_axis: str = "model",
+) -> Callable[..., jax.Array]:
+    """Tensor-parallel autoregressive generation on a (data, model)
+    mesh: the KV cache and Q/KV projections shard over HEADS on the
+    model axis (the same megatron split training uses, so a trained
+    sharded checkpoint serves without resharding), the cache's batch
+    dim over data.  GQA's Hkv-head cache shards whenever Hkv divides
+    the axis; otherwise it falls back to replicated-KV with sharded
+    query heads — still the memory win over MHA, never a crash
+    (``_divisible_or_replicated``'s contract).
+
+    Returns ``gen(params, prompt, steps, *, key=None, temperature=0.0,
+    top_k=None, top_p=None) -> (B, steps) tokens``, exact-match to the
+    single-device :func:`~distributed_learning_tpu.models.transformer.
+    generate` (pinned by tests/test_tp_decode.py).  The reference has
+    no serving path at all (SURVEY.md §2 — its models stop at training
+    notebooks); this is the framework's decode story scaled past one
+    chip.
+    """
+    from distributed_learning_tpu.models.transformer import (
+        validate_sampling,
+    )
+
+    dec = model.clone(decode=True)
+
+    def gen(params, prompt, steps, *, key=None, temperature=0.0,
+            top_k=None, top_p=None):
+        validate_sampling(model, prompt.shape[1], int(steps), key,
+                          float(temperature), top_k, top_p)
+        run = _tp_generate_runner(
+            dec, int(steps), float(temperature),
+            None if top_k is None else int(top_k),
+            None if top_p is None else float(top_p),
+            mesh, data_axis, model_axis,
+        )
+        with mesh:
+            return run(params, prompt, key)
+
+    return gen

@@ -148,7 +148,7 @@ def test_two_process_initialize_and_local_agents():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=600)
             outs.append(out)
     finally:
         for p in procs:
@@ -242,6 +242,133 @@ def test_ring_order_multiprocess_single_slice_groups_by_process():
     order = order_devices_for_ring(shuffled)
     _assert_slices_contiguous(order)
     assert _cross_slice_ring_edges(order) == 2
+
+
+_WORKER4 = r"""
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from distributed_learning_tpu.parallel import multihost
+
+coordinator, pid = sys.argv[1], int(sys.argv[2])
+multihost.initialize(coordinator, num_processes=4, process_id=pid)
+
+assert jax.process_count() == 4, jax.process_count()
+devices = jax.devices()
+assert len(devices) == 8, devices
+
+mesh = multihost.hybrid_agent_mesh()
+flat = list(np.asarray(mesh.devices).ravel())
+assert [d.process_index for d in flat] == [0, 0, 1, 1, 2, 2, 3, 3], flat
+local = multihost.process_local_agents(mesh)
+assert local == (2 * pid, 2 * pid + 1), (pid, local)
+
+# One SPMD gossip program spanning all four processes: the ring ppermute
+# crosses three process boundaries; eps-stopped mixing must still reach
+# the exact global mean.
+import jax.numpy as jnp
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+
+W = Topology.ring(8).metropolis_weights()
+x0 = jnp.asarray(
+    np.random.default_rng(0).normal(size=(8, 8)).astype(np.float32)
+)
+mean = np.asarray(x0).mean(axis=0)
+eng = ConsensusEngine(W, mesh=mesh)
+out, rounds, res = eng.mix_until(eng.shard(x0), eps=1e-5, max_rounds=800)
+assert float(res) < 1e-5, float(res)
+assert float(jnp.max(jnp.abs(out - mean[None]))) < 1e-3
+
+# Traced-W mixing over a denser runtime graph on the same mesh.
+W2 = Topology.erdos_renyi(8, 0.6, seed=3).metropolis_weights()
+m2 = eng.mix_with(out, W2, times=2, route="allgather")
+jax.block_until_ready(m2)
+
+print(f"OK-MH4 {pid}", flush=True)
+"""
+
+
+def test_four_process_gossip():
+    """Four CPU processes x two devices each — the >2-process control
+    plane VERDICT r4 next-#6 asks for: initialize, hybrid mesh ordering
+    across four process boundaries, and eps-stopped gossip reaching the
+    global mean through three DCN-analog hops."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER4, coordinator, str(pid)],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(4)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"OK-MH4 {pid}" in out
+
+
+def test_hybrid_agent_mesh_two_slice_schedule_dcn_hops(monkeypatch):
+    """End-to-end on a MOCKED 2-slice topology (VERDICT r4 next-#6):
+    ``hybrid_agent_mesh`` built from a shuffled fake device set must
+    order the mesh so the ring topology's edge-colored ppermute
+    schedule (``parallel/schedule.py``) pays exactly n_slices = 2 DCN
+    hops per full round — the minimum a closed ring can pay — with
+    every other matched pair staying intra-slice (ICI)."""
+    from distributed_learning_tpu.parallel.multihost import (
+        hybrid_agent_mesh,
+    )
+    from distributed_learning_tpu.parallel.schedule import (
+        MatchingSchedule,
+    )
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    devs = [_FakeDev(p, p, p * 4 + i) for p in range(2) for i in range(4)]
+    rng = np.random.default_rng(7)
+    shuffled = [devs[i] for i in rng.permutation(len(devs))]
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: shuffled)
+
+    mesh = hybrid_agent_mesh()
+    order = list(np.asarray(mesh.devices).ravel())
+    _assert_slices_contiguous(order)
+
+    sched = MatchingSchedule.from_topology(Topology.ring(8))
+    slice_of = lambda d: (d.process_index, d.slice_index or 0)
+    dcn = intra = 0
+    for matching in sched.matchings:
+        for i, j in matching:
+            if slice_of(order[i]) != slice_of(order[j]):
+                dcn += 1
+            else:
+                intra += 1
+    # A ring's matchings cover each of the 8 undirected edges exactly
+    # once per full round; on the ordered mesh exactly the two
+    # slice-boundary edges cross DCN.
+    assert dcn + intra == 8, (dcn, intra)
+    assert dcn == 2, (dcn, [slice_of(d) for d in order])
 
 
 def test_hybrid_agent_mesh_uses_ring_order():

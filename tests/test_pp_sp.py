@@ -157,10 +157,26 @@ def test_pp_sp_trains_with_optax():
     assert float(loss) < float(l0)
 
 
-def test_pp_sp_refuses_input_grad_collection():
+def test_pp_sp_collects_input_grads():
+    """Input-cotangent collection under pp x sp (the pp_lm embedding
+    chain): each seq shard banks ITS slice and the returned global
+    d_microbatches equals the unsharded input gradient."""
     mesh = _mesh()
-    with pytest.raises(ValueError, match="extra_manual_axes"):
-        make_1f1b_train_step(
-            mesh, _stage_sp, _loss_fn, extra_manual_axes=("seq",),
-            microbatch_spec=MB_SPEC, collect_input_grads=True,
+    params = _params(6)
+    x, y = _xy(7)
+    step = make_1f1b_train_step(
+        mesh, _stage_sp, _loss_fn, extra_manual_axes=("seq",),
+        microbatch_spec=MB_SPEC, collect_input_grads=True,
+    )
+    with mesh:
+        grads, dx, loss = step(params, _shard(mesh, x), _shard(mesh, y))
+    assert dx.shape == x.shape
+    ref_dx = jax.grad(_ref_loss, argnums=1)(params, x, y)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(ref_dx),
+                               rtol=2e-4, atol=2e-3)
+    ref_grads = jax.grad(_ref_loss)(params, x, y)
+    for k in grads:
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=2e-4, atol=2e-3, err_msg=k,
         )
