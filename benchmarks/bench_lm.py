@@ -104,17 +104,26 @@ def _measure_decode(
         rng.integers(0, vocab, size=(B, T_prompt)), jnp.int32
     )
     params = jax.jit(model.init)(jax.random.key(0), prompt)["params"]
-    # Subtract the prefill (one O(T^2) forward, identical across
-    # configurations) from the timed window so the reported rate is the
-    # steady-state single-token decode loop — the quantity the MHA/GQA
-    # comparison is about.  steps=1 ≈ prefill + one step.
+    return _time_decode(
+        lambda p, n: generate(model, params, p, n), prompt, steps
+    )
+
+
+def _time_decode(gen_fn, prompt, steps: int) -> tuple[float, float]:
+    """Prefill-subtracted decode timing, shared by the single-device and
+    tensor-parallel paths so the MHA/GQA-vs-TP comparison uses ONE
+    protocol.  Subtract the prefill (one O(T^2) forward, identical
+    across configurations) from the timed window so the reported rate
+    is the steady-state single-token decode loop; steps=1 ≈ prefill +
+    one step."""
+    B = prompt.shape[0]
     for n in (1, steps):
-        sync(generate(model, params, prompt, n))  # compile both programs
+        sync(gen_fn(prompt, n))  # compile both programs
     t0 = time.perf_counter()
-    sync(generate(model, params, prompt, 1))
+    sync(gen_fn(prompt, 1))
     dt_prefill = time.perf_counter() - t0
     t0 = time.perf_counter()
-    sync(generate(model, params, prompt, steps))
+    sync(gen_fn(prompt, steps))
     dt = time.perf_counter() - t0
     decode_dt = dt - dt_prefill
     if decode_dt <= 0.1 * dt_prefill:
@@ -159,21 +168,9 @@ def _measure_decode_tp(
         jax.jit(model.init)(jax.random.key(0), prompt)["params"], mesh
     )
     gen = make_tp_generate(mesh, model)
-    for n in (1, steps):
-        sync(gen(params, prompt, n))
-    t0 = time.perf_counter()
-    sync(gen(params, prompt, 1))
-    dt_prefill = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    sync(gen(params, prompt, steps))
-    dt = time.perf_counter() - t0
-    decode_dt = dt - dt_prefill
-    if decode_dt <= 0.1 * dt_prefill:
-        raise RuntimeError(
-            f"decode window not resolvable: total {dt:.4f}s vs prefill "
-            f"{dt_prefill:.4f}s"
-        )
-    return B * (steps - 1) / decode_dt, dt
+    return _time_decode(
+        lambda p, n: gen(params, p, n), prompt, steps
+    )
 
 
 def run() -> None:

@@ -93,7 +93,7 @@ def run_reference(Xs, ys, iters):
         except subprocess.TimeoutExpired as e:
             raise RuntimeError(
                 f"reference subprocess hung past 900s: "
-                f"{(e.stderr or b'')[-500:]}"
+                f"{(e.stderr or '')[-500:]}"
             ) from e
         if proc.returncode:
             raise RuntimeError(

@@ -106,6 +106,29 @@ class MoEMLP(nn.Module):
     top_k: int = 1
     drop_tokens: bool = True
     dtype: jnp.dtype = jnp.float32
+    # MANUAL expert parallelism (for shard_map contexts — the pipeline's
+    # stages, where GSPMD auto-sharding can't reach): when set, this
+    # module's expert kernels hold only the LOCAL E/n shard (the caller
+    # shards the stacked (E, ...) kernels over the axis), routing is
+    # computed against the GLOBAL expert set from the replicated gate,
+    # each shard runs its own experts on the (replicated) tokens, and
+    # one ``lax.psum`` over the axis combines — no all-to-all at all,
+    # because tokens are replicated across the expert axis here (the
+    # pp x ep layout).  ``None`` keeps the GSPMD-auto formulation the
+    # fsdp/tp/data-sharded paths use.
+    expert_axis: str | None = None
+
+    def _local_experts(self, E: int) -> tuple[int, int]:
+        """(E_local, my first global expert index) under manual ep."""
+        if self.expert_axis is None:
+            return E, 0
+        n = jax.lax.axis_size(self.expert_axis)
+        if E % n:
+            raise ValueError(
+                f"num_experts {E} must divide the {self.expert_axis!r} "
+                f"axis size {n}"
+            )
+        return E // n, jax.lax.axis_index(self.expert_axis) * (E // n)
 
     @nn.compact
     def __call__(self, x):
@@ -180,22 +203,35 @@ class MoEMLP(nn.Module):
             g[:, None, None] * dsp for g, dsp in zip(gates, dispatches)
         )
 
+        # Manual ep: routing above used the GLOBAL expert set; this
+        # shard computes only its E/n experts, so slice its columns of
+        # the dispatch/combine tensors and declare the LOCAL kernels.
+        E_loc, e0 = self._local_experts(E)
+        disp_total = jnp.sum(dispatch)  # global (pre-slice) kept count
+        if self.expert_axis is not None:
+            dispatch = jax.lax.dynamic_slice_in_dim(dispatch, e0, E_loc, 1)
+            combine_w = jax.lax.dynamic_slice_in_dim(combine_w, e0, E_loc, 1)
+
         # Expert buffers: (E, C, d) — the all-to-all XLA inserts when
-        # tokens are data-sharded and experts expert-sharded.
+        # tokens are data-sharded and experts expert-sharded (under
+        # manual ep tokens are replicated across the axis, so this is
+        # pure local compute instead).
         buffers = jnp.einsum("sec,sd->ecd", dispatch,
                              tokens.astype(jnp.float32))
 
         h = self.mlp_ratio * d
         w_up = self.param(
-            "w_up", nn.initializers.lecun_normal(batch_axis=(0,)), (E, d, h),
-            self.dtype,
+            "w_up", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E_loc, d, h), self.dtype,
         )
-        b_up = self.param("b_up", nn.initializers.zeros, (E, h), self.dtype)
+        b_up = self.param("b_up", nn.initializers.zeros, (E_loc, h),
+                          self.dtype)
         w_dn = self.param(
-            "w_dn", nn.initializers.lecun_normal(batch_axis=(0,)), (E, h, d),
-            self.dtype,
+            "w_dn", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E_loc, h, d), self.dtype,
         )
-        b_dn = self.param("b_dn", nn.initializers.zeros, (E, d), self.dtype)
+        b_dn = self.param("b_dn", nn.initializers.zeros, (E_loc, d),
+                          self.dtype)
 
         act = jnp.einsum("ecd,edh->ech", buffers, w_up.astype(jnp.float32))
         act = nn.gelu(act + b_up.astype(jnp.float32)[:, None, :])
@@ -203,11 +239,17 @@ class MoEMLP(nn.Module):
         out_e = out_e + b_dn.astype(jnp.float32)[:, None, :]
 
         # Combine with the gate-weighted tensor: out_s = sum over the
-        # token's kept choices of gate_j * expert_out.
+        # token's kept choices of gate_j * expert_out.  Under manual ep
+        # each shard contributes its experts' share; the psum exit is
+        # the whole combine (and, like the TP stages, transposes to the
+        # correct cotangent broadcast automatically — training/tp.py's
+        # NOTE).
         out = jnp.einsum("sec,ecd->sd", combine_w, out_e)
+        if self.expert_axis is not None:
+            out = jax.lax.psum(out, self.expert_axis)
         self.sow(
             "moe_stats", "dropped_fraction",
-            1.0 - jnp.sum(dispatch) / (S * self.top_k),
+            1.0 - disp_total / (S * self.top_k),
             reduce_fn=lambda a, b: b,
         )
         return out.reshape(B, T, d).astype(x.dtype)
@@ -225,17 +267,21 @@ class MoEMLP(nn.Module):
         """
         h = self.mlp_ratio * d
         # Declare the SAME params as the dropping branch (names, shapes,
-        # initializers) so a drop-free module inits/shards identically.
+        # initializers) so a drop-free module inits/shards identically
+        # (LOCAL shard shapes under manual ep, exactly as there).
+        E_loc, e0 = self._local_experts(E)
         w_up = self.param(
             "w_up", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (E, d, h), self.dtype,
+            (E_loc, d, h), self.dtype,
         )
-        b_up = self.param("b_up", nn.initializers.zeros, (E, h), self.dtype)
+        b_up = self.param("b_up", nn.initializers.zeros, (E_loc, h),
+                          self.dtype)
         w_dn = self.param(
             "w_dn", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (E, h, d), self.dtype,
+            (E_loc, h, d), self.dtype,
         )
-        b_dn = self.param("b_dn", nn.initializers.zeros, (E, d), self.dtype)
+        b_dn = self.param("b_dn", nn.initializers.zeros, (E_loc, d),
+                          self.dtype)
         act = jnp.einsum(
             "sd,edh->seh", tokens.astype(jnp.float32),
             w_up.astype(jnp.float32),
@@ -246,8 +292,12 @@ class MoEMLP(nn.Module):
         ) + b_dn.astype(jnp.float32)[None]
         weight = sum(
             g[:, None] * oh for g, oh in zip(gates, onehots)
-        )  # (S, E)
+        )  # (S, E) over the GLOBAL experts; slice this shard's columns.
+        if self.expert_axis is not None:
+            weight = jax.lax.dynamic_slice_in_dim(weight, e0, E_loc, 1)
         out = jnp.einsum("se,sed->sd", weight, out_e)
+        if self.expert_axis is not None:
+            out = jax.lax.psum(out, self.expert_axis)
         self.sow(
             "moe_stats", "dropped_fraction", jnp.zeros(()),
             reduce_fn=lambda a, b: b,

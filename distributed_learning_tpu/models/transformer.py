@@ -258,6 +258,7 @@ class _Block(nn.Module):
     rope: bool = False
     num_kv_heads: int | None = None
     dropout_rate: float = 0.0
+    moe_expert_axis: str | None = None  # manual ep (models/moe.py)
 
     @nn.compact
     def __call__(self, x, positions=None, train: bool = False):
@@ -284,6 +285,7 @@ class _Block(nn.Module):
                 num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
                 top_k=self.moe_top_k, dtype=self.dtype,
                 drop_tokens=not self.decode,
+                expert_axis=self.moe_expert_axis,
             )(h))
         if self.mlp != "dense":
             raise ValueError(f"unknown mlp {self.mlp!r} (want dense|moe)")

@@ -47,6 +47,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["make_pipeline_apply", "make_1f1b_train_step"]
 
 
+def _aux_seed_value(coef: float, n_microbatches: int, n_stages: int,
+                    extra_manual_axes: tuple) -> float:
+    """The constant aux cotangent d(loss)/d(aux_{m,s}) = coef / (M * S *
+    prod(extra axis sizes)) — ONE definition of the regularized
+    objective's normalization shared by every schedule executor (pp.py
+    and both pp_interleaved paths), so they cannot drift.  Trace-time
+    constant (axis sizes are static inside shard_map)."""
+    denom = n_microbatches * n_stages
+    for ax in extra_manual_axes:
+        denom *= lax.axis_size(ax)
+    return coef / denom
+
+
 def _varying_cast(axes: tuple):
     """Idempotent invariant->varying cast: adds only the vma axes the
     value lacks (``lax.pcast`` rejects re-casting an already-varying
@@ -495,12 +508,11 @@ def make_1f1b_train_step(
                 # constant d(loss)/d(aux_{m,s}) alongside its main
                 # cotangent — the resulting dact carries the aux's
                 # upstream dependence through the same reverse ring.
-                denom = M * S
-                for ax in extra_manual_axes:
-                    denom *= lax.axis_size(ax)
                 aux_ct = var_full(jnp.where(
                     bwd_valid,
-                    jnp.asarray(stage_aux_coef / denom, aux.dtype),
+                    jnp.asarray(_aux_seed_value(
+                        stage_aux_coef, M, S, extra_manual_axes
+                    ), aux.dtype),
                     jnp.zeros((), aux.dtype),
                 ))
                 dp, dact = pb((cot.astype(out.dtype), aux_ct))

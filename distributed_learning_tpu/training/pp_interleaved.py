@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_learning_tpu.training.pp import (
+    _aux_seed_value,
     _check_param_specs,
     _manual_axes,
     _varying_cast,
@@ -444,12 +445,11 @@ def make_interleaved_1f1b_train_step(
                     new_hacc = hacc
                 cot = jnp.where(v == SV - 1, seed, buf_read(bbuf, c, slot))
                 if stage_aux_coef is not None:
-                    denom = M * SV
-                    for ax in extra_manual_axes:
-                        denom *= lax.axis_size(ax)
-                    aux_ct = var_full(
-                        jnp.asarray(stage_aux_coef / denom, aux.dtype)
-                    )
+                    aux_ct = var_full(jnp.asarray(
+                        _aux_seed_value(stage_aux_coef, M, SV,
+                                        extra_manual_axes),
+                        aux.dtype,
+                    ))
                     dp, dact = pb((cot.astype(out.dtype), aux_ct))
                 else:
                     dp, dact = pb(cot.astype(out.dtype))
@@ -564,12 +564,11 @@ def make_interleaved_1f1b_train_step(
                 jnp.zeros_like(out_b),
             )
             if stage_aux_coef is not None:
-                denom = M * SV
-                for ax in extra_manual_axes:
-                    denom *= lax.axis_size(ax)
                 aux_ct = var_full(jnp.where(
                     is_b,
-                    jnp.asarray(stage_aux_coef / denom, aux.dtype),
+                    jnp.asarray(_aux_seed_value(
+                        stage_aux_coef, M, SV, extra_manual_axes
+                    ), aux.dtype),
                     jnp.zeros((), aux.dtype),
                 ))
                 dp, dact = pb((cot.astype(out_b.dtype), aux_ct))

@@ -157,7 +157,8 @@ class _LMParts:
       ``stage_aux_coef`` in pp.py / pp_interleaved.py).
     """
 
-    def __init__(self, mesh: Mesh, model, stage_axis: str):
+    def __init__(self, mesh: Mesh, model, stage_axis: str,
+                 expert_axis: str | None = None):
         reject_dropout_model(model)
         if model.attn_impl not in (
             "full", "flash", "ring", "ring_flash", "ulysses"
@@ -174,6 +175,24 @@ class _LMParts:
                 f"{model.seq_axis!r}; the mesh has {mesh.axis_names}"
             )
         self.moe = model.mlp == "moe"
+        if expert_axis is not None:
+            if not self.moe:
+                raise ValueError(
+                    "expert_axis needs mlp='moe' — a dense LM has no "
+                    "expert kernels to shard"
+                )
+            if expert_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"expert_axis {expert_axis!r} is not on the mesh "
+                    f"{mesh.axis_names}"
+                )
+            if model.num_experts % mesh.shape[expert_axis]:
+                raise ValueError(
+                    f"num_experts {model.num_experts} must divide the "
+                    f"{expert_axis!r} axis size {mesh.shape[expert_axis]}"
+                )
+        self.expert_axis = expert_axis
+        self.stage_axis = stage_axis
         self.S = mesh.shape[stage_axis]
         L = model.num_layers
         if L % self.S:
@@ -190,6 +209,7 @@ class _LMParts:
             model.mlp, model.num_experts, model.moe_top_k,
             model.attn_window, False, model.max_len,
             self.use_rope, model.num_kv_heads, 0.0,
+            moe_expert_axis=expert_axis,
         )
         use_rope = self.use_rope
         sp, seq_axis, moe = self.sp, self.seq_axis, self.moe
@@ -241,6 +261,53 @@ class _LMParts:
         # (M, mb, T[, d]): dim 2 is the token dim for both the embedded
         # activations and the (M, mb, T) integer labels.
         return P(None, None, self.seq_axis) if self.sp else P()
+
+    def param_specs(self, stages, *, n_chunks: int | None = None):
+        """Per-leaf PartitionSpecs for the stacked stage params, or
+        ``None`` for the uniform-P(stage) default.  With ``expert_axis``
+        the MoE kernels (``w_up``/``b_up``/``w_dn``/``b_dn``) shard
+        their stacked-expert dim — dim 2 of the (S, L/S, E, ...) stage
+        layout, dim 3 of the (S, V, Lc, E, ...) interleaved layout —
+        and everything else stays P(stage): pp x ep from specs alone,
+        exactly how pp x tp composes."""
+        if self.expert_axis is None:
+            return None
+        edim = 2 if n_chunks is None else 3
+        ax = self.expert_axis
+        stage_ax = self.stage_axis
+
+        def spec(path, leaf):
+            names = [getattr(k, "key", str(k)) for k in path]
+            if names and names[-1] in ("w_up", "b_up", "w_dn", "b_dn"):
+                ent = [None] * leaf.ndim
+                ent[0] = stage_ax
+                ent[edim] = ax
+                return P(*ent)
+            return P(stage_ax)
+
+        return jax.tree_util.tree_map_with_path(spec, stages)
+
+    def build_param_specs(self, *, n_chunks: int | None = None):
+        """The :meth:`param_specs` tree without real parameters: derive
+        the stacked stage layout's STRUCTURE via ``jax.eval_shape`` (no
+        FLOPs, no devices) so the step builders can hand the generic
+        executors their specs at build time."""
+        if self.expert_axis is None:
+            return None
+        model = self.model
+
+        def shape_fn():
+            p = model.clone(attn_impl="full").init(
+                jax.random.key(0), jnp.zeros((1, 2), jnp.int32)
+            )["params"]
+            _, stacked = split_lm_params(model, p)
+            if n_chunks is not None:
+                return interleaved_stage_layout(stacked, self.S, n_chunks)
+            return stage_layout(stacked, self.S)
+
+        return self.param_specs(
+            jax.eval_shape(shape_fn), n_chunks=n_chunks
+        )
 
     def embed(self, embed_params, tok_mb):
         T = tok_mb.shape[-1]
@@ -295,6 +362,7 @@ def make_lm_pipeline_train_step(
     stage_axis: str = "stage",
     remat_stage: bool = False,
     moe_aux_coef: float = 0.01,
+    expert_axis: str | None = None,
 ) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
     """Build ``step(outer, stages, opt_state, tok_mb, y_mb) ->
     (outer, stages, opt_state, loss)`` — GPipe schedule, backward by
@@ -316,8 +384,9 @@ def make_lm_pipeline_train_step(
     builder).
     """
 
-    parts = _LMParts(mesh, model, stage_axis)
+    parts = _LMParts(mesh, model, stage_axis, expert_axis)
     pipe = make_pipeline_apply(mesh, parts.stage_fn, stage_axis=stage_axis,
+                               param_specs=parts.build_param_specs(),
                                remat_stage=remat_stage,
                                extra_manual_axes=parts.extra_axes,
                                microbatch_spec=parts.mb_spec,
@@ -368,6 +437,7 @@ def make_lm_1f1b_train_step(
     *,
     stage_axis: str = "stage",
     moe_aux_coef: float = 0.01,
+    expert_axis: str | None = None,
 ) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
     """The same contract as :func:`make_lm_pipeline_train_step`, under
     the hand-scheduled 1F1B pipeline (O(stages) activation stash).
@@ -384,12 +454,13 @@ def make_lm_1f1b_train_step(
     ``pp.make_1f1b_train_step``).
     """
 
-    parts = _LMParts(mesh, model, stage_axis)
+    parts = _LMParts(mesh, model, stage_axis, expert_axis)
     inner = make_1f1b_train_step(
         mesh, parts.stage_fn,
         head_fn=parts.head_loss_sharded,
         collect_input_grads=True,
         stage_axis=stage_axis,
+        param_specs=parts.build_param_specs(),
         extra_manual_axes=parts.extra_axes,
         microbatch_spec=parts.mb_spec,
         stage_aux_coef=moe_aux_coef if parts.moe else None,
@@ -406,6 +477,7 @@ def make_lm_interleaved_train_step(
     *,
     stage_axis: str = "stage",
     moe_aux_coef: float = 0.01,
+    expert_axis: str | None = None,
 ) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
     """The LM under the INTERLEAVED 1F1B schedule
     (``training/pp_interleaved.py``): same contract as
@@ -419,7 +491,7 @@ def make_lm_interleaved_train_step(
         make_interleaved_1f1b_train_step,
     )
 
-    parts = _LMParts(mesh, model, stage_axis)
+    parts = _LMParts(mesh, model, stage_axis, expert_axis)
     if model.num_layers % (parts.S * n_chunks):
         raise ValueError(
             f"num_layers {model.num_layers} must divide into "
@@ -432,6 +504,7 @@ def make_lm_interleaved_train_step(
         head_fn=parts.head_loss_sharded,
         collect_input_grads=True,
         stage_axis=stage_axis,
+        param_specs=parts.build_param_specs(n_chunks=n_chunks),
         extra_manual_axes=parts.extra_axes,
         microbatch_spec=parts.mb_spec,
         stage_aux_coef=moe_aux_coef if parts.moe else None,

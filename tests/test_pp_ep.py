@@ -1,0 +1,230 @@
+"""Expert parallelism inside pipeline stages (pp x ep — the last open
+cell of the parallelism matrix after round 4).
+
+Two layers: ``models/moe.py::MoEMLP(expert_axis=...)`` — the MANUAL
+formulation for shard_map contexts, where routing runs against the
+global expert set on every shard, each shard computes its local E/n
+experts, and one psum combines (tokens are replicated across the
+expert axis inside a stage, so no all-to-all exists to place) — and
+``training/pp_lm.py``'s ``expert_axis`` kwarg, which shards the stacked
+expert kernels via per-leaf ``param_specs`` exactly how pp x tp does.
+Everything pinned to the unsharded oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_learning_tpu.models.moe import MoEMLP
+from distributed_learning_tpu.models.transformer import TransformerLM
+from distributed_learning_tpu.training.pp_lm import (
+    interleaved_stage_layout,
+    make_lm_1f1b_train_step,
+    make_lm_interleaved_train_step,
+    make_lm_pipeline_train_step,
+    merge_lm_params,
+    split_lm_params,
+    stage_layout,
+)
+
+E = 4                # experts
+S_PP = 2             # pipeline stages
+M, MB, T = 3, 2, 8   # microbatches x size x seq len
+COEF = 0.5
+
+
+# --------------------------------------------------------------------- #
+# Layer level: the manual-ep MoEMLP equals the plain one.
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("top_k,drop", [(1, True), (2, True), (1, False)])
+def test_moe_manual_ep_matches_unsharded(top_k, drop):
+    mesh = Mesh(np.array(jax.devices()[:E]), ("expert",))
+    plain = MoEMLP(num_experts=E, mlp_ratio=2, top_k=top_k,
+                   drop_tokens=drop, capacity_factor=2.0)
+    manual = plain.clone(expert_axis="expert")
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8)).astype(np.float32))
+    params = plain.init(jax.random.key(0), x)["params"]
+    expect = plain.apply({"params": params}, x)
+
+    pspecs = {
+        "gate": {"kernel": P()},
+        "w_up": P("expert"), "b_up": P("expert"),
+        "w_dn": P("expert"), "b_dn": P("expert"),
+    }
+
+    def local(p, xx):
+        return manual.apply({"params": p}, xx)
+
+    got = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(pspecs, P()), out_specs=P(),
+    ))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_manual_ep_gradients_match():
+    """The psum exit's transpose must hand every expert shard the right
+    cotangent: gradients of a scalar loss through the manual layer
+    equal the plain layer's for every param (gate included)."""
+    mesh = Mesh(np.array(jax.devices()[:E]), ("expert",))
+    plain = MoEMLP(num_experts=E, mlp_ratio=2, capacity_factor=2.0)
+    manual = plain.clone(expert_axis="expert")
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(2, 8, 8)).astype(np.float32))
+    params = plain.init(jax.random.key(1), x)["params"]
+
+    ref = jax.grad(
+        lambda p: jnp.mean((plain.apply({"params": p}, x) - y) ** 2)
+    )(params)
+
+    pspecs = {
+        "gate": {"kernel": P()},
+        "w_up": P("expert"), "b_up": P("expert"),
+        "w_dn": P("expert"), "b_dn": P("expert"),
+    }
+
+    def local_loss(p, xx, yy):
+        out = manual.apply({"params": p}, xx)
+        return jnp.mean((out - yy) ** 2)
+
+    def sharded_loss(p, xx, yy):
+        return jax.shard_map(
+            local_loss, mesh=mesh,
+            in_specs=(pspecs, P(), P()), out_specs=P(),
+        )(p, xx, yy)
+
+    got = jax.jit(jax.grad(sharded_loss))(params, x, y)
+    for (pa, ga), (_, gb) in zip(
+        jax.tree_util.tree_leaves_with_path(got),
+        jax.tree_util.tree_leaves_with_path(ref),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(ga), np.asarray(gb), rtol=2e-5, atol=2e-5,
+            err_msg=jax.tree_util.keystr(pa),
+        )
+
+
+# --------------------------------------------------------------------- #
+# Model level: the MoE LM through the pipeline with experts sharded.
+# --------------------------------------------------------------------- #
+
+def _model():
+    return TransformerLM(vocab_size=32, num_layers=4, num_heads=2,
+                         head_dim=8, max_len=T, mlp_ratio=2,
+                         mlp="moe", num_experts=E)
+
+
+def _mesh():
+    return Mesh(
+        np.array(jax.devices()[: S_PP * 2]).reshape(S_PP, 2),
+        ("stage", "expert"),
+    )
+
+
+def _tokens(seed, model):
+    rng = np.random.default_rng(seed)
+    tok = jnp.asarray(
+        rng.integers(0, model.vocab_size, (M, MB, T)), jnp.int32
+    )
+    return tok, jnp.roll(tok, -1, axis=-1)
+
+
+def _direct_loss(model, params, tok_mb, y_mb):
+    from distributed_learning_tpu.models.moe import (
+        apply_collecting_moe_aux,
+    )
+
+    def one(tok, y):
+        logits, aux = apply_collecting_moe_aux(model, params, tok)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+        return ce + COEF * aux
+
+    return jnp.mean(jax.vmap(one)(tok_mb, y_mb))
+
+
+def _assert_ep_step_matches(make_step, layout_fn, merge_kw, seed=0,
+                            expert_dim=2):
+    model = _model()
+    tok, y = _tokens(seed, model)
+    params = model.init(jax.random.key(seed), tok[0])["params"]
+    outer, stacked = split_lm_params(model, params)
+    stages = layout_fn(stacked)
+    mesh = _mesh()
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: _direct_loss(model, p, tok, y)
+    )(params)
+
+    tx1 = optax.sgd(1.0)
+    step1 = make_step(mesh, model, tx1)
+    with mesh:
+        outer2, stages2, _, loss = step1(
+            outer, stages, tx1.init((outer, stages)), tok, y
+        )
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-6)
+    got = merge_lm_params(model, outer2, stages2, **merge_kw)
+    expect = jax.tree.map(lambda p, g: p - g, params, ref_grads)
+    for (pa, ga), (_, gb) in zip(
+        jax.tree_util.tree_leaves_with_path(got),
+        jax.tree_util.tree_leaves_with_path(expect),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(ga), np.asarray(gb), atol=5e-5,
+            err_msg=jax.tree_util.keystr(pa),
+        )
+    # The stacked expert kernels really shard: half the experts per
+    # device on the expert axis (dim 2 of the (S, L/S, E, ...) layout,
+    # dim 3 of the interleaved (S, V, Lc, E, ...)).
+    wup = stages2["MoEMLP_0"]["w_up"]
+    assert (
+        wup.addressable_shards[0].data.shape[expert_dim] == E // 2
+    ), wup.addressable_shards[0].data.shape
+
+
+def test_lm_gpipe_ep_matches_oracle():
+    _assert_ep_step_matches(
+        lambda mesh, model, tx: make_lm_pipeline_train_step(
+            mesh, model, tx, moe_aux_coef=COEF, expert_axis="expert"
+        ),
+        lambda st: stage_layout(st, S_PP), dict(n_stages=S_PP),
+    )
+
+
+def test_lm_1f1b_ep_matches_oracle():
+    _assert_ep_step_matches(
+        lambda mesh, model, tx: make_lm_1f1b_train_step(
+            mesh, model, tx, moe_aux_coef=COEF, expert_axis="expert"
+        ),
+        lambda st: stage_layout(st, S_PP), dict(n_stages=S_PP), seed=1,
+    )
+
+
+def test_lm_interleaved_ep_matches_oracle():
+    _assert_ep_step_matches(
+        lambda mesh, model, tx: make_lm_interleaved_train_step(
+            mesh, model, tx, n_chunks=2, n_microbatches=M,
+            moe_aux_coef=COEF, expert_axis="expert",
+        ),
+        lambda st: interleaved_stage_layout(st, S_PP, 2),
+        dict(n_stages=S_PP, n_chunks=2), seed=2, expert_dim=3,
+    )
+
+
+def test_lm_ep_validation():
+    mesh = _mesh()
+    tx = optax.sgd(0.1)
+    dense = TransformerLM(vocab_size=32, num_layers=4, num_heads=2,
+                          head_dim=8, max_len=T)
+    with pytest.raises(ValueError, match="moe"):
+        make_lm_pipeline_train_step(mesh, dense, tx,
+                                    expert_axis="expert")
+    with pytest.raises(ValueError, match="mesh"):
+        make_lm_pipeline_train_step(mesh, _model(), tx,
+                                    expert_axis="nope")
