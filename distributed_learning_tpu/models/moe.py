@@ -125,8 +125,8 @@ class MoEMLP(nn.Module):
         n = jax.lax.axis_size(self.expert_axis)
         if E % n:
             raise ValueError(
-                f"num_experts {E} must divide the {self.expert_axis!r} "
-                f"axis size {n}"
+                f"num_experts {E} must be divisible by the "
+                f"{self.expert_axis!r} axis size {n}"
             )
         return E // n, jax.lax.axis_index(self.expert_axis) * (E // n)
 

@@ -83,6 +83,25 @@ class _Attention(nn.Module):
     cache_len: int = 0         # static KV-cache length (decode mode)
     rope: bool = False         # rotary Q/K (positions arg required)
     num_kv_heads: int | None = None  # GQA: kv heads < query heads
+    # MANUAL megatron tensor parallelism (shard_map contexts — the
+    # pipeline's stages, where GSPMD annotation can't reach): when set,
+    # this module declares only its LOCAL H/n heads' kernels (the
+    # caller shards the stacked kernels over the axis), attention runs
+    # head-local, and the out-projection's partial product exits
+    # through one raw lax.psum — the shard_map transpose rules supply
+    # the Megatron f/g pair (training/tp.py's NOTE).
+    tp_axis: str | None = None
+
+    def _tp_shard(self, n_global: int, what: str) -> int:
+        if self.tp_axis is None:
+            return n_global
+        n = jax.lax.axis_size(self.tp_axis)
+        if n_global % n:
+            raise ValueError(
+                f"{what} {n_global} must be divisible by the "
+                f"{self.tp_axis!r} axis size {n}"
+            )
+        return n_global // n
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -93,8 +112,14 @@ class _Attention(nn.Module):
         # resharding inside the block.  A flat Dense(3*H*Dh) kernel can
         # only be split contiguously over the concatenated [Q|K|V]
         # columns, which straddles heads and forces XLA to re-gather.
-        H = self.num_heads
-        Hkv = self.num_kv_heads if self.num_kv_heads is not None else H
+        if self.tp_axis is not None and self.decode:
+            raise ValueError(
+                "manual tp_axis is a training-stage mode; decode uses "
+                "the GSPMD path (training/tp.py::make_tp_generate)"
+            )
+        H = self._tp_shard(self.num_heads, "num_heads")
+        Hkv = (self._tp_shard(self.num_kv_heads, "num_kv_heads")
+               if self.num_kv_heads is not None else H)
         if Hkv == H:
             qkv = nn.DenseGeneral(
                 features=(3, H, self.head_dim),
@@ -132,7 +157,7 @@ class _Attention(nn.Module):
             )
         if self.decode:
             return self._decode_step(q, k, v, x)
-        k, v = self._expand_kv(k, v)
+        k, v = self._expand_kv(k, v, H)
         if self.attn_impl == "full":
             out = attention_reference(q, k, v, causal=True,
                                       window=self.window)
@@ -154,20 +179,28 @@ class _Attention(nn.Module):
         # head-sharded under TP with one psum placed by the partitioner.
         return self._out_proj(out, x.shape[-1])
 
-    def _expand_kv(self, k, v):
+    def _expand_kv(self, k, v, H: int | None = None):
         """Broadcast Hkv K/V heads up to the H query heads (no-op when
-        equal): repeat each kv head for its group of queries."""
-        H = self.num_heads
+        equal): repeat each kv head for its group of queries.  ``H`` is
+        the query-head count actually in play — the LOCAL shard under
+        manual tp, where ``num_heads`` would be the global count."""
+        if H is None:
+            H = self.num_heads
         if k.shape[2] == H:
             return k, v
         g = H // k.shape[2]
         return (jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2))
 
     def _out_proj(self, out, d):
-        return nn.DenseGeneral(
+        y = nn.DenseGeneral(
             features=d, axis=(-2, -1),
             use_bias=False, dtype=self.dtype, name="DenseGeneral_1",
         )(out)
+        if self.tp_axis is not None:
+            # Local heads contracted a partial product; one psum totals
+            # it (bias-free, so nothing to de-duplicate).
+            y = jax.lax.psum(y, self.tp_axis)
+        return y
 
     def _decode_step(self, q, k, v, x):
         """Autoregressive attention against a static KV cache.
@@ -242,6 +275,34 @@ class _Attention(nn.Module):
         return self._out_proj(out, x.shape[-1])
 
 
+class _RowDense(nn.Module):
+    """Row-parallel Dense for the manual-TP MLP exit: the kernel holds
+    this shard's ROWS (the caller shards dim 0 over ``tp_axis``), the
+    partial product exits through one psum, and the (replicated) bias
+    is added AFTER it — added before, every shard would contribute a
+    copy and the psum would scale it by the axis size.  Param names and
+    initializers match ``nn.Dense`` exactly so the tree is
+    checkpoint-compatible with the unsharded block."""
+
+    features: int
+    tp_axis: str
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features), self.dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), self.dtype
+        )
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype
+        )
+        return jax.lax.psum(x @ kernel, self.tp_axis) + bias
+
+
 class _Block(nn.Module):
     num_heads: int
     head_dim: int
@@ -259,6 +320,8 @@ class _Block(nn.Module):
     num_kv_heads: int | None = None
     dropout_rate: float = 0.0
     moe_expert_axis: str | None = None  # manual ep (models/moe.py)
+    tp_axis: str | None = None          # manual megatron tp (_Attention)
+    moe_capacity_factor: float = 1.25   # GShard slots per expert
 
     @nn.compact
     def __call__(self, x, positions=None, train: bool = False):
@@ -271,11 +334,16 @@ class _Block(nn.Module):
                 )(h)
             return h
 
+        if self.tp_axis is not None and self.mlp == "moe":
+            raise ValueError(
+                "manual tp_axis with mlp='moe' is not supported: shard "
+                "experts over an expert axis instead (moe_expert_axis)"
+            )
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + drop(_Attention(
             self.num_heads, self.head_dim, self.attn_impl, self.seq_axis,
             self.dtype, self.attn_window, self.decode, self.cache_len,
-            self.rope, self.num_kv_heads,
+            self.rope, self.num_kv_heads, tp_axis=self.tp_axis,
         )(h, positions))
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.mlp == "moe":
@@ -283,6 +351,7 @@ class _Block(nn.Module):
             # stacked (E, ...) kernels shardable over an expert mesh axis.
             return x + drop(MoEMLP(
                 num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
+                capacity_factor=self.moe_capacity_factor,
                 top_k=self.moe_top_k, dtype=self.dtype,
                 drop_tokens=not self.decode,
                 expert_axis=self.moe_expert_axis,
@@ -290,6 +359,24 @@ class _Block(nn.Module):
         if self.mlp != "dense":
             raise ValueError(f"unknown mlp {self.mlp!r} (want dense|moe)")
         d = x.shape[-1]
+        if self.tp_axis is not None:
+            # Megatron column-then-row MLP: the up-projection declares
+            # only this shard's COLUMNS (nn.Dense with local features —
+            # kernel (d, h/n), bias (h/n): the same tree paths as the
+            # unsharded block, locally shaped), gelu stays elementwise
+            # local, and the row-parallel exit psums before its bias.
+            n = jax.lax.axis_size(self.tp_axis)
+            h_f = self.mlp_ratio * d
+            if h_f % n:
+                raise ValueError(
+                    f"mlp width {h_f} must be divisible by the "
+                    f"{self.tp_axis!r} axis size {n}"
+                )
+            h = nn.Dense(h_f // n, dtype=self.dtype, name="Dense_0")(h)
+            h = nn.gelu(h)
+            return x + drop(_RowDense(
+                d, self.tp_axis, self.dtype, name="Dense_1"
+            )(h))
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
         h = nn.gelu(h)
         h = nn.Dense(d, dtype=self.dtype)(h)
@@ -316,6 +403,13 @@ class TransformerLM(nn.Module):
     mlp: str = "dense"       # "dense" | "moe" (expert-parallel blocks)
     num_experts: int = 4
     moe_top_k: int = 1       # router choices per token (1=Switch, 2=GShard)
+    # GShard capacity: slots per expert = ceil(tokens/E * factor).
+    # NOTE training (drop_tokens=True) DROPS overflow while decode
+    # (drop-free) runs every expert, so a capacity-constrained model is
+    # a slightly different function at decode time; raise the factor
+    # (e.g. 8.0 at toy sizes) when train/generate agreement matters
+    # more than the capacity behavior.
+    moe_capacity_factor: float = 1.25
     attn_window: int | None = None  # sliding-window attention (full/flash)
     dropout_rate: float = 0.0  # residual-branch dropout (train=True only)
     pos_emb: str = "learned"  # "learned" table | "rope" rotary Q/K
@@ -383,6 +477,7 @@ class TransformerLM(nn.Module):
                 self.mlp, self.num_experts, self.moe_top_k,
                 self.attn_window, self.decode, self.max_len,
                 use_rope, self.num_kv_heads, self.dropout_rate,
+                moe_capacity_factor=self.moe_capacity_factor,
             )(x, positions if use_rope else None, train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)

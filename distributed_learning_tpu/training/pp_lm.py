@@ -158,7 +158,8 @@ class _LMParts:
     """
 
     def __init__(self, mesh: Mesh, model, stage_axis: str,
-                 expert_axis: str | None = None):
+                 expert_axis: str | None = None,
+                 tp_axis: str | None = None):
         reject_dropout_model(model)
         if model.attn_impl not in (
             "full", "flash", "ring", "ring_flash", "ulysses"
@@ -188,9 +189,35 @@ class _LMParts:
                 )
             if model.num_experts % mesh.shape[expert_axis]:
                 raise ValueError(
-                    f"num_experts {model.num_experts} must divide the "
-                    f"{expert_axis!r} axis size {mesh.shape[expert_axis]}"
+                    f"num_experts {model.num_experts} must be divisible by "
+                    f"the {expert_axis!r} axis size "
+                    f"{mesh.shape[expert_axis]}"
                 )
+        if tp_axis is not None:
+            if self.moe:
+                raise ValueError(
+                    "tp_axis with mlp='moe' is not supported; shard the "
+                    "experts instead (expert_axis)"
+                )
+            if tp_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"tp_axis {tp_axis!r} is not on the mesh "
+                    f"{mesh.axis_names}"
+                )
+            n_tp = mesh.shape[tp_axis]
+            Hkv = (model.num_kv_heads if model.num_kv_heads is not None
+                   else model.num_heads)
+            for what, val in (("num_heads", model.num_heads),
+                              ("num_kv_heads", Hkv),
+                              ("mlp width",
+                               model.mlp_ratio * model.num_heads
+                               * model.head_dim)):
+                if val % n_tp:
+                    raise ValueError(
+                        f"{what} {val} must be divisible by the "
+                        f"{tp_axis!r} axis size {n_tp}"
+                    )
+        self.tp_axis = tp_axis
         self.expert_axis = expert_axis
         self.stage_axis = stage_axis
         self.S = mesh.shape[stage_axis]
@@ -209,7 +236,8 @@ class _LMParts:
             model.mlp, model.num_experts, model.moe_top_k,
             model.attn_window, False, model.max_len,
             self.use_rope, model.num_kv_heads, 0.0,
-            moe_expert_axis=expert_axis,
+            moe_expert_axis=expert_axis, tp_axis=tp_axis,
+            moe_capacity_factor=model.moe_capacity_factor,
         )
         use_rope = self.use_rope
         sp, seq_axis, moe = self.sp, self.seq_axis, self.moe
@@ -264,25 +292,54 @@ class _LMParts:
 
     def param_specs(self, stages, *, n_chunks: int | None = None):
         """Per-leaf PartitionSpecs for the stacked stage params, or
-        ``None`` for the uniform-P(stage) default.  With ``expert_axis``
-        the MoE kernels (``w_up``/``b_up``/``w_dn``/``b_dn``) shard
-        their stacked-expert dim — dim 2 of the (S, L/S, E, ...) stage
-        layout, dim 3 of the (S, V, Lc, E, ...) interleaved layout —
-        and everything else stays P(stage): pp x ep from specs alone,
-        exactly how pp x tp composes."""
-        if self.expert_axis is None:
+        ``None`` for the uniform-P(stage) default.
+
+        With ``expert_axis`` the MoE kernels (``w_up``/``b_up``/
+        ``w_dn``/``b_dn``) shard their stacked-expert dim; with
+        ``tp_axis`` the attention kernels shard their HEAD dim and the
+        MLP pair its column/row dims (the megatron split of
+        ``training/tp.py::transformer_tp_rules``, restated against the
+        stacked layout).  ``off`` is where a block-param's own dims
+        start: 2 after the (S, L/S, ...) stage layout, 3 after the
+        (S, V, Lc, ...) interleaved layout.  Everything else stays
+        P(stage) — pp x ep / pp x tp from specs alone."""
+        if self.expert_axis is None and self.tp_axis is None:
             return None
-        edim = 2 if n_chunks is None else 3
-        ax = self.expert_axis
+        off = 2 if n_chunks is None else 3
+        eax, tax = self.expert_axis, self.tp_axis
         stage_ax = self.stage_axis
+
+        def at(ndim, dim):
+            ent = [None] * ndim
+            ent[0] = stage_ax
+            ent[off + dim] = tax
+            return P(*ent)
 
         def spec(path, leaf):
             names = [getattr(k, "key", str(k)) for k in path]
-            if names and names[-1] in ("w_up", "b_up", "w_dn", "b_dn"):
+            leafname = names[-1] if names else ""
+            parent = names[-2] if len(names) > 1 else ""
+            if eax is not None and leafname in (
+                "w_up", "b_up", "w_dn", "b_dn"
+            ):
                 ent = [None] * leaf.ndim
                 ent[0] = stage_ax
-                ent[edim] = ax
+                ent[off] = eax
                 return P(*ent)
+            if tax is not None:
+                if parent == "DenseGeneral_0" and leafname == "kernel":
+                    return at(leaf.ndim, 2)   # (d, 3, H, Dh): heads
+                if parent == "q_proj" and leafname == "kernel":
+                    return at(leaf.ndim, 1)   # (d, H, Dh)
+                if parent == "kv_proj" and leafname == "kernel":
+                    return at(leaf.ndim, 2)   # (d, 2, Hkv, Dh)
+                if parent == "DenseGeneral_1" and leafname == "kernel":
+                    return at(leaf.ndim, 0)   # (H, Dh, d): head rows
+                if parent == "Dense_0":       # columns: kernel (d, h),
+                    return at(leaf.ndim, leaf.ndim - off - 1)  # bias (h)
+                if parent == "Dense_1" and leafname == "kernel":
+                    return at(leaf.ndim, 0)   # rows: (h, d)
+                # Dense_1 bias, LayerNorms: replicated over tp.
             return P(stage_ax)
 
         return jax.tree_util.tree_map_with_path(spec, stages)
@@ -292,7 +349,7 @@ class _LMParts:
         the stacked stage layout's STRUCTURE via ``jax.eval_shape`` (no
         FLOPs, no devices) so the step builders can hand the generic
         executors their specs at build time."""
-        if self.expert_axis is None:
+        if self.expert_axis is None and self.tp_axis is None:
             return None
         model = self.model
 
@@ -363,6 +420,7 @@ def make_lm_pipeline_train_step(
     remat_stage: bool = False,
     moe_aux_coef: float = 0.01,
     expert_axis: str | None = None,
+    tp_axis: str | None = None,
 ) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
     """Build ``step(outer, stages, opt_state, tok_mb, y_mb) ->
     (outer, stages, opt_state, loss)`` — GPipe schedule, backward by
@@ -384,7 +442,7 @@ def make_lm_pipeline_train_step(
     builder).
     """
 
-    parts = _LMParts(mesh, model, stage_axis, expert_axis)
+    parts = _LMParts(mesh, model, stage_axis, expert_axis, tp_axis)
     pipe = make_pipeline_apply(mesh, parts.stage_fn, stage_axis=stage_axis,
                                param_specs=parts.build_param_specs(),
                                remat_stage=remat_stage,
@@ -438,6 +496,7 @@ def make_lm_1f1b_train_step(
     stage_axis: str = "stage",
     moe_aux_coef: float = 0.01,
     expert_axis: str | None = None,
+    tp_axis: str | None = None,
 ) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
     """The same contract as :func:`make_lm_pipeline_train_step`, under
     the hand-scheduled 1F1B pipeline (O(stages) activation stash).
@@ -454,7 +513,7 @@ def make_lm_1f1b_train_step(
     ``pp.make_1f1b_train_step``).
     """
 
-    parts = _LMParts(mesh, model, stage_axis, expert_axis)
+    parts = _LMParts(mesh, model, stage_axis, expert_axis, tp_axis)
     inner = make_1f1b_train_step(
         mesh, parts.stage_fn,
         head_fn=parts.head_loss_sharded,
@@ -478,6 +537,7 @@ def make_lm_interleaved_train_step(
     stage_axis: str = "stage",
     moe_aux_coef: float = 0.01,
     expert_axis: str | None = None,
+    tp_axis: str | None = None,
 ) -> Callable[..., Tuple[Any, Any, Any, jax.Array]]:
     """The LM under the INTERLEAVED 1F1B schedule
     (``training/pp_interleaved.py``): same contract as
@@ -491,7 +551,7 @@ def make_lm_interleaved_train_step(
         make_interleaved_1f1b_train_step,
     )
 
-    parts = _LMParts(mesh, model, stage_axis, expert_axis)
+    parts = _LMParts(mesh, model, stage_axis, expert_axis, tp_axis)
     if model.num_layers % (parts.S * n_chunks):
         raise ValueError(
             f"num_layers {model.num_layers} must divide into "

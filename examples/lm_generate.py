@@ -10,6 +10,7 @@ Run (any platform; ~20s on CPU):
 
     python -m examples.lm_generate
     python -m examples.lm_generate --steps 200 --gen 12
+    python -m examples.lm_generate --tp   # + tensor-parallel decode
 """
 
 from __future__ import annotations
@@ -32,6 +33,11 @@ def main() -> None:
     ap.add_argument("--vocab", type=int, default=16)
     ap.add_argument("--steps", type=int, default=300)
     ap.add_argument("--gen", type=int, default=8)
+    ap.add_argument("--tp", action="store_true",
+                    help="ALSO decode tensor-parallel on a (data, "
+                         "model) mesh — head-sharded KV cache "
+                         "(training/tp.py::make_tp_generate); tokens "
+                         "must match the single-device path exactly")
     args = ap.parse_args()
     V = args.vocab
 
@@ -73,6 +79,28 @@ def main() -> None:
     print(f"generated: {toks.tolist()}")
     print(f"expected:  {expect.tolist()}")
     print(f"correct_tokens: {n_ok}/{args.gen}")
+
+    if args.tp:
+        from jax.sharding import Mesh
+
+        from distributed_learning_tpu.training.tp import (
+            make_tp_generate,
+            shard_transformer_params,
+        )
+
+        if len(jax.devices()) < 2:
+            print("tp decode: skipped (needs >= 2 devices)")
+            return
+        mesh = Mesh(
+            np.array(jax.devices()[:2]).reshape(1, 2), ("data", "model")
+        )
+        p_sh = shard_transformer_params(params, mesh)
+        toks_tp = np.asarray(
+            make_tp_generate(mesh, model)(p_sh, prompt, args.gen)
+        )[0]
+        match = bool((toks_tp == toks).all())
+        print(f"tp generated: {toks_tp.tolist()}")
+        print(f"tp_matches_single_device: {match}")
 
 
 if __name__ == "__main__":

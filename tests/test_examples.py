@@ -257,3 +257,34 @@ def test_lm_pipeline_interleaved_example():
     m = re.search(r"correct_tokens: (\d+)/(\d+)", out)
     assert m, out
     assert int(m.group(1)) == int(m.group(2)) == 6, out
+
+
+def test_lm_pipeline_ring_example():
+    """pp x sp mode: ring attention inside the pipeline stages on a
+    (stage, seq) mesh still learns the progression."""
+    out = _run("lm_pipeline", "--attn", "ring",
+               "--steps", "220", "--gen", "6", timeout=580.0)
+    assert "2 seq shards" in out, out
+    m = re.search(r"correct_tokens: (\d+)/(\d+)", out)
+    assert m, out
+    assert int(m.group(1)) == int(m.group(2)) == 6, out
+
+
+def test_lm_pipeline_ep_example():
+    """pp x ep mode: the MoE LM with expert kernels sharded inside the
+    stages learns the progression."""
+    out = _run("lm_pipeline", "--ep", "--schedule", "1f1b",
+               "--steps", "220", "--gen", "6", timeout=580.0)
+    assert "2 expert shards" in out, out
+    m = re.search(r"correct_tokens: (\d+)/(\d+)", out)
+    assert m, out
+    assert int(m.group(1)) == int(m.group(2)) == 6, out
+
+
+def test_lm_generate_tp_example():
+    """--tp decode: the tensor-parallel path must reproduce the
+    single-device tokens exactly."""
+    out = _run("lm_generate", "--tp", "--steps", "220", "--gen", "6",
+               env_extra={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert "tp_matches_single_device: True" in out, out
