@@ -200,6 +200,63 @@ def _emit_and_exit(code: int) -> None:
     os._exit(code)
 
 
+def _cpu_fallback_record():
+    """When the accelerator backend never completes a single op, re-run
+    this benchmark in a SUBPROCESS pinned to the CPU backend (tiny
+    config) and return its record tagged ``tunnel_wedged`` — the driver
+    then gets a parseable, honestly-labeled harness-sanity record
+    instead of nothing.  Returns None if even that fails (the caller
+    falls back to the bare rc=2 diagnostic)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        # Load-bearing on this machine: the ambient sitecustomize dials
+        # the (wedged) accelerator tunnel at interpreter start.
+        PYTHONPATH=os.path.dirname(os.path.abspath(__file__)),
+        DLT_BENCH_CPU_FALLBACK="1",
+        BENCH_WATCHDOG_SECS="120",
+        BENCH_DEADLINE_SECS="0",   # the subprocess timeout is the guard
+        # The CPU-validated tiny recipe (~2-4 min incl. compile): the
+        # record is a harness sanity check, not a number to optimize.
+        BENCH_DEPTH="10", BENCH_WIDEN="1", BENCH_BATCH="32",
+        BENCH_STEPS="2", BENCH_EPOCHS="1", BENCH_AGENTS="2",
+    )
+    env.pop("BENCH_FULL", None)
+    env.pop("BENCH_POOL", None)
+    env.pop("DLT_BENCH_FAKE_WEDGE", None)
+    out = None
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=420,
+        )
+        line = [l for l in out.stdout.splitlines() if l.strip()][-1]
+        rec = json.loads(line)
+        assert "metric" in rec
+    except Exception as exc:  # pragma: no cover - best effort
+        child_err = (
+            out.stderr if out is not None
+            else getattr(exc, "stderr", None) or ""
+        )
+        print(
+            f"bench.py cpu fallback failed: {exc!r}; child stderr tail: "
+            f"{str(child_err)[-2000:]}",
+            file=sys.stderr, flush=True,
+        )
+        return None
+    rec["tunnel_wedged"] = True
+    rec["note"] = (
+        "TPU backend unresponsive (no device op within the watchdog "
+        "window); this is the CPU-platform harness-sanity record, NOT "
+        "a TPU measurement"
+    )
+    return rec
+
+
 def _arm_watchdog():
     """Self-describing failure instead of an opaque hang.
 
@@ -225,6 +282,7 @@ def _arm_watchdog():
     progressed = threading.Event()
     secs = float(os.environ.get("BENCH_WATCHDOG_SECS", 900))
     deadline = float(os.environ.get("BENCH_DEADLINE_SECS", 3300))
+    cancel_cell = [lambda: None]  # filled once the deadline timer exists
 
     def fire():
         if progressed.is_set():
@@ -236,6 +294,25 @@ def _arm_watchdog():
             file=sys.stderr,
             flush=True,
         )
+        if (not _BEST_RECORD
+                and os.environ.get("DLT_BENCH_CPU_FALLBACK") != "1"):
+            # The fallback takes minutes: the deadline timer must not
+            # fire mid-flight and rc=2 away the record it is producing.
+            cancel_cell[0]()
+            rec = _cpu_fallback_record()
+            if progressed.is_set():
+                # The tunnel unwedged while the fallback ran: the REAL
+                # measurement is in flight on the main thread — print
+                # nothing here (one-JSON-line contract) and stand down.
+                print(
+                    "bench.py watchdog: backend recovered during the "
+                    "cpu fallback; discarding the fallback record",
+                    file=sys.stderr, flush=True,
+                )
+                return
+            if rec is not None:
+                print(json.dumps(rec), flush=True)
+                os._exit(0)
         _emit_and_exit(2)
 
     def fire_deadline():
@@ -258,6 +335,7 @@ def _arm_watchdog():
         td = threading.Timer(deadline, fire_deadline)
         td.daemon = True
         td.start()
+        cancel_cell[0] = td.cancel
     return progressed, (td.cancel if td is not None else lambda: None)
 
 
@@ -272,6 +350,11 @@ def main():
     # wedged tunnel now fails at the watchdog with zero minutes burned on
     # compilation, and a healthy one proves itself immediately (the
     # watchdog keeps guarding until this completes).
+    if os.environ.get("DLT_BENCH_FAKE_WEDGE") == "1":
+        # Test hook: simulate the tunnel wedge (device ops never
+        # complete) so the watchdog + cpu-fallback path is exercisable
+        # on any machine (tests/test_benchmarks.py).
+        time.sleep(10 ** 9)
     t0 = time.perf_counter()
     # float() forces a host copy — the only sync this backend honors
     # (see measure_throughput's docstring); async dispatch alone would

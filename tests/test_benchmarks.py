@@ -185,3 +185,36 @@ def test_noniid_default_outpath_never_clobbers_canonical(tmp_path, monkeypatch):
         for f in os.listdir(results_dir):
             if f.startswith("titanic_noniid_curves_") and "100it" in f:
                 os.remove(os.path.join(results_dir, f))
+
+
+def test_bench_cpu_fallback_on_wedge():
+    """bench.py's watchdog must convert a dead accelerator backend into
+    a parseable, honestly-labeled CPU-platform record (one JSON line,
+    rc 0, ``tunnel_wedged`` set) instead of exiting empty-handed —
+    driven end to end via the fake-wedge test hook."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(
+        DLT_BENCH_FAKE_WEDGE="1",
+        BENCH_WATCHDOG_SECS="5",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=repo,
+    )
+    env.pop("BENCH_FULL", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=560,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout  # the one-JSON-line contract
+    rec = json.loads(lines[0])
+    assert rec["tunnel_wedged"] is True
+    assert rec["metric"].endswith("_cpu")
+    assert rec["value"] > 0
+    assert "NOT a TPU measurement" in rec["note"]
