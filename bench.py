@@ -282,7 +282,11 @@ def _arm_watchdog():
     progressed = threading.Event()
     secs = float(os.environ.get("BENCH_WATCHDOG_SECS", 900))
     deadline = float(os.environ.get("BENCH_DEADLINE_SECS", 3300))
-    cancel_cell = [lambda: None]  # filled once the deadline timer exists
+    t_armed = time.monotonic()
+    # Always points at the LIVE deadline timer's cancel (the timer can
+    # be re-armed after a mid-fallback recovery, so both the watchdog
+    # and the main thread cancel through this cell, never a stale ref).
+    cancel_cell = [lambda: None]
 
     def fire():
         if progressed.is_set():
@@ -303,7 +307,15 @@ def _arm_watchdog():
             if progressed.is_set():
                 # The tunnel unwedged while the fallback ran: the REAL
                 # measurement is in flight on the main thread — print
-                # nothing here (one-JSON-line contract) and stand down.
+                # nothing here (one-JSON-line contract), RE-ARM the
+                # deadline with its remaining budget (the short-window
+                # guarantee must survive the detour), and stand down.
+                remaining = deadline - (time.monotonic() - t_armed)
+                if deadline > 0 and remaining > 0:
+                    td2 = threading.Timer(remaining, fire_deadline)
+                    td2.daemon = True
+                    td2.start()
+                    cancel_cell[0] = td2.cancel
                 print(
                     "bench.py watchdog: backend recovered during the "
                     "cpu fallback; discarding the fallback record",
@@ -330,13 +342,14 @@ def _arm_watchdog():
         t.start()
     else:
         progressed.set()
-    td = None
     if deadline > 0:
         td = threading.Timer(deadline, fire_deadline)
         td.daemon = True
         td.start()
         cancel_cell[0] = td.cancel
-    return progressed, (td.cancel if td is not None else lambda: None)
+    # The caller cancels through the cell too: after a re-arm the cell
+    # tracks the live timer, a direct td.cancel would hit a dead one.
+    return progressed, (lambda: cancel_cell[0]())
 
 
 def main():

@@ -26,6 +26,19 @@ mkdir -p "$OUT"
 STAMP=$(date +%Y%m%d_%H%M%S)
 CAPTURE="$OUT/session2_$STAMP.jsonl"
 
+# Append $1's ["summary"] (or, with -last-line, its last stdout line) to
+# the capture — ONE guarded implementation so a malformed file can never
+# abort stage 5's publish (and fixes to the guard can't drift between
+# stages).
+emit_summary() {
+  python - "$1" >>"$CAPTURE" <<'EOF' || true
+import json, sys
+rec = json.load(open(sys.argv[1]))["summary"]
+assert "metric" in rec
+print(json.dumps(rec))
+EOF
+}
+
 echo "== stage 0: liveness probe" >&2
 if ! timeout 60 python -u -c \
   "import jax, jax.numpy as j; jax.jit(lambda a: a.sum())(j.ones((8,8))).block_until_ready(); print('alive')"; then
@@ -76,12 +89,21 @@ echo "== stage 3: WRN accuracy" >&2
 ACC_JSON="$OUT/wrn_accuracy_$STAMP.json"
 if timeout 4500 python -m benchmarks.train_wrn_accuracy --out "$ACC_JSON" \
   2>"$OUT/wrn_accuracy_$STAMP.err"; then
-  python - "$ACC_JSON" >>"$CAPTURE" <<'EOF'
-import json, sys
-print(json.dumps(json.load(open(sys.argv[1]))["summary"]))
-EOF
+  emit_summary "$ACC_JSON"
 else
   echo "stage 3 rc=$?" >&2
+fi
+
+if [ "${WRN_CIFAR100:-0}" = "1" ]; then
+  echo "== stage 3b: WRN accuracy, cifar100 shape (reference's 2nd anchor)" >&2
+  ACC100_JSON="$OUT/wrn_accuracy_cifar100_$STAMP.json"
+  if timeout 4500 python -m benchmarks.train_wrn_accuracy \
+    --dataset cifar100 --out "$ACC100_JSON" \
+    2>"$OUT/wrn_accuracy100_$STAMP.err"; then
+    emit_summary "$ACC100_JSON"
+  else
+    echo "stage 3b rc=$?" >&2
+  fi
 fi
 
 echo "== stage 4: compression (TPU-sized, incl. atopk)" >&2

@@ -218,3 +218,25 @@ def test_bench_cpu_fallback_on_wedge():
     assert rec["metric"].endswith("_cpu")
     assert rec["value"] > 0
     assert "NOT a TPU measurement" in rec["note"]
+
+
+def test_wrn_accuracy_cifar100_proxy_smoke(tmp_path, monkeypatch):
+    """The cifar100 shape of the accuracy driver (the reference's second
+    anchor, CIFAR_100_Baseline.ipynb cell 9): 100-class model wiring,
+    synthetic-label path, and record naming — at a tiny proxy scale so
+    regressions surface here, not in a paid TPU session."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from benchmarks import train_wrn_accuracy
+
+    out = str(tmp_path / "wrn100.json")
+    rec = train_wrn_accuracy.run(
+        proxy=True, epochs=1, n_agents=2, dataset="cifar100",
+        n_train=128, n_test=64, out_path=out,
+    )
+    assert "cifar100" in rec["metric"]
+    assert rec["data_source"] == "synthetic-stand-in"
+    assert 0.0 <= rec["value"] <= 1.0
+    with open(out) as f:
+        saved = json.load(f)
+    assert saved["summary"]["metric"] == rec["metric"]
+    assert len(saved["curve"]) == 1
