@@ -201,3 +201,15 @@ def test_lm_1f1b_dp_pp_matches_oracle():
             np.asarray(ga), np.asarray(gb), atol=5e-5,
             err_msg=jax.tree_util.keystr(pa),
         )
+
+
+def test_lm_1f1b_ulysses_matches_full_attention():
+    """Ulysses sequence parallelism (all_to_all head/seq reshard)
+    inside the pipeline stages — the third sp impl through the LM 1F1B
+    path, same full-attention oracle.  The all_to_all runs
+    unconditionally every tick (the executors never branch around
+    stage work), so its collective stays aligned across stage rows."""
+    _assert_step_matches(
+        _model(attn_impl="ulysses"), make_lm_1f1b_train_step,
+        lambda st: stage_layout(st, S), dict(n_stages=S),
+    )
