@@ -1,0 +1,79 @@
+"""The FULL 4-axis composition on the flagship TransformerLM:
+dp x pp x sp x tp on a (data, stage, seq, model) = (2, 2, 2, 2) mesh —
+data GSPMD-auto over the microbatch dim, the pipeline's stage ring,
+ring attention over seq with each shard's LOCAL heads, megatron psum
+exits over model.  Exact against the unsharded full-attention oracle.
+
+Runs in a SUBPROCESS: the suite's conftest pins 8 virtual devices, and
+the device count is frozen at backend init — 16 needs its own
+interpreter (the same pattern as tests/test_multihost.py)."""
+
+import os
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import jax, jax.numpy as jnp, numpy as np, optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from distributed_learning_tpu.models.transformer import TransformerLM
+from distributed_learning_tpu.training.pp_lm import (
+    make_lm_1f1b_train_step, split_lm_params, stage_layout,
+    merge_lm_params)
+
+M, MB, T = 3, 4, 8
+model = TransformerLM(vocab_size=32, num_layers=4, num_heads=4,
+                      head_dim=8, max_len=T, mlp_ratio=2,
+                      attn_impl="ring")
+rng = np.random.default_rng(0)
+tok = jnp.asarray(rng.integers(0, 32, (M, MB, T)), jnp.int32)
+y = jnp.roll(tok, -1, axis=-1)
+params = model.clone(attn_impl="full").init(
+    jax.random.key(0), tok[0]
+)["params"]
+outer, stacked = split_lm_params(model, params)
+stages = stage_layout(stacked, 2)
+
+def direct(p):
+    logits = model.clone(attn_impl="full").apply(
+        {"params": p}, tok.reshape(M * MB, T)
+    )
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, y.reshape(M * MB, T)
+    ).mean()
+
+ref_l, ref_g = jax.value_and_grad(direct)(params)
+expect = jax.tree.map(lambda p, g: p - g, params, ref_g)
+
+mesh = Mesh(np.array(jax.devices()[:16]).reshape(2, 2, 2, 2),
+            ("data", "stage", "seq", "model"))
+tx = optax.sgd(1.0)
+step = make_lm_1f1b_train_step(mesh, model, tx, tp_axis="model")
+spec = NamedSharding(mesh, P(None, "data", "seq"))
+with mesh:
+    o2, s2, _, loss = step(
+        outer, stages, tx.init((outer, stages)),
+        jax.device_put(tok, spec), jax.device_put(y, spec),
+    )
+assert abs(float(loss) - float(ref_l)) < 1e-4, (loss, ref_l)
+got = merge_lm_params(model, o2, s2, n_stages=2)
+maxe = max(
+    float(jnp.max(jnp.abs(a - b)))
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(expect))
+)
+assert maxe < 5e-4, maxe
+print(f"OK-4D maxerr={maxe:.2e}", flush=True)
+"""
+
+
+def test_lm_1f1b_4d_dp_pp_sp_tp_matches_oracle():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env["PYTHONPATH"] = repo  # hermetic: no site hooks
+    out = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=1500,
+    )
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr[-3000:]}"
+    assert "OK-4D" in out.stdout, out.stdout
