@@ -290,7 +290,7 @@ class _LMParts:
         # activations and the (M, mb, T) integer labels.
         return P(None, None, self.seq_axis) if self.sp else P()
 
-    def param_specs(self, stages, *, n_chunks: int | None = None):
+    def build_param_specs(self, *, n_chunks: int | None = None):
         """Per-leaf PartitionSpecs for the stacked stage params, or
         ``None`` for the uniform-P(stage) default.
 
@@ -302,12 +302,25 @@ class _LMParts:
         stacked layout).  ``off`` is where a block-param's own dims
         start: 2 after the (S, L/S, ...) stage layout, 3 after the
         (S, V, Lc, ...) interleaved layout.  Everything else stays
-        P(stage) — pp x ep / pp x tp from specs alone."""
+        P(stage) — pp x ep / pp x tp from specs alone.  The tree's
+        STRUCTURE comes from ``jax.eval_shape`` over the model's init
+        (no FLOPs, no devices), so the step builders get their specs at
+        build time without real parameters."""
         if self.expert_axis is None and self.tp_axis is None:
             return None
         off = 2 if n_chunks is None else 3
         eax, tax = self.expert_axis, self.tp_axis
         stage_ax = self.stage_axis
+        model = self.model
+
+        def shape_fn():
+            p = model.clone(attn_impl="full").init(
+                jax.random.key(0), jnp.zeros((1, 2), jnp.int32)
+            )["params"]
+            _, stacked = split_lm_params(model, p)
+            if n_chunks is not None:
+                return interleaved_stage_layout(stacked, self.S, n_chunks)
+            return stage_layout(stacked, self.S)
 
         def at(ndim, dim):
             ent = [None] * ndim
@@ -342,28 +355,8 @@ class _LMParts:
                 # Dense_1 bias, LayerNorms: replicated over tp.
             return P(stage_ax)
 
-        return jax.tree_util.tree_map_with_path(spec, stages)
-
-    def build_param_specs(self, *, n_chunks: int | None = None):
-        """The :meth:`param_specs` tree without real parameters: derive
-        the stacked stage layout's STRUCTURE via ``jax.eval_shape`` (no
-        FLOPs, no devices) so the step builders can hand the generic
-        executors their specs at build time."""
-        if self.expert_axis is None and self.tp_axis is None:
-            return None
-        model = self.model
-
-        def shape_fn():
-            p = model.clone(attn_impl="full").init(
-                jax.random.key(0), jnp.zeros((1, 2), jnp.int32)
-            )["params"]
-            _, stacked = split_lm_params(model, p)
-            if n_chunks is not None:
-                return interleaved_stage_layout(stacked, self.S, n_chunks)
-            return stage_layout(stacked, self.S)
-
-        return self.param_specs(
-            jax.eval_shape(shape_fn), n_chunks=n_chunks
+        return jax.tree_util.tree_map_with_path(
+            spec, jax.eval_shape(shape_fn)
         )
 
     def embed(self, embed_params, tok_mb):

@@ -147,10 +147,12 @@ def main() -> None:
             # materialization per step keeps at most one execution in
             # flight.  Real TPU steps block on the host loop anyway.
             jax.block_until_ready(loss)
-    flavor = {"ring": " x 2 seq shards (ring attention)",
-              }.get(args.attn, "")
     if args.ep:
         flavor = " x 2 expert shards (MoE kernels split)"
+    elif args.attn == "ring":
+        flavor = " x 2 seq shards (ring attention)"
+    else:
+        flavor = ""
     print(
         f"trained {args.steps} steps ({args.schedule}) over {S} pipeline "
         f"stages{flavor} ({model.num_layers} blocks, "
