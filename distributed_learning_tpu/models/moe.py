@@ -246,6 +246,7 @@ class MoEMLP(nn.Module):
         # NOTE).
         out = jnp.einsum("sec,ecd->sd", combine_w, out_e)
         if self.expert_axis is not None:
+            # graftlint: disable=raw-collective-in-shard-map -- manual-EP combine exit: psum over expert_axis totals the shards' gate-weighted expert outputs; entry-cast transpose is the cotangent broadcast (training/tp.py NOTE)
             out = jax.lax.psum(out, self.expert_axis)
         self.sow(
             "moe_stats", "dropped_fraction",
@@ -297,6 +298,7 @@ class MoEMLP(nn.Module):
             weight = jax.lax.dynamic_slice_in_dim(weight, e0, E_loc, 1)
         out = jnp.einsum("se,sed->sd", weight, out_e)
         if self.expert_axis is not None:
+            # graftlint: disable=raw-collective-in-shard-map -- manual-EP combine exit (dense top-k path): same psum-over-expert_axis combine as above
             out = jax.lax.psum(out, self.expert_axis)
         self.sow(
             "moe_stats", "dropped_fraction", jnp.zeros(()),

@@ -199,6 +199,7 @@ class _Attention(nn.Module):
         if self.tp_axis is not None:
             # Local heads contracted a partial product; one psum totals
             # it (bias-free, so nothing to de-duplicate).
+            # graftlint: disable=raw-collective-in-shard-map -- megatron g exit: attention out-projection psum over tp_axis (training/tp.py NOTE)
             y = jax.lax.psum(y, self.tp_axis)
         return y
 
@@ -300,6 +301,7 @@ class _RowDense(nn.Module):
         x, kernel, bias = nn.dtypes.promote_dtype(
             x, kernel, bias, dtype=self.dtype
         )
+        # graftlint: disable=raw-collective-in-shard-map -- megatron g exit: row-sharded kernel's partial matmul psum'd over tp_axis before the (replicated) bias
         return jax.lax.psum(x @ kernel, self.tp_axis) + bias
 
 

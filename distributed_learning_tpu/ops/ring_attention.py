@@ -121,6 +121,7 @@ def ring_attention(
     # EVERY sharded axis, so the carries must match q's full vma, not just
     # the ring axis.
     vary_axes = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
+    # graftlint: disable=raw-collective-in-shard-map -- vma cast: fresh carries marked varying over the ring axes so cotangents stay LOCAL (the pcast-before-local-cotangent rule, training/pp.py head_seed)
     pvary = lambda x: lax.pcast(x, vary_axes, to="varying")
     acc0 = pvary(jnp.zeros((B, t_local, H, D), jnp.float32))
     l0 = pvary(jnp.zeros((B, H, t_local), jnp.float32))
@@ -260,6 +261,7 @@ def ring_flash_attention(
         # branches consume the ppermuted (device-varying) K/V, so cond
         # needs this branch's fresh constants marked varying too (over
         # q's full vma — multi-axis meshes vary over more than the ring).
+        # graftlint: disable=raw-collective-in-shard-map -- vma cast: cond branch constants must match the live branches' varying set (local-cotangent rule, training/pp.py head_seed)
         pv = lambda x: lax.pcast(x, vary_axes, to="varying")
         return (
             pv(jnp.zeros((B, t_local, H, D), q.dtype)),
@@ -267,6 +269,7 @@ def ring_flash_attention(
         )
 
     vary_axes = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
+    # graftlint: disable=raw-collective-in-shard-map -- vma cast: fresh carries marked varying over the ring axes so cotangents stay LOCAL (the pcast-before-local-cotangent rule, training/pp.py head_seed)
     pvary = lambda x: lax.pcast(x, vary_axes, to="varying")
     acc0 = pvary(jnp.zeros((B, t_local, H, D), jnp.float32))
     l0 = pvary(jnp.zeros((B, H, t_local), jnp.float32))

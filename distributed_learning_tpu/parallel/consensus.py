@@ -162,6 +162,7 @@ def local_sq_deviation(x: Pytree, axis_name: str) -> jax.Array:
     ``ops.agent_deviations``**2)."""
     total = jnp.float32(0.0)
     for leaf in jax.tree.leaves(x):
+        # graftlint: disable=raw-collective-in-shard-map -- consensus residual: the pmean over agents IS the statistic (distance from the global mean), not a TP exit
         mean = lax.pmean(leaf.astype(jnp.float32), axis_name)
         d = leaf.astype(jnp.float32) - mean
         total = total + jnp.sum(d * d)
@@ -836,7 +837,9 @@ class ConsensusEngine:
                     m = jnp.float32(0.0)
                     for leaf in jax.tree.leaves(x):
                         lf = leaf.astype(jnp.float32)
+                        # graftlint: disable=raw-collective-in-shard-map -- telemetry: per-coordinate mean over agents (reference mixer.py:78-84 stats)
                         mean = lax.pmean(lf, ax)
+                        # graftlint: disable=raw-collective-in-shard-map -- telemetry: per-coordinate variance over agents (same stat family)
                         var = lax.pmean((lf - mean) ** 2, ax)
                         m = jnp.maximum(m, jnp.max(jnp.sqrt(var)))
                     return m
@@ -877,6 +880,7 @@ class ConsensusEngine:
             elif name == "global_average":
                 def local_avg(x):
                     return jax.tree.map(
+                        # graftlint: disable=raw-collective-in-shard-map -- exact consensus: the global average is the mixing fixed point, pmean over agents by definition
                         lambda v: lax.pmean(
                             v.astype(jnp.float32), ax
                         ).astype(v.dtype),

@@ -181,6 +181,7 @@ class ExtraEngine:
             )
             m_sc = scale
         else:
+            # graftlint: disable=raw-collective-in-shard-map -- EXTRA mean-field terms: pmean over agents implements the W-bar average of the update rule, not a TP exit
             m_r, m_d, m_sc = jax.lax.pmean(
                 (r, d, scale), self.axis_name
             )

@@ -69,6 +69,7 @@ def _varying_cast(axes: tuple):
             a for a in axes
             if a not in getattr(jax.typeof(x), "vma", ())
         )
+        # graftlint: disable=raw-collective-in-shard-map -- THE vma cast helper: explicit invariant->varying pcast so cotangents stay local (head_seed's pcast-before-local-cotangent rule)
         return lax.pcast(x, missing, to="varying") if missing else x
     return f
 
@@ -263,6 +264,7 @@ def make_pipeline_apply(
                 out = stage_fn(p, act)
             # The LAST stage's fresh output is a finished microbatch
             # (valid for ticks t >= S-1); replicate it for collection.
+            # graftlint: disable=raw-collective-in-shard-map -- collection exit: psum over stages replicates the last stage's output (zeros elsewhere); transpose is the identity broadcast
             done = lax.psum(
                 jnp.where(idx == S - 1, out, jnp.zeros_like(out)),
                 stage_axis,
@@ -277,8 +279,10 @@ def make_pipeline_apply(
         outs = dones[S - 1:]
         if not stage_aux:
             return outs
+        # graftlint: disable=raw-collective-in-shard-map -- stage-aux exit: total the per-stage aux over stages (bubble ticks already masked)
         aux = lax.psum(aux_acc, stage_axis) / (S * M)
         for ax in extra_manual_axes:
+            # graftlint: disable=raw-collective-in-shard-map -- pp x sp aux: per-shard mean convention (training/spmd_lm.py)
             aux = lax.pmean(aux, ax)
         return outs, aux
 
@@ -557,20 +561,25 @@ def make_1f1b_train_step(
         # pvaries its params opts out of that; total its partials here.
         for ax in extra_manual_axes:
             gacc = jax.tree.map(
+                # graftlint: disable=raw-collective-in-shard-map -- pp x sp opt-out total: explicitly pvaried param partials summed over the extra axis (cotangent-psum done by hand)
                 lambda g: lax.psum(g, ax)
                 if ax in getattr(jax.typeof(g), "vma", ()) else g,
                 gacc,
             )
             hacc = jax.tree.map(
+                # graftlint: disable=raw-collective-in-shard-map -- pp x sp opt-out total: head-grad partials summed over the extra axis, same rule as gacc
                 lambda h: lax.psum(h, ax)
                 if ax in getattr(jax.typeof(h), "vma", ()) else h,
                 hacc,
             )
         grads = jax.tree.map(lambda g: g[None], gacc)  # (1, ...) local slice
+        # graftlint: disable=raw-collective-in-shard-map -- loss exit: only the last stage holds a nonzero loss; psum over stages replicates it for the P() out-spec
         loss = lax.psum(lacc, stage_axis)  # only the last stage contributes
         if stage_aux_coef is not None:
+            # graftlint: disable=raw-collective-in-shard-map -- stage-aux exit: total over stages (masked bubble ticks), as in make_pipeline_apply
             aux = lax.psum(aacc, stage_axis) / (S * M)
             for ax in extra_manual_axes:
+                # graftlint: disable=raw-collective-in-shard-map -- pp x sp aux: per-shard mean convention (training/spmd_lm.py)
                 aux = lax.pmean(aux, ax)
             loss = loss + stage_aux_coef * aux
         outs = [grads]
@@ -578,9 +587,11 @@ def make_1f1b_train_step(
             # Only the last stage accumulated; the psum both totals and
             # makes the tree replicated for the P() out-spec.
             outs.append(jax.tree.map(
+                # graftlint: disable=raw-collective-in-shard-map -- head-grad exit: totals the last stage's accumulator AND replicates it over stages (P() out-spec)
                 lambda h: lax.psum(h, stage_axis), hacc
             ))
         if collect_input_grads:
+            # graftlint: disable=raw-collective-in-shard-map -- input-cotangent exit: only stage 0 banked dmbs; psum replicates for collection
             outs.append(lax.psum(dmbs, stage_axis))  # stage 0 only
         outs.append(loss)
         return tuple(outs)

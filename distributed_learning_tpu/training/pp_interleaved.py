@@ -611,28 +611,35 @@ def make_interleaved_1f1b_train_step(
         # partials a pvarying stage_fn left unreduced over the extras.
         for ax in extra_manual_axes:
             gacc = jax.tree.map(
+                # graftlint: disable=raw-collective-in-shard-map -- pp x sp opt-out total: explicitly pvaried param partials summed over the extra axis (see pp.py)
                 lambda g: lax.psum(g, ax)
                 if ax in getattr(jax.typeof(g), "vma", ()) else g,
                 gacc,
             )
             hacc = jax.tree.map(
+                # graftlint: disable=raw-collective-in-shard-map -- pp x sp opt-out total: head-grad partials summed over the extra axis, same rule as gacc
                 lambda h: lax.psum(h, ax)
                 if ax in getattr(jax.typeof(h), "vma", ()) else h,
                 hacc,
             )
         grads = jax.tree.map(lambda g: g[None], gacc)
+        # graftlint: disable=raw-collective-in-shard-map -- loss exit: only the last virtual stage holds a nonzero loss; psum replicates it (P() out-spec)
         loss = lax.psum(lacc, stage_axis)
         if stage_aux_coef is not None:
+            # graftlint: disable=raw-collective-in-shard-map -- stage-aux exit: total over stages (masked bubble ticks), pp.py convention
             aux = lax.psum(aacc, stage_axis) / (SV * M)
             for ax in extra_manual_axes:
+                # graftlint: disable=raw-collective-in-shard-map -- pp x sp aux: per-shard mean convention (training/spmd_lm.py)
                 aux = lax.pmean(aux, ax)
             loss = loss + stage_aux_coef * aux
         outs = [grads]
         if head_fn is not None:
             outs.append(jax.tree.map(
+                # graftlint: disable=raw-collective-in-shard-map -- head-grad exit: totals the last stage's accumulator and replicates over stages
                 lambda h: lax.psum(h, stage_axis), hacc
             ))
         if collect_input_grads:
+            # graftlint: disable=raw-collective-in-shard-map -- input-cotangent exit: stage 0 only; psum replicates for collection
             outs.append(lax.psum(dmbs, stage_axis))
         outs.append(loss)
         return tuple(outs)

@@ -116,7 +116,9 @@ def make_gossip_lm_step(
         loss, grads = jax.value_and_grad(loss_fn)(p)
         # One agent's seq-replicas each saw a different token shard: sum
         # both the loss and the gradient along the row.
+        # graftlint: disable=raw-collective-in-shard-map -- dp x sp row exit: per-token-shard loss totaled over seq (megatron-style row exit, training/tp.py NOTE)
         loss = lax.psum(loss, seq_axis)
+        # graftlint: disable=raw-collective-in-shard-map -- dp x sp row exit: gradient partials totaled over seq on the same row
         grads = lax.psum(grads, seq_axis)
 
         updates, opt_state0 = tx.update(

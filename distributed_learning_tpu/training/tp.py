@@ -38,7 +38,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["transformer_tp_rules", "shard_transformer_params",
-           "make_tp_train_step", "make_tp_generate"]
+           "make_tp_train_step", "make_tp_generate",
+           "constrain_decode_cache"]
 
 # NOTE on hand-written (shard_map) megatron regions: no explicit
 # Megatron f/g conjugate operators (arXiv:1909.08053 §3) are needed
@@ -222,6 +223,41 @@ def make_tp_train_step(
     return step
 
 
+def constrain_decode_cache(state: Any, mesh: Mesh, *,
+                           data_axis: str = "data",
+                           model_axis: str = "model") -> Any:
+    """Pin the KV cache to the head split: ``key``/``value`` are
+    (B, L, Hkv, Dh) — batch over data, heads over model (replicated
+    when Hkv doesn't divide, mirroring ``_divisible_or_replicated``);
+    the index/pos counters replicate.  Without the constraint the
+    decode scan carry is at the partitioner's mercy and a single
+    all-gather choice would replicate the cache — the memory TP decode
+    exists to shard.  Module-level so tests can pin the cache leaves'
+    sharding directly (tests/test_tp_decode.py) instead of grepping
+    compiled HLO."""
+    n_model = mesh.shape[model_axis]
+    n_data = mesh.shape[data_axis]
+
+    def place(path, leaf):
+        name = getattr(path[-1], "key", None)
+        if name in ("key", "value") and leaf.ndim == 4:
+            heads_ok = leaf.shape[2] % n_model == 0
+            batch_ok = leaf.shape[0] % n_data == 0
+            spec = P(
+                data_axis if batch_ok else None,
+                None,
+                model_axis if heads_ok else None,
+                None,
+            )
+        else:
+            spec = P()
+        return jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, spec)
+        )
+
+    return jax.tree_util.tree_map_with_path(place, state)
+
+
 @functools.lru_cache(maxsize=32)
 def _tp_generate_runner(dec, steps: int, temperature: float,
                         top_k, top_p, mesh: Mesh,
@@ -232,35 +268,14 @@ def _tp_generate_runner(dec, steps: int, temperature: float,
     from distributed_learning_tpu.models.transformer import sample_fn
 
     pick = sample_fn(temperature, top_k, top_p)
-    n_model = mesh.shape[model_axis]
     n_data = mesh.shape[data_axis]
 
     def constrain_cache(state):
-        """Pin the KV cache to the head split every step: ``key``/
-        ``value`` are (B, L, Hkv, Dh) — batch over data, heads over
-        model (replicated when Hkv doesn't divide, mirroring
-        ``_divisible_or_replicated``); the index/pos counters
-        replicate.  Without the constraint the scan carry is at the
-        partitioner's mercy and a single all-gather choice would
-        replicate the cache — the memory TP decode exists to shard."""
-        def place(path, leaf):
-            name = getattr(path[-1], "key", None)
-            if name in ("key", "value") and leaf.ndim == 4:
-                heads_ok = leaf.shape[2] % n_model == 0
-                batch_ok = leaf.shape[0] % n_data == 0
-                spec = P(
-                    data_axis if batch_ok else None,
-                    None,
-                    model_axis if heads_ok else None,
-                    None,
-                )
-            else:
-                spec = P()
-            return jax.lax.with_sharding_constraint(
-                leaf, NamedSharding(mesh, spec)
-            )
-
-        return jax.tree_util.tree_map_with_path(place, state)
+        # The per-step cache pin (see constrain_decode_cache's
+        # docstring for why the carry must be constrained every step).
+        return constrain_decode_cache(
+            state, mesh, data_axis=data_axis, model_axis=model_axis
+        )
 
     def constrain_params(params):
         def place(path, leaf):

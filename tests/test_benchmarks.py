@@ -220,6 +220,49 @@ def test_bench_cpu_fallback_on_wedge():
     assert "NOT a TPU measurement" in rec["note"]
 
 
+def test_bench_emit_claim_is_atomic(capsys):
+    """The one-JSON-line contract under thread races (ADVICE r5
+    bench.py:327): N threads racing _emit_record must produce exactly
+    one stdout line, and _emit_and_exit after a claimed emission must
+    not double-print (it exits 0 via the shared flag instead)."""
+    import threading
+
+    import jax
+
+    prev_prng = jax.config.jax_default_prng_impl
+    import bench
+
+    # Importing bench switches the global PRNG impl (its rbg knob);
+    # restore immediately so this in-process import cannot perturb other
+    # tests' exact PRNG streams.
+    jax.config.update("jax_default_prng_impl", prev_prng)
+    # Fresh claim state: the module may have been imported by an earlier
+    # test in this process.
+    bench._EMIT_STATE["done"] = False
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def racer(i):
+        barrier.wait()
+        if bench._emit_record({"metric": "race", "value": i}):
+            wins.append(i)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out_lines = [
+        l for l in capsys.readouterr().out.splitlines() if l.strip()
+    ]
+    assert len(wins) == 1 and len(out_lines) == 1, (wins, out_lines)
+    assert json.loads(out_lines[0])["value"] == wins[0]
+    # A second claim attempt (the watchdog/main race's loser) is refused.
+    assert bench._emit_record({"metric": "late"}) is False
+    assert capsys.readouterr().out == ""
+    bench._EMIT_STATE["done"] = False  # leave the module reusable
+
+
 def test_wrn_accuracy_cifar100_proxy_smoke(tmp_path, monkeypatch):
     """The cifar100 shape of the accuracy driver (the reference's second
     anchor, CIFAR_100_Baseline.ipynb cell 9): 100-class model wiring,

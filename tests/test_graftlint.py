@@ -1,0 +1,432 @@
+"""graftlint tier-1 coverage (AST stage needs no mesh and no jax).
+
+Three layers:
+
+* fixture files proving each rule FIRES on a violating snippet (a lint
+  whose rules can silently stop firing is worse than no lint);
+* suppression semantics (same-line, line-above, reason-required,
+  unknown-rule);
+* the tree itself: ``lint_paths()`` over the real scanned roots must
+  return zero findings — the repo's invariants hold, machine-checked;
+* the jaxpr/HLO audit: each registered entry point's collective
+  inventory must match its pin (entries needing a jax API this
+  environment lacks skip with the feature named).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.graftlint import RULES, lint_file, lint_paths
+from tools.graftlint import jaxpr_audit
+from tools.graftlint.core import REPO_ROOT
+
+
+def _lint(tmp_path, code, relname="snippet.py", rules=None):
+    p = tmp_path / relname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(code))
+    rule_map = None if rules is None else {r: RULES[r] for r in rules}
+    return lint_file(str(p), rules=rule_map, repo_root=str(tmp_path))
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------------- #
+# no-pickle                                                             #
+# --------------------------------------------------------------------- #
+def test_no_pickle_fires_on_import(tmp_path):
+    fs = _lint(tmp_path, "import pickle\n", rules=["no-pickle"])
+    assert _rules_of(fs) == ["no-pickle"]
+    assert "framing" in fs[0].message
+
+
+def test_no_pickle_fires_on_from_import_and_calls(tmp_path):
+    code = """
+    from pickle import loads
+    import numpy as np
+    df.to_pickle("x.pkl")
+    np.load("a.npy", allow_pickle=True)
+    """
+    fs = _lint(tmp_path, code, rules=["no-pickle"])
+    assert len(fs) == 3, fs
+
+
+def test_no_pickle_allowlists_cifar(tmp_path):
+    fs = _lint(
+        tmp_path,
+        "import pickle\n",
+        relname="distributed_learning_tpu/data/cifar.py",
+        rules=["no-pickle"],
+    )
+    assert fs == []
+
+
+# --------------------------------------------------------------------- #
+# banned-import                                                         #
+# --------------------------------------------------------------------- #
+def test_banned_import_fires_on_each_banned_module(tmp_path):
+    code = """
+    import cvxpy
+    import networkx as nx
+    from torchvision.models import resnet18
+    import torch
+    """
+    fs = _lint(tmp_path, code, rules=["banned-import"])
+    assert len(fs) == 4, fs
+
+
+def test_banned_import_allows_torch_in_interop(tmp_path):
+    fs = _lint(
+        tmp_path,
+        "import torch\n",
+        relname="distributed_learning_tpu/interop.py",
+        rules=["banned-import"],
+    )
+    assert fs == []
+
+
+# --------------------------------------------------------------------- #
+# raw-collective-in-shard-map                                           #
+# --------------------------------------------------------------------- #
+def test_raw_collective_fires_without_suppression(tmp_path):
+    code = """
+    from jax import lax
+    def f(x):
+        return lax.psum(x, "model")
+    """
+    fs = _lint(tmp_path, code, rules=["raw-collective-in-shard-map"])
+    assert _rules_of(fs) == ["raw-collective-in-shard-map"]
+    assert "lax.psum" in fs[0].message
+
+
+def test_raw_collective_fires_on_bare_import_alias(tmp_path):
+    code = """
+    from jax.lax import pmean
+    def f(x):
+        return pmean(x, "agents")
+    """
+    fs = _lint(tmp_path, code, rules=["raw-collective-in-shard-map"])
+    assert len(fs) == 1
+
+
+def test_raw_collective_bare_suppression_rejected(tmp_path):
+    code = """
+    from jax import lax
+    def f(x):
+        return lax.psum(x, "m")  # graftlint: disable=raw-collective-in-shard-map
+    """
+    fs = _lint(tmp_path, code, rules=["raw-collective-in-shard-map"])
+    assert len(fs) == 1 and "needs a reason" in fs[0].message
+
+
+def test_raw_collective_reasoned_suppression_accepted(tmp_path):
+    code = """
+    from jax import lax
+    def f(x):
+        return lax.psum(x, "m")  # graftlint: disable=raw-collective-in-shard-map -- megatron g exit
+    """
+    fs = _lint(tmp_path, code, rules=["raw-collective-in-shard-map"])
+    assert fs == []
+
+
+def test_suppression_on_line_above(tmp_path):
+    code = """
+    from jax import lax
+    def f(x):
+        # graftlint: disable=raw-collective-in-shard-map -- exit psum
+        return lax.psum(x, "m")
+    """
+    fs = _lint(tmp_path, code, rules=["raw-collective-in-shard-map"])
+    assert fs == []
+
+
+def test_unknown_rule_in_suppression_is_a_finding(tmp_path):
+    code = "x = 1  # graftlint: disable=not-a-rule\n"
+    fs = _lint(tmp_path, code)
+    assert _rules_of(fs) == ["bad-suppression"]
+    assert "not-a-rule" in fs[0].message
+
+
+# --------------------------------------------------------------------- #
+# host-sync-in-hot-path                                                 #
+# --------------------------------------------------------------------- #
+def test_host_sync_fires_in_jitted_fn(tmp_path):
+    code = """
+    import jax
+    @jax.jit
+    def step(x):
+        return x.item()
+    """
+    fs = _lint(tmp_path, code, rules=["host-sync-in-hot-path"])
+    assert _rules_of(fs) == ["host-sync-in-hot-path"]
+
+
+def test_host_sync_fires_in_scanned_lambda_and_body(tmp_path):
+    code = """
+    import jax
+    import numpy as np
+    from jax import lax
+
+    def body(c, t):
+        return c, float(c)
+
+    def run(xs):
+        lax.scan(body, 0.0, xs)
+        lax.scan(lambda c, t: (c, np.asarray(t)), 0.0, xs)
+    """
+    fs = _lint(tmp_path, code, rules=["host-sync-in-hot-path"])
+    assert len(fs) == 2, fs
+
+
+def test_host_sync_ignores_static_shape_math(tmp_path):
+    code = """
+    import functools, jax
+    import numpy as np
+    @functools.partial(jax.jit, static_argnames=("d",))
+    def f(x, d):
+        scale = float(1.0 / np.sqrt(d))
+        return x * scale
+    """
+    fs = _lint(tmp_path, code, rules=["host-sync-in-hot-path"])
+    assert fs == []
+
+
+def test_host_sync_ignores_cold_paths(tmp_path):
+    code = """
+    import numpy as np
+    def measure(losses):
+        return float(np.asarray(losses).mean())
+    """
+    fs = _lint(tmp_path, code, rules=["host-sync-in-hot-path"])
+    assert fs == []
+
+
+# --------------------------------------------------------------------- #
+# stdout-contract                                                       #
+# --------------------------------------------------------------------- #
+def test_stdout_contract_fires_on_bare_print(tmp_path):
+    code = """
+    import json, sys
+    print("starting up")
+    print(json.dumps({"metric": 1}))
+    print("diag", file=sys.stderr)
+    sys.stdout.write("x")
+    """
+    fs = _lint(tmp_path, code, relname="bench.py", rules=["stdout-contract"])
+    assert len(fs) == 2, fs
+    assert {f.line for f in fs} == {3, 6}  # the bare print + the write
+
+
+def test_stdout_contract_scoped_to_bench(tmp_path):
+    fs = _lint(
+        tmp_path, 'print("hello")\n', relname="other.py",
+        rules=["stdout-contract"],
+    )
+    assert fs == []
+
+
+# --------------------------------------------------------------------- #
+# reference-citation                                                    #
+# --------------------------------------------------------------------- #
+@pytest.fixture
+def fake_reference(tmp_path, monkeypatch):
+    ref = tmp_path / "refroot"
+    (ref / "utils").mkdir(parents=True)
+    (ref / "utils" / "mixer.py").write_text("\n".join(["x"] * 50) + "\n")
+    monkeypatch.setattr(
+        RULES["reference-citation"], "reference_root", str(ref)
+    )
+    return ref
+
+
+def test_reference_citation_resolves_good_cite(tmp_path, fake_reference):
+    code = '"""Parity: ``utils/mixer.py:18-41`` semantics."""\n'
+    fs = _lint(tmp_path, code, rules=["reference-citation"])
+    assert fs == []
+
+
+def test_reference_citation_fires_on_stale_line(tmp_path, fake_reference):
+    code = '"""See ``mixer.py:999`` for the loop."""\n'
+    fs = _lint(tmp_path, code, rules=["reference-citation"])
+    assert _rules_of(fs) == ["reference-citation"]
+    assert "mixer.py:999" in fs[0].message
+
+
+def test_reference_citation_fires_on_missing_file(tmp_path, fake_reference):
+    code = "# as in no_such_module.py:12\n"
+    fs = _lint(tmp_path, code, rules=["reference-citation"])
+    assert len(fs) == 1
+
+
+def test_reference_citation_skips_unverifiable(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        RULES["reference-citation"],
+        "reference_root",
+        str(tmp_path / "absent"),
+    )
+    fs = _lint(
+        tmp_path, "# see unknowable.py:7\n", rules=["reference-citation"]
+    )
+    assert fs == []
+
+
+# --------------------------------------------------------------------- #
+# the tree itself                                                       #
+# --------------------------------------------------------------------- #
+def test_tree_has_zero_unsuppressed_findings():
+    findings = lint_paths(None)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# --------------------------------------------------------------------- #
+# CLI rot-guard (the tests/test_config_cli.py-style smoke)              #
+# --------------------------------------------------------------------- #
+def _cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_list_rules():
+    out = _cli("--list-rules")
+    assert out.returncode == 0, out.stderr
+    for rule in ("no-pickle", "stdout-contract", "reference-citation"):
+        assert rule in out.stdout
+
+
+def test_cli_exits_nonzero_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import cvxpy\n")
+    out = _cli(str(bad))
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "banned-import" in out.stdout
+
+
+def test_cli_clean_tree_exits_zero_and_changed_mode_runs():
+    out = _cli("--rules", "banned-import,no-pickle")
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-500:])
+    out = _cli("--changed")
+    # --changed lints whatever is currently modified: rc 0/1 are both
+    # valid states; anything else is a harness break.
+    assert out.returncode in (0, 1), out.stderr
+    assert "graftlint:" in out.stderr
+
+
+def test_cli_rejects_unknown_rule():
+    out = _cli("--rules", "bogus-rule")
+    assert out.returncode == 2
+    assert "unknown rule" in out.stderr
+
+
+# --------------------------------------------------------------------- #
+# jaxpr/HLO audit                                                       #
+# --------------------------------------------------------------------- #
+def test_normalize_primitive_prefixes():
+    assert jaxpr_audit.normalize_primitive("psum") == "psum"
+    assert jaxpr_audit.normalize_primitive("psum_invariant") == "psum"
+    assert jaxpr_audit.normalize_primitive("psum2") == "psum"
+    assert jaxpr_audit.normalize_primitive("all_gather_invariant") == (
+        "all_gather"
+    )
+    assert jaxpr_audit.normalize_primitive("pvary") is None
+    assert jaxpr_audit.normalize_primitive("pcast") is None
+    assert jaxpr_audit.normalize_primitive("dot_general") is None
+
+
+def test_collector_counts_injected_psum():
+    """The collector must see through jit/shard_map/scan nesting — and
+    an injected psum must CHANGE the inventory (the property the pinned
+    entries rely on).  Uses whichever shard_map this jax provides."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if hasattr(jax, "shard_map"):
+        shard_map = jax.shard_map
+        kw = {}
+    else:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        shard_map = _sm
+        kw = {"check_rep": False}
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("a",))
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+
+    def make(extra_psum):
+        def f(x):
+            def body(c, t):
+                c = lax.ppermute(c, "a", perm)
+                if extra_psum:
+                    c = c + lax.psum(c, "a")
+                return c, t
+
+            c, _ = lax.scan(body, x, jnp.arange(3))
+            return c + lax.psum(x, "a")
+
+        sm = shard_map(
+            f, mesh=mesh, in_specs=P("a"), out_specs=P("a"), **kw
+        )
+        return jax.make_jaxpr(jax.jit(sm))(jnp.ones((8, 4)))
+
+    base = jaxpr_audit.collect_collectives(make(False).jaxpr)
+    assert base[("psum", ("a",))] == 1
+    assert base[("ppermute", ("a",))] == 1
+    injected = jaxpr_audit.collect_collectives(make(True).jaxpr)
+    assert injected[("psum", ("a",))] == 2, (
+        "an injected raw lax.psum must change the collective inventory"
+    )
+
+
+def test_audit_mismatch_reports_drift(tmp_path):
+    """The comparison logic end to end against a stub entry point."""
+    from collections import Counter
+
+    name = "_stub_entry"
+    jaxpr_audit.ENTRY_POINTS[name] = jaxpr_audit.EntryPoint(
+        name, "jaxpr", (), lambda: Counter({("psum", ("m",)): 2})
+    )
+    try:
+        exp = tmp_path / "expected.json"
+        exp.write_text(json.dumps(
+            {name: {"kind": "jaxpr", "inventory": {"psum|m": 1}}}
+        ))
+        res = jaxpr_audit.audit([name], expected_path=str(exp))[name]
+        assert res["status"] == "mismatch"
+        assert "audit-write" in res["detail"]
+        # and the regeneration path repins:
+        res = jaxpr_audit.audit(
+            [name], write=True, expected_path=str(exp)
+        )[name]
+        assert res["status"] == "ok"
+        assert json.loads(exp.read_text())[name]["inventory"] == {
+            "psum|m": 2
+        }
+    finally:
+        del jaxpr_audit.ENTRY_POINTS[name]
+
+
+@pytest.mark.parametrize("name", sorted(jaxpr_audit.ENTRY_POINTS))
+def test_audit_entry_inventory_pinned(name):
+    """The acceptance property: each registered SPMD entry point's
+    collective inventory matches its pin, so an injected collective
+    turns tier-1 red with the entry, op, and axis named."""
+    ep = jaxpr_audit.ENTRY_POINTS[name]
+    missing = ep.missing_features()
+    if missing:
+        pytest.skip(
+            f"jax lacks {missing} — {name} traces only on the new "
+            "shard_map API (jax >= 0.7); the pin stays recorded in "
+            "audit_expected.json"
+        )
+    res = jaxpr_audit.audit([name])[name]
+    assert res["status"] == "ok", res

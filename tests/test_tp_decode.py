@@ -75,24 +75,40 @@ def test_tp_decode_matches_single_device_sampled():
 def test_tp_decode_cache_is_head_sharded():
     """The point of the exercise: the KV cache must actually SHARD over
     the model axis (GQA Hkv=2 on a 2-way axis -> half the cache per
-    device), not silently replicate."""
+    device), not silently replicate.  Asserted on the cache LEAVES'
+    addressable shard shapes (the tests/test_pp_lm_tp.py QKV pattern)
+    — an unrelated same-shape tensor in the HLO can't mask a
+    replicated cache, and XLA's HLO printing can't break the test."""
     model, params, prompt = _setup(2, num_kv_heads=2)
     mesh = _mesh()
     p_sh = shard_transformer_params(params, mesh)
     dec = model.clone(decode=True)
 
-    from distributed_learning_tpu.training.tp import _tp_generate_runner
+    from distributed_learning_tpu.training.tp import constrain_decode_cache
 
-    run = _tp_generate_runner(dec, STEPS, 0.0, None, None, mesh,
-                              "data", "model")
+    @jax.jit
+    def prefill(p, tok):
+        _, state = dec.apply({"params": p}, tok, mutable=["cache"])
+        return constrain_decode_cache(state, mesh)
+
     with mesh:
-        lowered = run.lower(p_sh, prompt, None)
-    hlo = lowered.compile().as_text()
-    # The compiled program must carry a (B/2, L, Hkv/2, Dh) cache
-    # tensor: B=4 data-split 2, Hkv=2 model-split 2, L=max_len=32, Dh=8.
-    assert "2,32,1,8" in hlo.replace(" ", ""), (
-        "no head-sharded KV cache tensor found in the compiled decode"
-    )
+        state = prefill(p_sh, prompt)
+    kv = [
+        (path, leaf)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state)
+        if getattr(path[-1], "key", None) in ("key", "value")
+        and getattr(leaf, "ndim", 0) == 4
+    ]
+    assert len(kv) == 2 * 2, [jax.tree_util.keystr(p) for p, _ in kv]
+    for path, leaf in kv:
+        B_, L, Hkv, Dh = leaf.shape
+        assert (B_, L, Hkv, Dh) == (B, 32, 2, 8), leaf.shape
+        # B=4 data-split 2, Hkv=2 model-split 2: each device holds a
+        # (2, 32, 1, 8) shard — half the batch, half the heads.
+        got = leaf.addressable_shards[0].data.shape
+        assert got == (B_ // 2, L, Hkv // 2, Dh), (
+            jax.tree_util.keystr(path), got,
+        )
 
 
 def test_tp_decode_validates_like_generate():

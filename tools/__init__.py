@@ -1,0 +1,1 @@
+"""Repo tooling (not shipped with the framework package)."""

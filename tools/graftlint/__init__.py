@@ -1,0 +1,31 @@
+"""graftlint: static analysis enforcing this repo's SPMD, wire-format,
+and dependency invariants.
+
+Two stages:
+
+* AST (``tools/graftlint/rules.py``): pluggable source rules over
+  ``distributed_learning_tpu/``, ``benchmarks/``, ``examples/`` and
+  ``bench.py``, with ``# graftlint: disable=<rule>[ -- reason]`` inline
+  suppressions.  Imports no jax — safe and fast anywhere.
+* jaxpr/HLO audit (``tools/graftlint/jaxpr_audit.py``): traces the
+  registered SPMD entry points on the 8-virtual-device CPU mesh and
+  pins their collective inventories.
+
+CLI: ``python -m tools.graftlint`` (see ``--help``); tier-1 coverage:
+``tests/test_graftlint.py``.
+"""
+
+from tools.graftlint.core import (  # noqa: F401
+    DEFAULT_ROOTS,
+    REPO_ROOT,
+    RULES,
+    FileContext,
+    Finding,
+    Rule,
+    Suppressions,
+    iter_python_files,
+    lint_file,
+    lint_paths,
+    register,
+)
+import tools.graftlint.rules  # noqa: F401  (registers the rule set)

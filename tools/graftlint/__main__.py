@@ -1,0 +1,137 @@
+"""CLI: ``python -m tools.graftlint [paths...]``.
+
+Exits non-zero when any unsuppressed finding (or audit mismatch)
+survives.  The AST stage imports no jax, so it is safe to run without
+the CPU-pinning env dance; ``--audit`` sets ``JAX_PLATFORMS=cpu`` and
+the 8-virtual-device flag itself *before* jax is first imported.
+
+Pre-commit usage: ``python -m tools.graftlint --changed`` lints only
+files modified vs. HEAD (plus untracked ones) inside the scanned roots.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from tools.graftlint import (
+    DEFAULT_ROOTS,
+    REPO_ROOT,
+    RULES,
+    lint_paths,
+)
+
+
+def _changed_files() -> list:
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=False,
+    ).stdout.splitlines()
+    out += subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=False,
+    ).stdout.splitlines()
+    scoped = []
+    for rel in sorted(set(out)):
+        if not rel.endswith(".py"):
+            continue
+        if not any(
+            rel == root or rel.startswith(root.rstrip("/") + "/")
+            for root in DEFAULT_ROOTS
+        ):
+            continue
+        full = os.path.join(REPO_ROOT, rel)
+        if os.path.isfile(full):
+            scoped.append(full)
+    return scoped
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST + jaxpr static analysis for this repo's SPMD, "
+        "wire-format, and dependency invariants.",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: %s)"
+                    % ", ".join(DEFAULT_ROOTS))
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs. git HEAD")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the registered rules and exit")
+    ap.add_argument("--audit", action="store_true",
+                    help="also run the jaxpr/HLO collective-inventory "
+                    "audit on the 8-virtual-device CPU mesh")
+    ap.add_argument("--audit-write", action="store_true",
+                    help="regenerate audit_expected.json from the "
+                    "observed inventories (implies --audit)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            doc = (RULES[name].__doc__ or "").strip().splitlines()
+            print(f"{name:32s} {doc[0] if doc else ''}")
+        return 0
+
+    rules = None
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in wanted if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+        rules = {r: RULES[r] for r in wanted}
+
+    paths = args.paths
+    if args.changed:
+        paths = _changed_files()
+        if not paths and not (args.audit or args.audit_write):
+            print("graftlint: no changed files in scope", file=sys.stderr)
+            return 0
+
+    findings = lint_paths(paths or None, rules=rules)
+    for f in findings:
+        print(str(f))
+    rc = 1 if findings else 0
+
+    if args.audit or args.audit_write:
+        # The audit traces real entry points: pin the CPU mesh BEFORE
+        # jax is imported (the tests/conftest.py contract).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        from tools.graftlint.jaxpr_audit import audit
+
+        results = audit(write=args.audit_write)
+        for name, res in sorted(results.items()):
+            line = f"audit {name}: {res['status']}"
+            if res.get("detail"):
+                line += f" — {res['detail']}"
+            print(line, file=sys.stderr)
+            if res["status"] in ("mismatch", "error"):
+                rc = 1
+            if res["status"] == "unpinned":
+                print(
+                    f"audit {name}: no pin recorded; run with "
+                    "--audit-write to record it",
+                    file=sys.stderr,
+                )
+                rc = 1
+
+    n = len(findings)
+    print(
+        f"graftlint: {n} finding{'s' if n != 1 else ''}",
+        file=sys.stderr,
+    )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

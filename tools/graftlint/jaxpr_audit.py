@@ -1,0 +1,327 @@
+"""Stage (b): jaxpr/HLO audit of the registered SPMD entry points.
+
+The AST stage sees the *source*; this stage sees the *program*.  Each
+registered entry point is abstractly traced on the 8-virtual-device CPU
+mesh (the ``tests/test_flash_dtype.py`` pattern: trace, walk the jaxpr,
+assert a program property chip-free) and its **collective inventory** —
+which ops run over which named axes, and how many call sites — is
+compared against the pinned inventory in ``audit_expected.json``.
+
+An accidental extra collective (e.g. the ``training/pp.py`` head_fn
+hazard: a missing ``lax.pcast`` before a local cotangent transposes to
+a silent psum-over-stages) changes the inventory and fails tier-1 with
+the op, the axis, and the entry point named.
+
+Two trace modes:
+
+* ``jaxpr`` — ``jax.make_jaxpr`` the entry point and count collective
+  primitives (psum/pmax/ppermute/...) per axis tuple, descending into
+  scan/while/cond/pjit/shard_map sub-jaxprs.  Primitive names are
+  normalized by prefix (``psum_invariant``/``psum2`` -> ``psum``) so
+  the pins survive jax-internal renames; vma bookkeeping casts
+  (``pvary``/``pcast``/``pbroadcast``) are metadata, not traffic, and
+  are excluded.
+* ``hlo`` — for GSPMD entry points (``training/tp.py``) the collectives
+  are inserted by the XLA partitioner, so the jaxpr has none; compile
+  on the CPU mesh and count ``all-reduce``/``all-gather``/
+  ``collective-permute``/... instructions instead.
+
+Entry points whose code needs a jax API the running environment lacks
+(``jax.shard_map``/``lax.pcast`` landed after 0.4.x) report
+``status="skip"`` instead of failing: the audit pins the program, not
+the environment.  Regenerate pins after an intentional change with
+``python -m tools.graftlint --audit --audit-write``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import Counter
+from typing import Callable, Dict, List, Optional, Tuple
+
+EXPECTED_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "audit_expected.json"
+)
+
+#: communication primitives we inventory, by name prefix (longest first).
+_COLLECTIVE_PREFIXES = (
+    "psum_scatter",
+    "reduce_scatter",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "pmax",
+    "pmin",
+    "psum",
+)
+#: vma bookkeeping casts: metadata, not traffic — excluded on purpose.
+_EXCLUDED_PREFIXES = ("pvary", "pcast", "pbroadcast")
+
+_HLO_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|collective-permute|all-to-all|"
+    r"reduce-scatter|collective-broadcast)(?:-start)?\("
+)
+
+
+def normalize_primitive(name: str) -> Optional[str]:
+    """Map a primitive name to its inventory key, or None to exclude."""
+    for p in _EXCLUDED_PREFIXES:
+        if name.startswith(p):
+            return None
+    for p in _COLLECTIVE_PREFIXES:
+        if name.startswith(p):
+            return p
+    return None
+
+
+def _axes_of(params: dict) -> Tuple[str, ...]:
+    axes = params.get("axes", params.get("axis_name", params.get("axis", ())))
+    if isinstance(axes, str):
+        axes = (axes,)
+    try:
+        return tuple(sorted(a for a in axes if isinstance(a, str)))
+    except TypeError:
+        return ()
+
+
+def collect_collectives(jaxpr) -> Counter:
+    """Counter[(op, axes)] over a jaxpr, descending into sub-jaxprs."""
+    acc: Counter = Counter()
+
+    def walk(j):
+        for eqn in j.eqns:
+            op = normalize_primitive(eqn.primitive.name)
+            if op is not None:
+                acc[(op, _axes_of(eqn.params))] += 1
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (tuple, list)) else [val]
+                for v in vals:
+                    inner = getattr(v, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        walk(inner)
+                    elif hasattr(v, "eqns"):
+                        walk(v)
+
+    walk(jaxpr)
+    return acc
+
+
+def collect_hlo_collectives(hlo_text: str) -> Counter:
+    """Counter[(op, ())] over compiled HLO text (GSPMD-inserted ops)."""
+    acc: Counter = Counter()
+    for m in _HLO_COLLECTIVE_RE.finditer(hlo_text):
+        acc[(m.group(1), ())] += 1
+    return acc
+
+
+def _encode(inv: Counter) -> Dict[str, int]:
+    return {
+        f"{op}|{','.join(axes)}": n
+        for (op, axes), n in sorted(inv.items())
+    }
+
+
+def _features() -> Dict[str, bool]:
+    import jax
+
+    return {
+        "shard_map": hasattr(jax, "shard_map"),
+        "pcast": hasattr(jax.lax, "pcast"),
+    }
+
+
+class EntryPoint:
+    def __init__(self, name: str, kind: str, requires: Tuple[str, ...],
+                 build: Callable[[], Counter]):
+        self.name = name
+        self.kind = kind  # "jaxpr" | "hlo"
+        self.requires = requires
+        self.build = build
+
+    def missing_features(self) -> List[str]:
+        feats = _features()
+        return [f for f in self.requires if not feats.get(f, False)]
+
+
+ENTRY_POINTS: Dict[str, EntryPoint] = {}
+
+
+def entry(name: str, *, kind: str, requires: Tuple[str, ...] = ()):
+    def deco(fn):
+        ENTRY_POINTS[name] = EntryPoint(name, kind, requires, fn)
+        return fn
+
+    return deco
+
+
+def _mesh(shape, names):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    n = 1
+    for s in shape:
+        n *= s
+    return Mesh(np.array(jax.devices()[:n]).reshape(*shape), names)
+
+
+@entry("tp_train_step", kind="hlo")
+def _tp_train_step() -> Counter:
+    """DP x TP LM step on a (2, 2) mesh: every collective is inserted by
+    the XLA partitioner from the megatron shardings, so the pin is on
+    the compiled HLO (the tests/test_tp.py counting pattern)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_learning_tpu.models.transformer import TransformerLM
+    from distributed_learning_tpu.training.tp import make_tp_train_step
+
+    mesh = _mesh((2, 2), ("data", "model"))
+    model = TransformerLM(
+        vocab_size=32, num_layers=2, num_heads=4, head_dim=8, max_len=16
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 32, (4, 8)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, 32, (4, 8)), jnp.int32)
+    params = model.init(jax.random.key(0), x)["params"]
+    tx = optax.sgd(0.1)
+    opt = tx.init(params)
+    step = make_tp_train_step(mesh, model, tx)
+    hlo = step.lower(params, opt, x, y).compile().as_text()
+    return collect_hlo_collectives(hlo)
+
+
+@entry("pp_1f1b_head_fn", kind="jaxpr", requires=("shard_map", "pcast"))
+def _pp_1f1b_head_fn() -> Counter:
+    """The 1F1B head_fn path (training/pp.py): the entry whose vma
+    transpose hazard motivated the audit — an implicit invariant->
+    varying cast inside the head vjp would add a psum over the stage
+    axis to this inventory."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.training.pp import make_1f1b_train_step
+
+    S, D, M, MB = 4, 8, 4, 2
+    mesh = _mesh((S,), ("stage",))
+    key = jax.random.key(0)
+    stage_params = {
+        "w": jax.random.normal(key, (S, D, D), jnp.float32) * 0.1
+    }
+    head_params = {"w": jax.random.normal(key, (D, 1), jnp.float32) * 0.1}
+
+    def stage_fn(p, a):
+        return jnp.tanh(a @ p["w"])
+
+    def head_fn(hp, o, y):
+        return jnp.mean((o @ hp["w"] - y) ** 2)
+
+    step = make_1f1b_train_step(
+        mesh, stage_fn, head_fn=head_fn, collect_input_grads=True
+    )
+    mbs = jax.random.normal(key, (M, MB, D), jnp.float32)
+    labels = jnp.zeros((M, MB, 1), jnp.float32)
+    jx = jax.make_jaxpr(step)(stage_params, head_params, mbs, labels)
+    return collect_collectives(jx.jaxpr)
+
+
+@entry("consensus_mix_until", kind="jaxpr", requires=("shard_map",))
+def _consensus_mix_until() -> Counter:
+    """The sharded eps-stopping gossip loop (ConsensusEngine.mix_until
+    on a ring(8) mesh engine): ppermute per matching inside the while
+    body plus the pmean/pmax deviation reductions."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    mesh = _mesh((8,), ("agents",))
+    engine = ConsensusEngine(
+        Topology.ring(8).metropolis_weights(), mesh=mesh
+    )
+    x = {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4)}
+    jx = jax.make_jaxpr(
+        lambda s: engine.mix_until(s, eps=1e-6, max_rounds=32)[0]
+    )(x)
+    return collect_collectives(jx.jaxpr)
+
+
+def load_expected(path: str = EXPECTED_PATH) -> Dict[str, dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def audit(
+    names: Optional[List[str]] = None,
+    write: bool = False,
+    expected_path: str = EXPECTED_PATH,
+) -> Dict[str, dict]:
+    """Run the audit; returns {entry: {"status": ..., ...}}.
+
+    status is one of ``ok`` (inventory matches the pin), ``mismatch``
+    (diff in ``detail``), ``skip`` (environment lacks a jax feature the
+    entry needs — ``detail`` names it), ``error`` (the entry failed to
+    build even though its features are present: a real regression), or
+    ``unpinned`` (no expectation recorded; rerun with ``write=True``).
+    """
+    expected = load_expected(expected_path) if os.path.exists(
+        expected_path
+    ) else {}
+    results: Dict[str, dict] = {}
+    todo = names or sorted(ENTRY_POINTS)
+    for name in todo:
+        ep = ENTRY_POINTS[name]
+        missing = ep.missing_features()
+        if missing:
+            results[name] = {
+                "status": "skip",
+                "detail": "environment lacks jax feature(s): "
+                + ", ".join(missing),
+            }
+            continue
+        try:
+            observed = _encode(ep.build())
+        except Exception as exc:  # real breakage, not a pin mismatch
+            results[name] = {
+                "status": "error",
+                "detail": f"{type(exc).__name__}: {exc}",
+            }
+            continue
+        exp = expected.get(name, {}).get("inventory")
+        if write or exp is None:
+            expected[name] = {
+                "kind": ep.kind,
+                "inventory": observed,
+                "verified": True,
+            }
+            results[name] = {
+                "status": "ok" if write else "unpinned",
+                "observed": observed,
+            }
+            continue
+        if observed == exp:
+            results[name] = {"status": "ok", "observed": observed}
+        else:
+            gone = {k: v for k, v in exp.items() if observed.get(k) != v}
+            new = {k: v for k, v in observed.items() if exp.get(k) != v}
+            results[name] = {
+                "status": "mismatch",
+                "observed": observed,
+                "expected": exp,
+                "detail": (
+                    f"collective inventory drift in {name}: expected "
+                    f"{gone or '{}'} but observed {new or '{}'} — if the "
+                    "change is intentional, regenerate the pin with "
+                    "'python -m tools.graftlint --audit --audit-write'"
+                ),
+            }
+    if write:
+        with open(expected_path, "w", encoding="utf-8") as fh:
+            json.dump(expected, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    return results
