@@ -45,10 +45,25 @@ import numpy as np
 import optax
 
 from distributed_learning_tpu.models import WideResNet
+from distributed_learning_tpu.obs import SpanTracer
 from distributed_learning_tpu.parallel.consensus import ConsensusEngine
 from distributed_learning_tpu.parallel.topology import Topology
 
 BASELINE_SAMPLES_PER_SEC = 100 * 50_000 / 29_887.0  # T4, BASELINE.md
+
+# Per-phase wall-clock spans (probe / compile / warmup / measure / emit):
+# aggregated into the one JSON record's "phases" payload so the driver
+# log shows where a run's time went.  Registry-free tracer — nothing
+# here may print; stdout stays the single json.dumps line.
+_TRACER = SpanTracer()
+
+
+def _phase_payload() -> dict:
+    """{phase: {"s": total_seconds, "n": count}} over the spans so far."""
+    return {
+        name: {"s": round(agg["total_s"], 3), "n": agg["count"]}
+        for name, agg in sorted(_TRACER.aggregate().items())
+    }
 
 
 def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
@@ -167,20 +182,23 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
         ).astype(np.int32)
         return jnp.asarray(idx.reshape(n_agents, steps, batch).swapaxes(0, 1))
 
-    state, losses = run_epoch(state, Xs, ys, epoch_idx(0))  # compile
-    np.asarray(losses)
+    with _TRACER.span("compile"):
+        state, losses = run_epoch(state, Xs, ys, epoch_idx(0))  # compile
+        np.asarray(losses)
     if on_first_op is not None:
         on_first_op()
-    state, losses = run_epoch(state, Xs, ys, epoch_idx(1))  # warm
-    np.asarray(losses)
+    with _TRACER.span("warmup"):
+        state, losses = run_epoch(state, Xs, ys, epoch_idx(1))  # warm
+        np.asarray(losses)
 
     if trace_dir is not None:
         jax.profiler.start_trace(trace_dir)
-    t0 = time.perf_counter()
-    for e in range(epochs):
-        state, losses = run_epoch(state, Xs, ys, epoch_idx(2 + e))
-    np.asarray(losses)
-    elapsed = time.perf_counter() - t0
+    with _TRACER.span("measure"):
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            state, losses = run_epoch(state, Xs, ys, epoch_idx(2 + e))
+        np.asarray(losses)
+        elapsed = time.perf_counter() - t0
     if trace_dir is not None:
         jax.profiler.stop_trace()
     return n_agents * batch * steps * epochs / elapsed
@@ -405,9 +423,10 @@ def main():
     # float() forces a host copy — the only sync this backend honors
     # (see measure_throughput's docstring); async dispatch alone would
     # "complete" without the op ever executing.
-    probe = float(
-        (jnp.ones((512, 512), jnp.bfloat16) @ jnp.ones((512, 512), jnp.bfloat16))[0, 0]
-    )
+    with _TRACER.span("probe"):
+        probe = float(
+            (jnp.ones((512, 512), jnp.bfloat16) @ jnp.ones((512, 512), jnp.bfloat16))[0, 0]
+        )
     import sys
 
     print(
@@ -482,6 +501,7 @@ def main():
                 "config": f"{n_agents} agents x batch {small_b}, bf16 — "
                           "small stand-in banked before the WRN-28-10 "
                           "attempt; not comparable to the T4 anchor",
+                "phases": _phase_payload(),
             })
             import sys
             print(
@@ -560,15 +580,19 @@ def main():
             batch //= 2
             pool = steps * batch
 
-    result = {
-        "metric": f"gossip_sgd_wrn{depth}x{widen}_cifar10_throughput_{platform}",
-        "value": round(sps, 2),
-        "unit": "samples/sec",
-        "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
-        "provisional": False,
-        "config": f"{n_agents} agents x batch {batch}, bf16, rbg dropout, "
-                  "mix 1/epoch",
-    }
+    # The emit phase covers record assembly + banking; its span must
+    # close before the payload snapshot reads the aggregates.
+    with _TRACER.span("emit"):
+        result = {
+            "metric": f"gossip_sgd_wrn{depth}x{widen}_cifar10_throughput_{platform}",
+            "value": round(sps, 2),
+            "unit": "samples/sec",
+            "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+            "provisional": False,
+            "config": f"{n_agents} agents x batch {batch}, bf16, rbg dropout, "
+                      "mix 1/epoch",
+        }
+    result["phases"] = _phase_payload()
     # Bank the completed headline FIRST (one dict, one schema): a
     # deadline that fires anywhere past this line emits THIS
     # measurement, never the inferior provisional record.  Then stand

@@ -23,6 +23,9 @@ Subpackages
 ``data``      Titanic and CIFAR pipelines with per-agent sharding.
 ``training``  gossip-SGD trainer (the reference's documented ``MasterNode``
               surface), checkpointing, telemetry.
+``obs``       unified observability: metrics registry (JSONL + run-report
+              exporters), device-side metrics carry, span tracing, gossip
+              counters (see ``docs/observability.md``).
 ``utils``     logging, metrics, tree utilities.
 """
 

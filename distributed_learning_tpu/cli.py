@@ -10,6 +10,13 @@ reproducibility (``--config``/``--dump-config``).
 
     python -m distributed_learning_tpu --net_type wide-resnet --depth 28 \
         --widen_factor 10 --dropout 0.3 --dataset cifar10 --nodes 4
+
+Subcommands (dispatched before the trainer flag surface):
+
+    python -m distributed_learning_tpu.cli obs-report <run.jsonl>
+
+summarizes a JSONL observability event log (``docs/observability.md``)
+without importing jax or touching any device.
 """
 
 from __future__ import annotations
@@ -170,10 +177,17 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "obs-report":
+        # jax-free path: replay + summarize an obs JSONL event log.
+        from distributed_learning_tpu.obs.report import obs_report_main
+
+        return obs_report_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.dump_config:
         cfg.save(args.dump_config)
+        # graftlint: disable=no-print-in-library -- CLI progress lines: stdout is this command's user interface
         print(f"wrote {args.dump_config}")
         return 0
 
@@ -188,12 +202,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.epochs is not None:
             saved.epoch = args.epochs
         cfg = saved
+        # graftlint: disable=no-print-in-library -- CLI progress lines: stdout is this command's user interface
         print(f"loaded experiment config from {cfg_path}")
 
     master = cfg.build()
     master.initialize_nodes()
     if (args.resume or args.testOnly) and ckpt and os.path.exists(ckpt):
         master.restore_checkpoint(ckpt)
+        # graftlint: disable=no-print-in-library -- CLI progress lines: stdout is this command's user interface
         print(f"restored checkpoint from {ckpt} "
               f"(epoch {master._epochs_done})")
 
@@ -201,6 +217,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         params, bs = master.state[0], master.state[1]
         accs = master._eval_accuracy(params, bs)
         for name, acc in zip(master.node_names, accs):
+            # graftlint: disable=no-print-in-library -- testOnly's result lines: stdout is this command's user interface
             print(f"node {name}: test acc {acc:.4f}")
         return 0
 
@@ -213,6 +230,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if out["test_acc"] is None
             else " ".join(f"{a:.4f}" for a in np.asarray(out["test_acc"]))
         )
+        # graftlint: disable=no-print-in-library -- per-epoch training log: stdout is this command's user interface
         print(
             f"| epoch {out['epoch'] + 1:3d}/{cfg.epoch}  "
             f"loss {float(np.mean(out['train_loss'])):.4f}  "

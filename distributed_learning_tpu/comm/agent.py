@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import logging
 from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 import numpy as np
@@ -31,6 +32,7 @@ import numpy as np
 from distributed_learning_tpu.comm.framing import FramedStream, open_framed_connection
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
 from distributed_learning_tpu.comm import protocol as P
+from distributed_learning_tpu.obs import get_registry
 
 __all__ = [
     "ConsensusAgent",
@@ -163,11 +165,43 @@ class ConsensusAgent:
         self._choco_hat_self: Optional[np.ndarray] = None
         self._choco_hat_nbrs: Dict[str, np.ndarray] = {}
         self._choco_invalidated_by: Optional[str] = None
+        # Observability: named logger (obs and logs share one switch —
+        # `logging.getLogger("dlt").setLevel(DEBUG)`; the legacy
+        # debug=True flag wires a handler via enable_debug_logging) and
+        # per-agent gossip counters mirrored into the default registry.
+        self._log = logging.getLogger(f"dlt.comm.agent.{self.token}")
+        if debug:
+            from distributed_learning_tpu.utils.profiling import (
+                enable_debug_logging,
+            )
+
+            enable_debug_logging()
+        self.counters: Dict[str, float] = {}
 
     # ------------------------------------------------------------------ #
-    def _debug(self, *args):
-        if self.debug:
-            print(f"[agent {self.token}]", *args, flush=True)
+    def _debug(self, msg: str, *args):
+        """Lazy-formatted debug line on the agent's named logger."""
+        self._log.debug(msg, *args)
+
+    def _count(self, name: str, value: float = 1) -> None:
+        """Bump a per-agent counter and its ``comm.agent.*`` aggregate
+        in the default registry."""
+        self.counters[name] = self.counters.get(name, 0) + value
+        get_registry().inc(f"comm.agent.{name}", value)
+
+    def wire_stats(self) -> Dict[str, int]:
+        """Whole-frame byte/frame totals over this agent's live streams
+        (master + neighbors) — the per-process "bytes framed" view of
+        the registry's global ``comm.bytes_framed_*`` counters."""
+        streams = list(self._neighbors.values())
+        if self._master is not None:
+            streams.append(self._master)
+        return {
+            "bytes_sent": sum(s.bytes_sent for s in streams),
+            "bytes_received": sum(s.bytes_received for s in streams),
+            "frames_sent": sum(s.frames_sent for s in streams),
+            "frames_received": sum(s.frames_received for s in streams),
+        }
 
     @property
     def neighbor_tokens(self) -> Tuple[str, ...]:
@@ -200,6 +234,7 @@ class ConsensusAgent:
                 # Rejoin raced the master's death detection: our
                 # predecessor's control stream still looks registered.
                 # Back off until the master observes the death.
+                self._count("register_retries")
                 self._master.close()
                 await asyncio.sleep(0.05)
                 continue
@@ -248,7 +283,7 @@ class ConsensusAgent:
         if self._expected_peers:
             await asyncio.wait_for(self._peers_ready.wait(), timeout)
         self.status = AgentStatus.READY
-        self._debug(f"ready; neighbors={sorted(self._neighbors)}")
+        self._debug("ready; neighbors=%s", sorted(self._neighbors))
 
     async def _handle_peer(self, reader, writer):
         stream = FramedStream(reader, writer)
@@ -319,10 +354,13 @@ class ConsensusAgent:
             # mixing against.
             value = self._prev_value
         elif key > (self._op_id, self._iteration):
+            self._count("requests_deferred")
             self._deferred.setdefault(key, []).append(token)
             return
         else:
+            self._count("stale_requests_dropped")
             return  # stale (finished op/iteration): drop
+        self._count("responses_sent")
         await self._neighbors[token].send(
             self._make_response(req.round_id, req.iteration, value)
         )
@@ -363,6 +401,7 @@ class ConsensusAgent:
     async def _flush_deferred(self) -> None:
         key = (self._op_id, self._iteration)
         for token in self._deferred.pop(key, []):
+            self._count("responses_sent")
             await self._neighbors[token].send(
                 self._make_response(
                     self._op_id, self._iteration, self._iter_value
@@ -377,6 +416,7 @@ class ConsensusAgent:
         ``y <- (1 - sum_j w_j) y + sum_j w_j y_j`` (parity: run_once's
         update, agent.py:204-207).  Returns None if Done/Shutdown arrived
         mid-iteration (round aborted by the master)."""
+        self._count("gossip_iterations")
         values = await self._exchange_values(y)
         if values is None:
             return None
@@ -448,6 +488,7 @@ class ConsensusAgent:
                     # Elastic abort: the value is mid-mix (and still weight
                     # lifted in run_round) — it must NOT be returned as a
                     # consensus result.
+                    self._count("rounds_aborted")
                     raise RoundAbortedError(
                         f"round {self._round_id} aborted by the master"
                     )
@@ -458,7 +499,7 @@ class ConsensusAgent:
                 raise ShutdownError(msg.reason)
             elif isinstance(msg, P.NewRoundNotification):
                 # Can't happen mid-round with a correct master; ignore.
-                self._debug(f"unexpected {msg} mid-round")
+                self._debug("unexpected %s mid-round", msg)
         if done_seen:
             return None
         return values
@@ -523,6 +564,7 @@ class ConsensusAgent:
         # agents at different iteration counts.
         self._op_id += 1
         self._iteration = 0
+        self._count("run_once")
         out = await self._gossip_iteration(y)
         assert out is not None  # no master Done in masterless mode
         return out
@@ -597,6 +639,7 @@ class ConsensusAgent:
             ))
         self._op_id += 1
         self._iteration = 0
+        self._count("choco_iterations")
         self._int8_active = self.int8_wire  # int8 only for this exchange
         try:
             neighbor_qs = await self._exchange_values(q)
@@ -668,6 +711,7 @@ class ConsensusAgent:
                 self._iteration += 1
                 y_new = await self._gossip_iteration(y)
                 if y_new is None:  # Done broadcast mid-iteration
+                    self._count("rounds_run")
                     return y
                 # Two-sided residual (the reference's one-sided check at
                 # consensus_asyncio.py:297 is a recorded defect).
@@ -679,6 +723,7 @@ class ConsensusAgent:
                 await self._master.send(
                     status(round_id=self._round_id, iteration=self._iteration)
                 )
+            self._count("rounds_run")
             return y
         finally:
             self._in_master_round = False
@@ -687,6 +732,7 @@ class ConsensusAgent:
 
     async def send_telemetry(self, payload: Dict[str, Any]) -> None:
         """Parity: ``send_telemetry``, agent.py:214-218."""
+        self._count("telemetry_sent")
         await self._master.send(P.Telemetry(token=self.token, payload=payload))
 
     def _require_neighbors(self) -> None:

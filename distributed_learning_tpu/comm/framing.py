@@ -20,6 +20,7 @@ from typing import Optional
 
 from distributed_learning_tpu import native
 from distributed_learning_tpu.comm.protocol import Message, pack_message, unpack_message
+from distributed_learning_tpu.obs import get_registry
 
 __all__ = ["FramedStream", "FrameError", "open_framed_connection"]
 
@@ -33,12 +34,22 @@ class FrameError(ConnectionError):
 
 
 class FramedStream:
-    """``send(Message)`` / ``recv() -> Message`` over one TCP connection."""
+    """``send(Message)`` / ``recv() -> Message`` over one TCP connection.
+
+    Per-stream ``bytes_sent``/``bytes_received``/``frames_sent``/
+    ``frames_received`` count whole frames (header + body + crc) — the
+    "bytes framed" wire-volume metric; the totals also aggregate into
+    the default obs registry (``comm.bytes_framed_out/in``,
+    ``comm.frames_out/in``)."""
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self.reader = reader
         self.writer = writer
         self._send_lock = asyncio.Lock()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.frames_sent = 0
+        self.frames_received = 0
 
     @property
     def peername(self):
@@ -50,9 +61,15 @@ class FramedStream:
             raise FrameError(f"frame of {len(body)} bytes exceeds {MAX_FRAME}")
         crc = native.crc32(body)
         header = _HEADER.pack(len(body), WIRE_VERSION, code, 0)
+        nbytes = len(header) + len(body) + 4
         async with self._send_lock:
             self.writer.write(header + body + struct.pack("<I", crc))
             await self.writer.drain()
+        self.bytes_sent += nbytes
+        self.frames_sent += 1
+        reg = get_registry()
+        reg.inc("comm.bytes_framed_out", nbytes)
+        reg.inc("comm.frames_out")
 
     async def recv(self) -> Message:
         header = await self.reader.readexactly(_HEADER.size)
@@ -65,6 +82,11 @@ class FramedStream:
         (crc,) = struct.unpack("<I", await self.reader.readexactly(4))
         if native.crc32(body) != crc:
             raise FrameError("frame checksum mismatch")
+        self.bytes_received += _HEADER.size + length + 4
+        self.frames_received += 1
+        reg = get_registry()
+        reg.inc("comm.bytes_framed_in", _HEADER.size + length + 4)
+        reg.inc("comm.frames_in")
         return unpack_message(code, body)
 
     def close(self) -> None:

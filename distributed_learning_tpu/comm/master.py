@@ -28,6 +28,7 @@ exists for heterogeneous CPU-host deployments and protocol parity.
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,6 +36,7 @@ import numpy as np
 from distributed_learning_tpu.comm.framing import FramedStream
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
 from distributed_learning_tpu.comm import protocol as P
+from distributed_learning_tpu.obs import get_registry
 from distributed_learning_tpu.parallel.fast_averaging import solve_fastest_mixing
 from distributed_learning_tpu.parallel.topology import Topology
 from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
@@ -101,10 +103,26 @@ class ConsensusMaster:
         self.elastic = bool(elastic)
         self._down: set = set()
 
+        # Observability: named logger + round/telemetry counters (the
+        # gossip-round accounting the reference's _debug prints threw
+        # away), mirrored into the default obs registry.
+        self._log = logging.getLogger("dlt.comm.master")
+        if debug:
+            from distributed_learning_tpu.utils.profiling import (
+                enable_debug_logging,
+            )
+
+            enable_debug_logging()
+        self.counters: Dict[str, float] = {}
+
     # ------------------------------------------------------------------ #
-    def _debug(self, *args):
-        if self.debug:
-            print("[master]", *args, flush=True)
+    def _debug(self, msg: str, *args):
+        """Lazy-formatted debug line on the master's named logger."""
+        self._log.debug(msg, *args)
+
+    def _count(self, name: str, value: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + value
+        get_registry().inc(f"comm.master.{name}", value)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -154,7 +172,8 @@ class ConsensusMaster:
         self._down.discard(token)
         self._control[token] = stream
         self._listen_addr[token] = (msg.host, msg.port)
-        self._debug(f"registered {token} @ {msg.host}:{msg.port}")
+        self._count("registrations")
+        self._debug("registered %s @ %s:%s", token, msg.host, msg.port)
         await stream.send(P.Ok(info="rejoined" if rejoining else "registered"))
         # Into the mux immediately: deaths are then observable in every
         # phase, including the registration window, and the serve loop's
@@ -167,7 +186,8 @@ class ConsensusMaster:
             # its peer connections itself, so nobody else needs its new
             # address.
             await self._send_neighborhood(token)
-            self._debug(f"{token} rejoined")
+            self._count("rejoins")
+            self._debug("%s rejoined", token)
             return
         if len(self._control) == len(self._tokens):
             await self._initialize_agents()
@@ -181,7 +201,7 @@ class ConsensusMaster:
             # all-registered).  Its rejoin re-requests the neighborhood, so
             # skipping here is safe; raising would kill the registration
             # handler and wedge the deployment.
-            self._debug(f"skip neighborhood for {token}: not connected")
+            self._debug("skip neighborhood for %s: not connected", token)
             return
         i = self._index[token]
         nbs: List[P.Neighbor] = []
@@ -210,7 +230,7 @@ class ConsensusMaster:
         except (ConnectionError, OSError) as exc:
             # The death itself surfaces through the mux sentinel; here we
             # only keep the caller (registration handler or init loop) alive.
-            self._debug(f"neighborhood send to {token} failed: {exc}")
+            self._debug("neighborhood send to %s failed: %s", token, exc)
 
     async def _initialize_agents(self) -> None:
         """Send every agent its neighborhood + mixing weights (parity:
@@ -245,13 +265,16 @@ class ConsensusMaster:
                         self._round_weights.pop(token, None)
                         if self._round_running:
                             self._round_running = False
+                            self._count("rounds_aborted")
                             await self._broadcast(
                                 P.Done(round_id=self._round_id, aborted=True)
                             )
                             self._debug(
-                                f"round {self._round_id} aborted: {token} died"
+                                "round %s aborted: %s died",
+                                self._round_id, token,
                             )
-                        self._debug(f"agent {token} down; awaiting rejoin")
+                        self._count("agents_down")
+                        self._debug("agent %s down; awaiting rejoin", token)
                         continue
                     # Control connection lost.  No recovery protocol exists
                     # in non-elastic mode (parity: reference master's only
@@ -263,16 +286,19 @@ class ConsensusMaster:
                 elif isinstance(msg, (P.Converged, P.NotConverged)):
                     await self._on_status(token, msg)
                 elif isinstance(msg, P.Telemetry):
+                    self._count("telemetry_payloads")
                     if self.telemetry is not None:
                         self.telemetry.process(msg.token or token, msg.payload)
                 elif isinstance(msg, P.ErrorException):
                     raise RuntimeError(f"agent {token}: {msg.message}")
                 else:
-                    self._debug(f"ignoring {type(msg).__name__} from {token}")
+                    self._debug(
+                        "ignoring %s from %s", type(msg).__name__, token
+                    )
         except asyncio.CancelledError:
             pass
         except Exception as e:  # parity: shutdown broadcast on master error
-            self._debug(f"error: {e!r}; broadcasting shutdown")
+            self._debug("error: %r; broadcasting shutdown", e)
             await self._broadcast(P.Shutdown(reason=repr(e)))
         finally:
             self._stopped.set()
@@ -292,10 +318,11 @@ class ConsensusMaster:
             self._converged = {t: False for t in self._tokens}
             mean_w = float(np.mean(list(self._round_weights.values())))
             self._round_weights.clear()
+            self._count("rounds_started")
             await self._broadcast(
                 P.NewRoundNotification(round_id=self._round_id, mean_weight=mean_w)
             )
-            self._debug(f"round {self._round_id} started, mean_w={mean_w}")
+            self._debug("round %s started, mean_w=%s", self._round_id, mean_w)
 
     async def _on_status(self, token: str, msg):
         if msg.round_id != self._round_id or not self._round_running:
@@ -303,15 +330,16 @@ class ConsensusMaster:
         self._converged[token] = isinstance(msg, P.Converged)
         if all(self._converged.values()):
             self._round_running = False
+            self._count("rounds_done")
             await self._broadcast(P.Done(round_id=self._round_id))
-            self._debug(f"round {self._round_id} done")
+            self._debug("round %s done", self._round_id)
 
     async def _broadcast(self, msg) -> None:
         for token, stream in list(self._control.items()):
             try:
                 await stream.send(msg)
             except (ConnectionError, OSError):
-                self._debug(f"broadcast to {token} failed")
+                self._debug("broadcast to %s failed", token)
 
     # ------------------------------------------------------------------ #
     async def shutdown(self, reason: str = "") -> None:

@@ -20,10 +20,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import jax
 import numpy as np
+
+from distributed_learning_tpu.obs import get_registry
 
 __all__ = ["prefetch_to_device", "epoch_batches"]
 
@@ -44,6 +47,13 @@ def prefetch_to_device(
     device.  Exceptions raised by the source iterator propagate to the
     consumer at the matching position; the daemon thread never outlives
     the consumer by more than the queue depth.
+
+    Observability (obs/): ``data.prefetch.batches`` counts staged
+    batches, ``data.prefetch.consumer_wait_s`` accumulates the seconds
+    the consumer blocked on the queue (the "did the lookahead hide the
+    transfer" signal — near zero means the pipeline kept up), and the
+    ``data.prefetch.depth`` gauge samples the queue depth at each get.
+    All host-side clock reads; the transfers themselves stay async.
     """
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
@@ -77,14 +87,22 @@ def prefetch_to_device(
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
+    reg = get_registry()
     try:
         while True:
+            reg.gauge("data.prefetch.depth", q.qsize())
+            t_wait = time.perf_counter()
             item = q.get()
+            reg.inc(
+                "data.prefetch.consumer_wait_s",
+                time.perf_counter() - t_wait,
+            )
             if isinstance(item, tuple) and len(item) == 2 and \
                     item[0] is _SENTINEL:
                 if item[1] is not None:
                     raise item[1]
                 return
+            reg.inc("data.prefetch.batches")
             yield item
     finally:
         stop.set()

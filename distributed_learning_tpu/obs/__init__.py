@@ -1,0 +1,62 @@
+"""Unified observability layer: metrics registry, device-side metrics
+carry, span tracing, and the comm-layer gossip counters.
+
+One import surface for the four pieces:
+
+* :class:`MetricsRegistry` (+ JSONL event-log / run-report exporters)
+  — `registry.py`;
+* :func:`flush_chunk` / :func:`global_norm` — the device-side metrics
+  carry that keeps instrumentation out of the hot path — `carry.py`;
+* :class:`SpanTracer` (nested wall-clock spans, Chrome trace export,
+  ``jax.profiler`` integration) — `spans.py`;
+* :func:`instrument_step` — transparent call wrapping for compiled step
+  functions — `instrument.py`;
+* run-report rendering + the ``obs-report`` CLI — `report.py`.
+
+Library code counts into the process-wide default registry/tracer
+(`get_registry()` / `get_tracer()`); tests and multi-run drivers scope
+them with `use_registry` / `set_tracer`.
+"""
+
+from distributed_learning_tpu.obs.carry import flush_chunk, global_norm
+from distributed_learning_tpu.obs.instrument import InstrumentedStep, instrument_step
+from distributed_learning_tpu.obs.registry import (
+    JsonlSink,
+    JsonlTelemetry,
+    MetricsRegistry,
+    get_registry,
+    read_jsonl,
+    run_report,
+    set_registry,
+    use_registry,
+)
+from distributed_learning_tpu.obs.report import format_run_report, obs_report_main
+from distributed_learning_tpu.obs.spans import (
+    Span,
+    SpanTracer,
+    get_tracer,
+    set_tracer,
+    span,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "JsonlSink",
+    "JsonlTelemetry",
+    "get_registry",
+    "set_registry",
+    "use_registry",
+    "read_jsonl",
+    "run_report",
+    "flush_chunk",
+    "global_norm",
+    "Span",
+    "SpanTracer",
+    "get_tracer",
+    "set_tracer",
+    "span",
+    "InstrumentedStep",
+    "instrument_step",
+    "format_run_report",
+    "obs_report_main",
+]

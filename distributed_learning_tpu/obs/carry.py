@@ -1,0 +1,86 @@
+"""Device-side metrics carry: per-step scalars accumulated *inside* the
+jitted chunk, flushed to the registry once per chunk host-side.
+
+The repo's hot-path contract (graftlint ``host-sync-in-hot-path``, the
+pinned jaxpr/HLO audits) forbids instrumentation that syncs or
+communicates per step.  The carry pattern satisfies it by construction:
+
+* inside the jitted chunk, each tracked metric is an ordinary traced
+  scalar (loss, grad norm, consensus residual, mixing-round count) that
+  the ``lax.scan`` stacks into a ``(steps, ...)`` trace — pure device
+  compute, no collectives, no callbacks;
+* the chunk returns those traces alongside its existing outputs, and
+  the host flushes them with ONE ``np.asarray`` materialization per
+  array per chunk (:func:`flush_chunk`) — the same sync the trainer
+  already pays to read its loss curve.
+
+The carry is part of the compiled program whether or not a registry is
+attached, so toggling observability cannot change the computation: an
+obs-enabled run is bit-identical to an obs-disabled one (the oracle
+test in ``tests/test_obs.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Sequence
+
+from distributed_learning_tpu.obs.registry import MetricsRegistry
+
+__all__ = ["global_norm", "flush_chunk"]
+
+
+def global_norm(tree: Any):
+    """L2 norm of a pytree, accumulated in f32 — the device-side grad
+    norm metric (jax-traced; call inside the jitted step).  Equivalent
+    to ``optax.global_norm`` but f32-accumulated regardless of the
+    state dtype, so bf16 training still reports a usable norm."""
+    import jax
+    import jax.numpy as jnp
+
+    total = jnp.float32(0.0)
+    for leaf in jax.tree.leaves(tree):
+        lf = leaf.astype(jnp.float32)
+        total = total + jnp.sum(lf * lf)
+    return jnp.sqrt(total)
+
+
+def flush_chunk(
+    registry: Optional[MetricsRegistry],
+    carry: Mapping[str, Any],
+    *,
+    step0: int = 0,
+    node_names: Optional[Sequence] = None,
+    prefix: str = "train",
+) -> Dict[str, Any]:
+    """Flush one jitted chunk's carried metric traces to ``registry``.
+
+    ``carry`` maps metric name to a per-chunk array: scalars, ``(steps,)``
+    traces, or ``(steps, n_nodes)`` stacked traces.  Each array is
+    materialized host-side exactly once (``np.asarray``) — the single
+    per-chunk sync the carry pattern allows.  Per-node chunk means are
+    recorded as ``{prefix}.{name}/{node}`` series points at the chunk's
+    final step, plus the cross-node mean as ``{prefix}.{name}``;
+    scalars record one point.  Returns the materialized numpy arrays so
+    the caller reuses them (the trainer feeds the same arrays to its
+    stats/telemetry paths — no second sync).
+    """
+    import numpy as np
+
+    arrays = {k: np.asarray(v) for k, v in carry.items()}
+    if registry is None:
+        return arrays
+    for name, arr in arrays.items():
+        key = f"{prefix}.{name}" if prefix else str(name)
+        if arr.ndim == 0:
+            registry.observe(key, float(arr), step=step0)
+            continue
+        steps = arr.shape[0]
+        end = step0 + steps
+        if arr.ndim >= 2 and node_names is not None and \
+                arr.shape[1] == len(node_names):
+            for a, node in enumerate(node_names):
+                registry.observe(
+                    f"{key}/{node}", float(arr[:, a].mean()), step=end
+                )
+        registry.observe(key, float(arr.mean()), step=end)
+    return arrays

@@ -1,0 +1,100 @@
+"""Run-report rendering + the ``obs-report`` CLI subcommand.
+
+``python -m distributed_learning_tpu.cli obs-report <run.jsonl>``
+replays a JSONL event log (written by
+``MetricsRegistry.dump_jsonl`` or streamed by a ``JsonlSink`` /
+``JsonlTelemetry``) and prints the aggregated run summary: counter
+totals, last gauges, time-series stats, and span timings — "where did
+this run's time and bandwidth go" without TensorBoard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Sequence
+
+from distributed_learning_tpu.obs.registry import MetricsRegistry
+
+__all__ = ["format_run_report", "obs_report_main"]
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def format_run_report(report: dict) -> str:
+    """Human-readable rendering of ``MetricsRegistry.run_report()``."""
+    lines: List[str] = []
+    wall = report.get("wall_s")
+    head = f"run report — {report.get('events', 0)} events"
+    if wall is not None:
+        head += f" over {wall:.3f}s"
+    lines.append(head)
+    if report.get("counters"):
+        lines.append("\ncounters:")
+        for name in sorted(report["counters"]):
+            lines.append(f"  {name:44s} {_fmt(report['counters'][name]):>14}")
+    if report.get("gauges"):
+        lines.append("\ngauges (last value):")
+        for name in sorted(report["gauges"]):
+            lines.append(f"  {name:44s} {_fmt(report['gauges'][name]):>14}")
+    if report.get("series"):
+        lines.append(
+            f"\nseries:\n  {'name':44s} {'n':>6} {'mean':>12} "
+            f"{'min':>12} {'max':>12} {'last':>12}"
+        )
+        for name in sorted(report["series"]):
+            s = report["series"][name]
+            lines.append(
+                f"  {name:44s} {s['count']:6d} {s['mean']:12.5g} "
+                f"{s['min']:12.5g} {s['max']:12.5g} {s['last']:12.5g}"
+            )
+    if report.get("spans"):
+        lines.append(
+            f"\nspans (wall clock):\n  {'name':44s} {'n':>6} "
+            f"{'total s':>12} {'mean s':>12} {'max s':>12}"
+        )
+        for name in sorted(
+            report["spans"],
+            key=lambda n: -report["spans"][n]["total_s"],
+        ):
+            s = report["spans"][name]
+            lines.append(
+                f"  {name:44s} {s['count']:6d} {s['total_s']:12.4f} "
+                f"{s['mean_s']:12.4f} {s['max_s']:12.4f}"
+            )
+    return "\n".join(lines)
+
+
+def obs_report_main(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for ``cli.py obs-report``."""
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_learning_tpu.cli obs-report",
+        description="summarize a JSONL observability event log",
+    )
+    ap.add_argument("path", help="JSONL event log (dump_jsonl/JsonlSink)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw run_report dict as JSON")
+    args = ap.parse_args(argv)
+    try:
+        report = MetricsRegistry.from_jsonl(args.path).run_report()
+    except FileNotFoundError:
+        # graftlint: disable=no-print-in-library -- CLI error reporting to stderr (argparse convention)
+        print(f"obs-report: no such file: {args.path}", file=sys.stderr)
+        return 2
+    except (json.JSONDecodeError, ValueError) as exc:
+        # graftlint: disable=no-print-in-library -- CLI error reporting to stderr (argparse convention)
+        print(f"obs-report: {args.path} is not a JSONL event log: {exc}",
+              file=sys.stderr)
+        return 2
+    text = (
+        json.dumps(report, indent=2, sort_keys=True)
+        if args.json else format_run_report(report)
+    )
+    # graftlint: disable=no-print-in-library -- obs-report's stdout IS its interface (the CLI subcommand's one output)
+    print(text)
+    return 0

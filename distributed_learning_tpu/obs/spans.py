@@ -1,0 +1,204 @@
+"""Nested wall-clock span tracing with Chrome trace-event export.
+
+``jax.profiler`` answers "where did the *device* time go" (see
+``utils/profiling.py``); these spans answer the host-side half — "where
+did this *step's wall clock* go": jitted-chunk dispatch vs gossip vs
+eval vs host bookkeeping.  A span is a context manager; spans nest, the
+per-thread stack tracks depth/parentage, and the result exports as
+Chrome ``traceEvents`` JSON (load in ``chrome://tracing`` / Perfetto)
+or aggregates into the run report through the
+:class:`~distributed_learning_tpu.obs.registry.MetricsRegistry`.
+
+``profiler=True`` additionally wraps every span in
+``jax.profiler.TraceAnnotation`` (via
+:func:`distributed_learning_tpu.utils.profiling.annotate`), so the same
+span names appear inside a TensorBoard device profile when one is being
+captured — one naming scheme across both tools.
+
+Everything is host-side: entering/leaving a span is two monotonic clock
+reads and a list append.  No device syncs, no jax import unless
+``profiler=True``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from distributed_learning_tpu.obs.registry import MetricsRegistry, get_registry
+
+__all__ = ["Span", "SpanTracer", "get_tracer", "set_tracer", "span"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One completed span (times are seconds on the tracer's clock)."""
+
+    name: str
+    t0: float
+    dur: float
+    depth: int
+    parent: Optional[str]
+    tid: int
+
+
+class SpanTracer:
+    """Collects nested wall-clock spans.
+
+    Parameters
+    ----------
+    registry:
+        Optional :class:`MetricsRegistry` to aggregate completed spans
+        into (``record_span``), so span stats join the run report and
+        the JSONL event log.  A zero-arg callable is resolved per span
+        (the default tracer passes ``get_registry`` so
+        ``use_registry`` scoping applies to spans too).
+    profiler:
+        Also emit each span as a ``jax.profiler.TraceAnnotation`` so the
+        names land inside an active device profile.
+    max_spans:
+        Bound on the retained per-span detail (aggregates in the
+        registry stay exact past the cap; the Chrome export covers the
+        first ``max_spans`` spans).
+    """
+
+    def __init__(self, *, registry: Optional[MetricsRegistry] = None,
+                 profiler: bool = False, max_spans: int = 1 << 16,
+                 clock=time.perf_counter):
+        self.registry = registry
+        self.profiler = bool(profiler)
+        self._clock = clock
+        self._max_spans = int(max_spans)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._epoch = self._clock()
+        self.spans: List[Span] = []
+        self.dropped = 0
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        """Time the enclosed block as span ``name`` (nested spans record
+        their depth and parent)."""
+        stack = self._stack()
+        depth = len(stack)
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        if self.profiler:
+            from distributed_learning_tpu.utils.profiling import annotate
+
+            cm: Any = annotate(name)
+        else:
+            cm = contextlib.nullcontext()
+        t0 = self._clock()
+        try:
+            with cm:
+                yield
+        finally:
+            dur = self._clock() - t0
+            stack.pop()
+            with self._lock:
+                if len(self.spans) < self._max_spans:
+                    self.spans.append(Span(
+                        name=name, t0=t0 - self._epoch, dur=dur,
+                        depth=depth, parent=parent,
+                        tid=threading.get_ident(),
+                    ))
+                else:
+                    self.dropped += 1
+            reg = (
+                self.registry() if callable(self.registry)
+                else self.registry
+            )
+            if reg is not None:
+                reg.record_span(name, dur, depth=depth, t0=t0 - self._epoch)
+
+    # ------------------------------------------------------------------ #
+    def aggregate(self) -> Dict[str, dict]:
+        """Per-name count/total/mean/max over the retained spans."""
+        with self._lock:
+            spans = list(self.spans)
+        out: Dict[str, dict] = {}
+        for s in spans:
+            agg = out.setdefault(
+                s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            agg["count"] += 1
+            agg["total_s"] += s.dur
+            agg["max_s"] = max(agg["max_s"], s.dur)
+        for agg in out.values():
+            agg["mean_s"] = agg["total_s"] / agg["count"]
+        return out
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (complete 'X' events, microseconds);
+        load the exported file in ``chrome://tracing`` or Perfetto."""
+        with self._lock:
+            spans = list(self.spans)
+        events = [
+            {
+                "name": s.name,
+                "ph": "X",
+                "ts": round(s.t0 * 1e6, 3),
+                "dur": round(s.dur * 1e6, 3),
+                "pid": 0,
+                "tid": s.tid,
+                "args": {"depth": s.depth, "parent": s.parent or ""},
+            }
+            for s in spans
+        ]
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write :meth:`to_chrome_trace` to ``path``; returns the event
+        count."""
+        trace = self.to_chrome_trace()
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(trace, fh)
+        return len(trace["traceEvents"])
+
+    def reset(self) -> None:
+        with self._lock:
+            self.spans.clear()
+            self.dropped = 0
+            self._epoch = self._clock()
+
+
+# ---------------------------------------------------------------------- #
+# Default (process-wide) tracer                                          #
+# ---------------------------------------------------------------------- #
+_DEFAULT: Optional[SpanTracer] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_tracer() -> SpanTracer:
+    """The process-wide default tracer, lazily bound to the default
+    registry (so library spans aggregate into the same run report as the
+    library counters)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = SpanTracer(registry=get_registry)
+        return _DEFAULT
+
+
+def set_tracer(tracer: SpanTracer) -> Optional[SpanTracer]:
+    """Replace the default tracer; returns the previous one."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        prev, _DEFAULT = _DEFAULT, tracer
+        return prev
+
+
+def span(name: str):
+    """Convenience: a span on the default tracer."""
+    return get_tracer().span(name)

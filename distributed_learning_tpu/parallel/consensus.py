@@ -39,6 +39,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_learning_tpu.obs import get_registry, get_tracer
 from distributed_learning_tpu.ops import mixing as ops
 from .schedule import MatchingSchedule, chebyshev_omegas, validate_mixing_matrix
 from .topology import Topology, gamma as exact_gamma
@@ -285,11 +286,21 @@ class ConsensusEngine:
         sharding = NamedSharding(self.mesh, P(self.axis_name))
         return jax.tree.map(lambda v: jax.device_put(v, sharding), stacked)
 
+    @staticmethod
+    def _count_rounds(times) -> None:
+        """Gossip-round counter (obs): static round counts only — a
+        traced ``times`` (caller inside jit) is counted by the caller at
+        its own chunk boundary, never synced here."""
+        if not isinstance(times, jax.core.Tracer):
+            get_registry().inc("consensus.rounds_run", int(times))
+
     def mix(self, stacked: Pytree, times: int = 1) -> Pytree:
         """Run exactly ``times`` gossip rounds (``Mixer.mix(times, eps=None)``
         semantics, ``mixer.py:18-41``)."""
         fn = self._get_jitted("mix")
-        return fn(stacked, jnp.int32(times))
+        self._count_rounds(times)
+        with get_tracer().span("consensus.mix"):
+            return fn(stacked, jnp.int32(times))
 
     def mix_until(
         self,
@@ -310,12 +321,14 @@ class ConsensusEngine:
         ``max_rounds`` bounds the loop (the reference's is unbounded).
         """
         fn = self._get_jitted("mix_until")
-        return fn(
-            stacked,
-            jnp.float32(eps),
-            jnp.int32(min_times),
-            jnp.int32(max_rounds),
-        )
+        get_registry().inc("consensus.mix_until.calls")
+        with get_tracer().span("consensus.mix_until"):
+            return fn(
+                stacked,
+                jnp.float32(eps),
+                jnp.int32(min_times),
+                jnp.int32(max_rounds),
+            )
 
     def mix_until_with(
         self,
@@ -345,22 +358,24 @@ class ConsensusEngine:
             jnp.int32(min_times),
             jnp.int32(max_rounds),
         )
-        if W_traced is not None:
-            return self._get_jitted("mix_until_with")(
-                stacked, W_traced, *args
+        get_registry().inc("consensus.mix_until.calls")
+        with get_tracer().span("consensus.mix_until_with"):
+            if W_traced is not None:
+                return self._get_jitted("mix_until_with")(
+                    stacked, W_traced, *args
+                )
+            self_w, w_fwd, w_bwd, k_hops = decomp
+            fn = self._get_ring_jitted(
+                "mix_until_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
             )
-        self_w, w_fwd, w_bwd, k_hops = decomp
-        fn = self._get_ring_jitted(
-            "mix_until_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
-        )
-        return fn(
-            stacked,
-            jnp.asarray(self_w),
-            jnp.asarray(w_fwd),
-            jnp.asarray(w_bwd),
-            jnp.int32(k_hops),
-            *args,
-        )
+            return fn(
+                stacked,
+                jnp.asarray(self_w),
+                jnp.asarray(w_fwd),
+                jnp.asarray(w_bwd),
+                jnp.int32(k_hops),
+                *args,
+            )
 
     def mix_pairwise(
         self,
@@ -397,8 +412,10 @@ class ConsensusEngine:
         edges = np.argwhere(np.abs(upper) > 1e-12)
         if len(edges) == 0:
             return stacked
+        self._count_rounds(rounds)
         if self.mesh is not None:
-            return self._mix_pairwise_sharded(stacked, key, rounds, edges)
+            with get_tracer().span("consensus.mix_pairwise"):
+                return self._mix_pairwise_sharded(stacked, key, rounds, edges)
         ckey = ("pairwise", len(edges))
         if ckey not in self._jit_cache:
             edges_dev = jnp.asarray(edges, jnp.int32)
@@ -424,7 +441,8 @@ class ConsensusEngine:
                 return out
 
             self._jit_cache[ckey] = jax.jit(f)
-        return self._jit_cache[ckey](stacked, key, jnp.int32(rounds))
+        with get_tracer().span("consensus.mix_pairwise"):
+            return self._jit_cache[ckey](stacked, key, jnp.int32(rounds))
 
     def _random_maximal_matchings(
         self, edges: np.ndarray
@@ -537,7 +555,9 @@ class ConsensusEngine:
             self._jit_cache[key] = jax.jit(
                 lambda x: self._run_chebyshev(x, omegas)
             )
-        return self._jit_cache[key](stacked)
+        self._count_rounds(times)
+        with get_tracer().span("consensus.mix_chebyshev"):
+            return self._jit_cache[key](stacked)
 
     def _traced_w_dispatch(self, W, route: str):
         """Shared guard for the traced-W entry points.
@@ -606,22 +626,24 @@ class ConsensusEngine:
         ``route="auto"`` picks whichever moves less data per round.
         """
         W_traced, decomp = self._traced_w_dispatch(W, route)
-        if W_traced is not None:
-            return self._get_jitted("mix_with")(
-                stacked, W_traced, jnp.int32(times)
+        self._count_rounds(times)
+        with get_tracer().span("consensus.mix_with"):
+            if W_traced is not None:
+                return self._get_jitted("mix_with")(
+                    stacked, W_traced, jnp.int32(times)
+                )
+            self_w, w_fwd, w_bwd, k_hops = decomp
+            fn = self._get_ring_jitted(
+                "mix_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
             )
-        self_w, w_fwd, w_bwd, k_hops = decomp
-        fn = self._get_ring_jitted(
-            "mix_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
-        )
-        return fn(
-            stacked,
-            jnp.asarray(self_w),
-            jnp.asarray(w_fwd),
-            jnp.asarray(w_bwd),
-            jnp.int32(k_hops),
-            jnp.int32(times),
-        )
+            return fn(
+                stacked,
+                jnp.asarray(self_w),
+                jnp.asarray(w_fwd),
+                jnp.asarray(w_bwd),
+                jnp.int32(k_hops),
+                jnp.int32(times),
+            )
 
     def mix_chebyshev_with(
         self, stacked: Pytree, W, omegas, *, route: str = "auto"
@@ -637,22 +659,24 @@ class ConsensusEngine:
         """
         omegas = jnp.asarray(omegas, dtype=jnp.float32)
         W_traced, decomp = self._traced_w_dispatch(W, route)
-        if W_traced is not None:
-            return self._get_jitted("mix_chebyshev_with")(
-                stacked, W_traced, omegas
+        self._count_rounds(int(omegas.shape[0]))
+        with get_tracer().span("consensus.mix_chebyshev_with"):
+            if W_traced is not None:
+                return self._get_jitted("mix_chebyshev_with")(
+                    stacked, W_traced, omegas
+                )
+            self_w, w_fwd, w_bwd, k_hops = decomp
+            fn = self._get_ring_jitted(
+                "mix_chebyshev_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
             )
-        self_w, w_fwd, w_bwd, k_hops = decomp
-        fn = self._get_ring_jitted(
-            "mix_chebyshev_with_ring", bool(w_fwd.any()), bool(w_bwd.any())
-        )
-        return fn(
-            stacked,
-            jnp.asarray(self_w),
-            jnp.asarray(w_fwd),
-            jnp.asarray(w_bwd),
-            jnp.int32(k_hops),
-            omegas,
-        )
+            return fn(
+                stacked,
+                jnp.asarray(self_w),
+                jnp.asarray(w_fwd),
+                jnp.asarray(w_bwd),
+                jnp.int32(k_hops),
+                omegas,
+            )
 
     def global_average(self, stacked: Pytree) -> Pytree:
         """Exact averaging — the gamma=0 degenerate case (centralized DP
@@ -665,7 +689,9 @@ class ConsensusEngine:
         round replaces neighbor gossip with one exact all-reduce, removing
         the accumulated consensus error at bounded extra bandwidth).
         """
-        return self._get_jitted("global_average")(stacked)
+        get_registry().inc("consensus.global_averages")
+        with get_tracer().span("consensus.global_average"):
+            return self._get_jitted("global_average")(stacked)
 
     def run_round(
         self,

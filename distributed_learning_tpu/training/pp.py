@@ -316,7 +316,11 @@ def make_pipeline_apply(
         )
         return sharded(stage_params, microbatches)
 
-    return apply
+    # Host-side span + call counter; .lower()/.trace() still reach the
+    # jit object, so the pinned collective inventories are untouched.
+    from distributed_learning_tpu.obs import instrument_step
+
+    return instrument_step(apply, "pp.apply")
 
 
 def make_1f1b_train_step(
@@ -628,11 +632,13 @@ def make_1f1b_train_step(
         )
         return sharded(stage_params, head_params, microbatches, labels)
 
+    from distributed_learning_tpu.obs import instrument_step
+
     if head_fn is not None:
-        return _step
+        return instrument_step(_step, "pp.1f1b_step")
 
     @jax.jit  # re-jitted so callers keep .lower()/.compile() access
     def step(stage_params, microbatches, labels):
         return _step(stage_params, {}, microbatches, labels)
 
-    return step
+    return instrument_step(step, "pp.1f1b_step")

@@ -220,7 +220,12 @@ def make_tp_train_step(
         params = optax.apply_updates(params, updates)
         return constrain_params(params), constrain_opt(opt_state, params), loss
 
-    return step
+    # Call-level span + counter only: the wrapper delegates .lower() to
+    # the jit object, so the compiled program (and its pinned HLO
+    # collective inventory — tools/graftlint --audit) is untouched.
+    from distributed_learning_tpu.obs import instrument_step
+
+    return instrument_step(step, "tp.train_step")
 
 
 def constrain_decode_cache(state: Any, mesh: Mesh, *,
@@ -364,4 +369,6 @@ def make_tp_generate(
         with mesh:
             return run(params, prompt, key)
 
-    return gen
+    from distributed_learning_tpu.obs import instrument_step
+
+    return instrument_step(gen, "tp.generate")

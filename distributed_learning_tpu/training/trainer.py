@@ -39,6 +39,12 @@ import numpy as np
 import optax
 
 from distributed_learning_tpu.models import get_model
+from distributed_learning_tpu.obs import (
+    MetricsRegistry,
+    SpanTracer,
+    flush_chunk,
+    global_norm as obs_global_norm,
+)
 from distributed_learning_tpu.ops import mixing as ops
 from distributed_learning_tpu.parallel.consensus import ConsensusEngine
 from distributed_learning_tpu.parallel.schedule import chebyshev_omegas
@@ -230,6 +236,7 @@ class ConsensusNode:
             matplotlib.use("Agg", force=False)
             import matplotlib.pyplot as plt
         except Exception:  # pragma: no cover - matplotlib is present in CI
+            # graftlint: disable=no-print-in-library -- show_graphs' matplotlib-free fallback: the summary IS the user-requested output
             print(self.summary())
             return None
         fig, axes = plt.subplots(1, 2, figsize=(10, 4))
@@ -296,6 +303,7 @@ class GossipTrainer:
         compression_gamma: float = 0.2,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
+        obs: Any = None,
         seed: int = 0,
         dropout: bool = True,
         augment: bool = False,
@@ -328,6 +336,28 @@ class GossipTrainer:
         self.metric_fn = get_metric(error)
         self.tx = make_optimizer(optimizer, optimizer_kwargs, learning_rate)
         self.telemetry = telemetry
+        # Observability (obs/): None disables host-side flushing, True
+        # uses the process-wide default registry/tracer, or pass a
+        # MetricsRegistry.  The device-side carry (per-step loss / acc /
+        # grad-norm traces) is part of the compiled chunk EITHER WAY, so
+        # toggling obs cannot change the computation — obs-on training
+        # is bit-identical to obs-off (tests/test_obs.py oracle).
+        if obs is None or obs is False:
+            self._obs_registry = None
+            self._obs_tracer = None
+        elif obs is True:
+            from distributed_learning_tpu.obs import get_registry, get_tracer
+
+            self._obs_registry = get_registry()
+            self._obs_tracer = get_tracer()
+        elif isinstance(obs, MetricsRegistry):
+            self._obs_registry = obs
+            self._obs_tracer = SpanTracer(registry=obs)
+        else:
+            raise ValueError(
+                "obs must be None/False (off), True (default registry), "
+                f"or a MetricsRegistry; got {obs!r}"
+            )
         self.stat_step = int(stat_step)
         self.num_epochs = int(epoch)
         self.epoch_cons_num = int(epoch_cons_num)
@@ -570,9 +600,13 @@ class GossipTrainer:
             (loss, (new_bs, acc)), grads = jax.value_and_grad(
                 lossf, has_aux=True
             )(params)
+            # Device-side metrics carry (obs/carry.py): the grad norm is
+            # computed on device and stacked by the epoch scan; the host
+            # reads it once per chunk alongside the loss trace.
+            gnorm = obs_global_norm(grads)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, new_bs, opt_state, loss, acc
+            return params, new_bs, opt_state, loss, acc, gnorm
 
         vstep = jax.vmap(train_step)
 
@@ -584,7 +618,8 @@ class GossipTrainer:
             its batch from the resident shards inside the scan, so the
             permuted epoch tensor is never materialized and the only
             per-epoch host->device transfer is the index array.
-            Returns state plus (steps, n) loss/acc traces.
+            Returns state plus (steps, n) loss/acc/grad-norm traces (the
+            device-side metrics carry).
             """
             take = jax.vmap(lambda X, i: jnp.take(X, i, axis=0))
 
@@ -594,13 +629,15 @@ class GossipTrainer:
                 y = take(ys, idx_t)
                 rng, *subs = jax.random.split(rng, n + 1)
                 subkeys = jnp.stack(subs)
-                params, bs, opt, loss, acc = vstep(params, bs, opt, x, y, subkeys)
-                return (params, bs, opt, rng), (loss, acc)
+                params, bs, opt, loss, acc, gnorm = vstep(
+                    params, bs, opt, x, y, subkeys
+                )
+                return (params, bs, opt, rng), (loss, acc, gnorm)
 
-            (params, bs, opt, rng), (losses, accs) = jax.lax.scan(
+            (params, bs, opt, rng), (losses, accs, gnorms) = jax.lax.scan(
                 body, state, idx
             )
-            return (params, bs, opt, rng), losses, accs
+            return (params, bs, opt, rng), losses, accs, gnorms
 
         # Donating the carried state lets XLA reuse its buffers in place —
         # at WRN scale the stacked params/opt slots dominate HBM, so the
@@ -710,20 +747,135 @@ class GossipTrainer:
         idx = idx.reshape(n, steps, self.batch_size).swapaxes(0, 1)
         return jnp.asarray(idx)
 
+    def _gossip(self, epoch_idx: int, params: Pytree):
+        """One epoch's consensus phase; returns ``(params, rounds_run)``.
+
+        ``rounds_run`` is the gossip-round count this epoch actually
+        executed — static for fixed-count paths, read back from the
+        eps-stopping ``lax.while_loop`` (one scalar host copy at the
+        chunk boundary, which the carry contract allows) for ``mix_eps``
+        paths.
+        """
+        mix_times = self.mix_times
+        if self.mix_times_schedule is not None:
+            # Adaptive averaging period (arXiv:1910.13598 — communicate
+            # less early, more as training converges, or vice versa).
+            mix_times = int(self.mix_times_schedule(epoch_idx))
+            if mix_times < 1:
+                raise ValueError(
+                    f"mix_times_schedule({epoch_idx}) returned "
+                    f"{mix_times}; must be >= 1 (0 would silently skip "
+                    "gossip while reporting a mixed epoch)"
+                )
+        rounds = mix_times
+        consensus_epochs = epoch_idx + 1 - self.epoch_cons_num
+        if (
+            self.global_avg_every is not None
+            and consensus_epochs % self.global_avg_every
+            == self.global_avg_every - 1
+        ):
+            # Gossip-PGA (arXiv:2105.09080): every H-th consensus epoch
+            # is one exact all-reduce, zeroing the consensus residual.
+            params = self.engine.global_average(params)
+            rounds = 1
+            # CHOCO estimates tracked the pre-all-reduce iterates; kept,
+            # they would push the now-identical params apart again next
+            # epoch.  Reset — error feedback re-converges from zero.
+            self._choco_xhat = None
+        elif self.topology_schedule is not None:
+            # Time-varying graph: resample, resolve, mix via the
+            # traced-W path (no recompilation per epoch).
+            W_e = resolve_mixing_matrix(
+                self.topology_schedule(epoch_idx), self.node_names
+            )
+            if self.chebyshev:
+                g_e = mixing_gamma(W_e)
+                if not (0.0 <= g_e < 1.0):
+                    raise ValueError(
+                        f"topology_schedule({epoch_idx}) produced a "
+                        f"graph with gamma={g_e}; Chebyshev acceleration "
+                        "needs a connected graph with gamma < 1"
+                    )
+                omegas = chebyshev_omegas(g_e, mix_times)
+                params = self.engine.mix_chebyshev_with(params, W_e, omegas)
+            elif self.mix_eps is not None:
+                # Eps-stopping composed with the traced-W path: the
+                # resampled graph still gossips until the residual
+                # drops below eps (at least mix_times rounds).
+                params, t, _ = self.engine.mix_until_with(
+                    params, W_e, eps=self.mix_eps, min_times=mix_times
+                )
+                rounds = int(t)
+            else:
+                params = self.engine.mix_with(params, W_e, times=mix_times)
+        elif self._choco is not None:
+            # CHOCO-SGD: compressed-correction gossip; the public
+            # estimates persist across epochs (reset only by a fresh
+            # initialize_nodes / checkpoint restore — error feedback
+            # re-converges them).
+            from distributed_learning_tpu.parallel.compression import (
+                ChocoState,
+            )
+
+            if self._choco_xhat is None:
+                cstate = self._choco.init(params, seed=self.seed + 2)
+            else:
+                cstate = ChocoState(
+                    x=params, xhat=self._choco_xhat, key=self._choco_key
+                )
+            cstate, _ = self._choco.run(cstate, mix_times)
+            params = cstate.x
+            self._choco_xhat = cstate.xhat
+            self._choco_key = cstate.key
+        elif self.chebyshev:
+            params = self.engine.mix_chebyshev(params, times=mix_times)
+        elif self.mix_eps is None:
+            params = self.engine.mix(params, times=mix_times)
+        else:
+            params, t, _ = self.engine.mix_until(
+                params, eps=self.mix_eps, min_times=mix_times
+            )
+            rounds = int(t)
+        return params, rounds
+
+    def _span(self, name: str):
+        """Wall-clock span on the trainer's tracer (no-op when obs is
+        disabled)."""
+        import contextlib
+
+        if self._obs_tracer is None:
+            return contextlib.nullcontext()
+        return self._obs_tracer.span(name)
+
     def train_epoch(self) -> Dict[str, Any]:
         """One epoch: local SGD on every node, then (maybe) gossip."""
+        with self._span("trainer.epoch"):
+            return self._train_epoch()
+
+    def _train_epoch(self) -> Dict[str, Any]:
         if self._state is None:
             self.initialize_nodes()
         epoch_idx = self._epochs_done
         idx = self._epoch_indices(epoch_idx)
         try:
-            self._state, losses, accs = self._jit_epoch(
-                self._state, self._Xs, self._ys, idx
-            )
-            # Materialize inside the try: dispatch is async, so an execution
-            # failure (e.g. OOM) surfaces here, not at the call above.
-            losses = np.asarray(losses)  # (steps, n)
-            accs = np.asarray(accs)
+            with self._span("trainer.chunk"):
+                self._state, losses, accs, gnorms = self._jit_epoch(
+                    self._state, self._Xs, self._ys, idx
+                )
+                # Materialize inside the try: dispatch is async, so an
+                # execution failure (e.g. OOM) surfaces here, not at the
+                # call above.  flush_chunk is the carry's single
+                # per-chunk host materialization; with obs enabled the
+                # same arrays also land in the registry as series.
+                arrs = flush_chunk(
+                    self._obs_registry,
+                    {"loss": losses, "acc": accs, "grad_norm": gnorms},
+                    step0=self._global_step,
+                    node_names=self.node_names,
+                )
+                losses = arrs["loss"]  # (steps, n)
+                accs = arrs["acc"]
+                gnorms = arrs["grad_norm"]
         except BaseException:
             # BaseException: KeyboardInterrupt mid-epoch must also drop the
             # state, or the next call crashes on deleted arrays.
@@ -737,84 +889,11 @@ class GossipTrainer:
         # Consensus from epoch_cons_num onward (parity: Man_Colab cell 21
         # "the first epoch from which consensus begins"; 1-based epochs).
         mixed = False
+        mix_rounds = 0
         params, bs, opt, rng = self._state
         if epoch_idx + 1 >= self.epoch_cons_num and len(self.node_names) > 1:
-            mix_times = self.mix_times
-            if self.mix_times_schedule is not None:
-                # Adaptive averaging period (arXiv:1910.13598 — communicate
-                # less early, more as training converges, or vice versa).
-                mix_times = int(self.mix_times_schedule(epoch_idx))
-                if mix_times < 1:
-                    raise ValueError(
-                        f"mix_times_schedule({epoch_idx}) returned "
-                        f"{mix_times}; must be >= 1 (0 would silently skip "
-                        "gossip while reporting a mixed epoch)"
-                    )
-            consensus_epochs = epoch_idx + 1 - self.epoch_cons_num
-            if (
-                self.global_avg_every is not None
-                and consensus_epochs % self.global_avg_every
-                == self.global_avg_every - 1
-            ):
-                # Gossip-PGA (arXiv:2105.09080): every H-th consensus epoch
-                # is one exact all-reduce, zeroing the consensus residual.
-                params = self.engine.global_average(params)
-                # CHOCO estimates tracked the pre-all-reduce iterates; kept,
-                # they would push the now-identical params apart again next
-                # epoch.  Reset — error feedback re-converges from zero.
-                self._choco_xhat = None
-            elif self.topology_schedule is not None:
-                # Time-varying graph: resample, resolve, mix via the
-                # traced-W path (no recompilation per epoch).
-                W_e = resolve_mixing_matrix(
-                    self.topology_schedule(epoch_idx), self.node_names
-                )
-                if self.chebyshev:
-                    g_e = mixing_gamma(W_e)
-                    if not (0.0 <= g_e < 1.0):
-                        raise ValueError(
-                            f"topology_schedule({epoch_idx}) produced a "
-                            f"graph with gamma={g_e}; Chebyshev acceleration "
-                            "needs a connected graph with gamma < 1"
-                        )
-                    omegas = chebyshev_omegas(g_e, mix_times)
-                    params = self.engine.mix_chebyshev_with(params, W_e, omegas)
-                elif self.mix_eps is not None:
-                    # Eps-stopping composed with the traced-W path: the
-                    # resampled graph still gossips until the residual
-                    # drops below eps (at least mix_times rounds).
-                    params, _, _ = self.engine.mix_until_with(
-                        params, W_e, eps=self.mix_eps, min_times=mix_times
-                    )
-                else:
-                    params = self.engine.mix_with(params, W_e, times=mix_times)
-            elif self._choco is not None:
-                # CHOCO-SGD: compressed-correction gossip; the public
-                # estimates persist across epochs (reset only by a fresh
-                # initialize_nodes / checkpoint restore — error feedback
-                # re-converges them).
-                from distributed_learning_tpu.parallel.compression import (
-                    ChocoState,
-                )
-
-                if self._choco_xhat is None:
-                    cstate = self._choco.init(params, seed=self.seed + 2)
-                else:
-                    cstate = ChocoState(
-                        x=params, xhat=self._choco_xhat, key=self._choco_key
-                    )
-                cstate, _ = self._choco.run(cstate, mix_times)
-                params = cstate.x
-                self._choco_xhat = cstate.xhat
-                self._choco_key = cstate.key
-            elif self.chebyshev:
-                params = self.engine.mix_chebyshev(params, times=mix_times)
-            elif self.mix_eps is None:
-                params = self.engine.mix(params, times=mix_times)
-            else:
-                params, _, _ = self.engine.mix_until(
-                    params, eps=self.mix_eps, min_times=mix_times
-                )
+            with self._span("trainer.mix"):
+                params, mix_rounds = self._gossip(epoch_idx, params)
             mixed = True
             self._state = (params, bs, opt, rng)
 
@@ -831,7 +910,8 @@ class GossipTrainer:
 
         test_accs = None
         if self.test_data is not None:
-            test_accs = self._eval_accuracy(params, bs)
+            with self._span("trainer.eval"):
+                test_accs = self._eval_accuracy(params, bs)
             for a, name in enumerate(self.node_names):
                 node = self.network[name]
                 node.stats.test_acc.append(float(test_accs[a]))
@@ -842,23 +922,46 @@ class GossipTrainer:
             "mixed": mixed,
             "train_loss": losses.mean(axis=0),
             "train_acc": accs.mean(axis=0),
+            "grad_norm": gnorms.mean(axis=0),
             "test_acc": test_accs,
+            "mix_rounds": mix_rounds,
             "deviation": float(self.engine.max_deviation(params)),
         }
-        if self.telemetry is not None:
-            for a, name in enumerate(self.node_names):
-                self.telemetry.process(
-                    name,
-                    {
-                        "epoch": epoch_idx,
-                        "train_loss": float(payload["train_loss"][a]),
-                        "train_acc": float(payload["train_acc"][a]),
-                        "test_acc": None
-                        if test_accs is None
-                        else float(test_accs[a]),
-                        "deviation": payload["deviation"],
-                    },
+        if self._obs_registry is not None:
+            # Per-chunk consensus metrics (the arXiv 2105.09080 headline
+            # traces): residual after mixing, rounds spent getting there.
+            self._obs_registry.observe(
+                "consensus.residual", payload["deviation"],
+                step=self._global_step,
+            )
+            if mixed:
+                self._obs_registry.inc("consensus.rounds_run", mix_rounds)
+            if test_accs is not None:
+                self._obs_registry.observe(
+                    "eval.test_acc", float(np.mean(test_accs)),
+                    step=self._global_step,
                 )
+        if self.telemetry is not None:
+            # Telemetry flushes once per jitted chunk (this method IS one
+            # chunk), so long runs stream metrics; the abstract
+            # TelemetryProcessor interface is unchanged — the payload
+            # only gained keys (grad_norm, mix_rounds).
+            with self._span("trainer.telemetry"):
+                for a, name in enumerate(self.node_names):
+                    self.telemetry.process(
+                        name,
+                        {
+                            "epoch": epoch_idx,
+                            "train_loss": float(payload["train_loss"][a]),
+                            "train_acc": float(payload["train_acc"][a]),
+                            "grad_norm": float(payload["grad_norm"][a]),
+                            "test_acc": None
+                            if test_accs is None
+                            else float(test_accs[a]),
+                            "mix_rounds": mix_rounds,
+                            "deviation": payload["deviation"],
+                        },
+                    )
         return payload
 
     def start_consensus(self) -> List[Dict[str, Any]]:

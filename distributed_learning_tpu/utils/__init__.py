@@ -1,6 +1,11 @@
 """Utilities: telemetry hooks, logging."""
 
-from distributed_learning_tpu.utils.profiling import DebugLogger, annotate, trace
+from distributed_learning_tpu.utils.profiling import (
+    DebugLogger,
+    annotate,
+    enable_debug_logging,
+    trace,
+)
 from distributed_learning_tpu.utils.telemetry import (
     CallbackTelemetry,
     RecordingTelemetry,
@@ -13,5 +18,6 @@ __all__ = [
     "TelemetryProcessor",
     "DebugLogger",
     "annotate",
+    "enable_debug_logging",
     "trace",
 ]

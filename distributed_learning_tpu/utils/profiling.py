@@ -22,7 +22,34 @@ import logging
 import time
 from typing import Iterator, Optional
 
-__all__ = ["trace", "annotate", "DebugLogger", "summarize_trace", "format_trace_summary"]
+__all__ = [
+    "trace",
+    "annotate",
+    "DebugLogger",
+    "enable_debug_logging",
+    "summarize_trace",
+    "format_trace_summary",
+]
+
+
+def enable_debug_logging(name: str = "dlt") -> logging.Logger:
+    """Make the framework's named loggers (``dlt.comm.agent.<token>``,
+    ``dlt.comm.master``, ...) visible: set the ``dlt`` root to DEBUG and
+    attach a stderr handler if none is configured.
+
+    The comm layer's legacy ``debug=True`` flags call this, so the old
+    print-style debugging experience survives the move to ``logging``;
+    applications that configure logging themselves never need it.
+    """
+    logger = logging.getLogger(name)
+    logger.setLevel(logging.DEBUG)
+    if not logger.handlers and not logging.getLogger().handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(name)s %(levelname).1s %(message)s")
+        )
+        logger.addHandler(handler)
+    return logger
 
 
 @contextlib.contextmanager

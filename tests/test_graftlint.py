@@ -233,6 +233,62 @@ def test_stdout_contract_scoped_to_bench(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# no-print-in-library                                                   #
+# --------------------------------------------------------------------- #
+def test_no_print_fires_in_library_code(tmp_path):
+    code = """
+    import sys
+    def f():
+        print("debugging")
+        print("diag", file=sys.stderr)
+    """
+    fs = _lint(
+        tmp_path, code,
+        relname="distributed_learning_tpu/comm/thing.py",
+        rules=["no-print-in-library"],
+    )
+    assert _rules_of(fs) == ["no-print-in-library"] * 2
+    assert "logging" in fs[0].message
+
+
+def test_no_print_exempts_bench_examples_tools(tmp_path):
+    for relname in (
+        "bench.py",
+        "benchmarks/bench_x.py",
+        "examples/demo.py",
+        "tools/helper.py",
+    ):
+        fs = _lint(
+            tmp_path, 'print("ok")\n', relname=relname,
+            rules=["no-print-in-library"],
+        )
+        assert fs == [], relname
+
+
+def test_no_print_bare_suppression_rejected(tmp_path):
+    code = 'print("x")  # graftlint: disable=no-print-in-library\n'
+    fs = _lint(
+        tmp_path, code,
+        relname="distributed_learning_tpu/x.py",
+        rules=["no-print-in-library"],
+    )
+    assert len(fs) == 1 and "needs a reason" in fs[0].message
+
+
+def test_no_print_reasoned_suppression_accepted(tmp_path):
+    code = (
+        'print("x")  # graftlint: disable=no-print-in-library'
+        " -- CLI output is the interface\n"
+    )
+    fs = _lint(
+        tmp_path, code,
+        relname="distributed_learning_tpu/x.py",
+        rules=["no-print-in-library"],
+    )
+    assert fs == []
+
+
+# --------------------------------------------------------------------- #
 # reference-citation                                                    #
 # --------------------------------------------------------------------- #
 @pytest.fixture

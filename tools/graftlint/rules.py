@@ -22,6 +22,12 @@ invariants (CLAUDE.md "Conventions that bite", SURVEY.md §2):
 * ``stdout-contract`` — ``bench.py`` must print exactly one JSON record
   line on stdout; every stdout ``print`` must be a ``json.dumps`` emit,
   everything else goes to stderr.
+* ``no-print-in-library`` — library code (``distributed_learning_tpu/``)
+  reports through the obs layer and named ``logging`` loggers, never
+  bare ``print``; stdout belongs to the CLI/bench emit paths and
+  benchmarks/examples (exempt trees).  A legitimate library print (a
+  CLI subcommand's output, a matplotlib-free fallback) carries a
+  reasoned suppression.
 * ``reference-citation`` — docstring/comment ``file:line`` citations
   must resolve (into ``/root/reference`` when present, else against the
   repo itself) so provenance pointers cannot rot.
@@ -392,6 +398,51 @@ class StdoutContract(Rule):
                     "print to stdout that is not a json.dumps record: "
                     "the driver parses stdout as exactly one JSON line "
                     "— send diagnostics to stderr (file=sys.stderr)",
+                )
+            )
+        return out
+
+
+@register
+class NoPrintInLibrary(Rule):
+    """Bare ``print`` in library code must carry a reasoned suppression.
+
+    The obs layer (``distributed_learning_tpu/obs/``) and named loggers
+    (``dlt.comm.*``) are the library's reporting channels — the
+    reference's debug-flag prints are exactly the observability this
+    repo replaced, and a stray ``print`` in the comm layer would also
+    corrupt any driver parsing stdout.  Benchmarks, examples, tools,
+    and ``bench.py`` own their stdout (bench.py's is separately held to
+    the ``stdout-contract``); everything else needs
+    ``# graftlint: disable=no-print-in-library -- <why this print is
+    the interface>``.
+    """
+
+    name = "no-print-in-library"
+    requires_reason = True
+    #: trees/files whose stdout IS their interface.
+    exempt_prefixes = ("benchmarks/", "examples/", "tools/", "tests/")
+    exempt_files = frozenset({"bench.py"})
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        rel = ctx.relpath
+        if rel in self.exempt_files or rel.startswith(self.exempt_prefixes):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "print":
+                continue
+            out.append(
+                Finding(
+                    self.name,
+                    rel,
+                    node.lineno,
+                    "bare print in library code: route diagnostics "
+                    "through logging (named 'dlt.*' loggers) or the obs "
+                    "registry; if this print IS the interface (CLI "
+                    "output), suppress with a reason",
                 )
             )
         return out
