@@ -46,6 +46,7 @@ import optax
 
 from distributed_learning_tpu.models import WideResNet
 from distributed_learning_tpu.obs import SpanTracer
+from distributed_learning_tpu.ops import mixing as mixing_ops
 from distributed_learning_tpu.parallel.consensus import ConsensusEngine
 from distributed_learning_tpu.parallel.topology import Topology
 
@@ -130,7 +131,12 @@ def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
                 state, idx, unroll=unroll,
             )
         if mix:
-            params = engine._dense_mix_once(params)
+            # Fused flat-buffer gossip: one GEMM per dtype bucket instead
+            # of one per leaf (ops/mixing.py); inside this jitted epoch
+            # the flatten/unflatten pair is a one-time prologue/epilogue.
+            params = mixing_ops.fused_dense_mix(
+                params, engine._W_dev, precision=engine.precision
+            )
         return (params, bs, opt, rng), losses
 
     donate = (0,) if jax.default_backend() != "cpu" else ()
@@ -163,6 +169,12 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
         lambda v: jnp.broadcast_to(v[None], (n_agents,) + v.shape), t
     )
     params = stack(variables["params"])
+    layout = mixing_ops.fused_layout(params)
+    _LAYOUT_INFO.update(
+        leaf_count=layout.leaf_count,
+        fused_buckets=layout.bucket_count,
+        mix_bytes_per_round=layout.bytes_per_round(n_agents),
+    )
     bs = stack(variables["batch_stats"])
     opt = jax.vmap(tx.init)(params)
     state = (params, bs, opt, jax.random.key(1))
@@ -205,6 +217,11 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
 
 
 _BEST_RECORD: dict = {}  # provisional result; emitted if the full run can't finish
+
+# Fused-consensus geometry of the measured model (leaf count / dtype
+# buckets / bytes one gossip round moves), recorded by measure_throughput
+# for the JSON record — measurement metadata, not a phase span.
+_LAYOUT_INFO: dict = {}
 
 # One-JSON-line contract, enforced atomically: the watchdog, the deadline
 # timer, and the main thread all print through _emit_record, and the
@@ -501,6 +518,7 @@ def main():
                 "config": f"{n_agents} agents x batch {small_b}, bf16 — "
                           "small stand-in banked before the WRN-28-10 "
                           "attempt; not comparable to the T4 anchor",
+                "consensus": dict(_LAYOUT_INFO),
                 "phases": _phase_payload(),
             })
             import sys
@@ -591,6 +609,7 @@ def main():
             "provisional": False,
             "config": f"{n_agents} agents x batch {batch}, bf16, rbg dropout, "
                       "mix 1/epoch",
+            "consensus": dict(_LAYOUT_INFO),
         }
     result["phases"] = _phase_payload()
     # Bank the completed headline FIRST (one dict, one schema): a

@@ -16,7 +16,7 @@ unflatten round-trip (``mixer.py:43-49, 68-76``) becomes a device-resident
 
 from __future__ import annotations
 
-from typing import Any, Dict, Hashable, List, Sequence
+from typing import Any, Dict, Hashable, List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +33,11 @@ __all__ = [
     "max_std",
     "weighted_lift",
     "weighted_readout",
+    "FusedLayout",
+    "fused_layout",
+    "flatten_stacked",
+    "unflatten_stacked",
+    "fused_dense_mix",
 ]
 
 
@@ -42,8 +47,172 @@ def stack_trees(trees: Sequence[Pytree]) -> Pytree:
 
 
 def unstack_tree(stacked: Pytree, n: int) -> List[Pytree]:
-    """Split the leading agent axis back into N per-agent pytrees."""
-    return [jax.tree.map(lambda x: x[i] if hasattr(x, "__getitem__") else x, stacked) for i in range(n)]
+    """Split the leading agent axis back into N per-agent pytrees.
+
+    Every leaf must carry the leading agent axis of size ``n`` (the
+    :func:`stack_trees` invariant).  A leaf without it — a python scalar,
+    a 0-d array, or an array whose leading dimension is not ``n`` — is
+    rejected: silently handing the SAME value to all agents (the old
+    ``hasattr(x, "__getitem__")`` fallback) turns a shape bug into n-way
+    state aliasing.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(stacked)
+    for path, leaf in flat:
+        shape = getattr(leaf, "shape", None)
+        if shape is None or len(shape) == 0 or shape[0] != n:
+            raise ValueError(
+                f"unstack_tree: leaf {jax.tree_util.keystr(path)} has "
+                f"shape {shape} — every leaf of a stacked tree must have "
+                f"a leading agent axis of size {n} (stack scalars with "
+                "stack_trees first)"
+            )
+    return [
+        jax.tree_util.tree_unflatten(
+            treedef, [leaf[i] for _, leaf in flat]
+        )
+        for i in range(n)
+    ]
+
+
+# --------------------------------------------------------------------- #
+# Fused flat-buffer layout                                              #
+# --------------------------------------------------------------------- #
+class _LeafSlot(NamedTuple):
+    """Where one stacked leaf lives inside its dtype bucket."""
+
+    bucket: str            # canonical dtype name, e.g. "float32"
+    offset: int            # column offset inside the (N, P_bucket) buffer
+    shape: Tuple[int, ...]  # trailing (per-agent) shape; () for (N,) leaves
+    size: int              # prod(shape)
+
+
+class FusedLayout(NamedTuple):
+    """Static (host-side, hashable) metadata of a fused flat-buffer state.
+
+    A stacked pytree is raveled into ONE contiguous ``(N, P)`` buffer per
+    storage dtype ("bucket"), so a gossip round is O(buckets) collectives
+    and matmuls instead of O(leaves).  The layout is leading-axis
+    agnostic: the same object serves the global ``(N, ...)`` tree and the
+    per-device ``(1, ...)`` shards inside ``shard_map``.  Hashable on
+    purpose — jit caches may key on it.
+    """
+
+    treedef: Any
+    slots: Tuple[_LeafSlot, ...]          # one per leaf, in tree order
+    buckets: Tuple[Tuple[str, int], ...]  # (dtype name, width P), sorted
+
+    @property
+    def leaf_count(self) -> int:
+        return len(self.slots)
+
+    @property
+    def bucket_count(self) -> int:
+        return len(self.buckets)
+
+    def bytes_per_round(self, n: int) -> int:
+        """Bytes of state one gossip round touches for ``n`` agents."""
+        return sum(
+            n * width * np.dtype(name).itemsize for name, width in self.buckets
+        )
+
+
+def fused_layout(stacked: Pytree) -> FusedLayout:
+    """Compute the fused flat-buffer layout of a stacked pytree.
+
+    Works on concrete arrays and on tracers (shapes are static under
+    jit).  Leaves are grouped by *storage* dtype — bf16/f32 leaves keep
+    their dtype at the buffer boundary; the mixing math stays f32 either
+    way (see :func:`dense_mix`).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(stacked)
+    if not flat:
+        return FusedLayout(treedef, (), ())
+    lead = None
+    widths: Dict[str, int] = {}
+    slots: List[_LeafSlot] = []
+    for path, leaf in flat:
+        shape = getattr(leaf, "shape", None)
+        if shape is None or len(shape) == 0:
+            raise ValueError(
+                f"fused_layout: leaf {jax.tree_util.keystr(path)} has "
+                f"shape {shape} — every leaf of a stacked tree must have "
+                "a leading agent axis (stack scalars with stack_trees "
+                "first)"
+            )
+        if lead is None:
+            lead = shape[0]
+        elif shape[0] != lead:
+            raise ValueError(
+                f"fused_layout: leaf {jax.tree_util.keystr(path)} has "
+                f"leading axis {shape[0]}, expected {lead} (inconsistent "
+                "agent axis across leaves)"
+            )
+        bucket = str(np.dtype(leaf.dtype))
+        size = int(np.prod(shape[1:], dtype=np.int64))
+        slots.append(
+            _LeafSlot(bucket, widths.get(bucket, 0), tuple(shape[1:]), size)
+        )
+        widths[bucket] = widths.get(bucket, 0) + size
+    return FusedLayout(
+        treedef, tuple(slots), tuple(sorted(widths.items()))
+    )
+
+
+def flatten_stacked(
+    stacked: Pytree, layout: FusedLayout | None = None
+) -> Tuple[Dict[str, jax.Array], FusedLayout]:
+    """Ravel a stacked pytree into its fused ``{dtype: (N, P)}`` buffers.
+
+    Inside jit this is a one-time reshape+concatenate at program entry —
+    the whole point of the layout is that the gossip ``while_loop`` body
+    then runs on O(buckets) buffers instead of O(leaves) arrays.  Returns
+    ``(buffers, layout)``; pass a precomputed ``layout`` to skip
+    revalidation (the CHOCO scan does, per cached program).
+    """
+    if layout is None:
+        layout = fused_layout(stacked)
+    leaves = jax.tree.leaves(stacked)
+    by_bucket: Dict[str, List[jax.Array]] = {}
+    for slot, leaf in zip(layout.slots, leaves):
+        by_bucket.setdefault(slot.bucket, []).append(
+            leaf.reshape(leaf.shape[0], slot.size)
+        )
+    buffers = {
+        name: (parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1))
+        for name, parts in by_bucket.items()
+    }
+    return buffers, layout
+
+
+def unflatten_stacked(
+    buffers: Dict[str, jax.Array], layout: FusedLayout
+) -> Pytree:
+    """Inverse of :func:`flatten_stacked`: slice each leaf back out of its
+    dtype bucket and restore the tree structure (one-time exit cost)."""
+    leaves = []
+    for slot in layout.slots:
+        buf = buffers[slot.bucket]
+        piece = jax.lax.slice_in_dim(
+            buf, slot.offset, slot.offset + slot.size, axis=1
+        )
+        leaves.append(piece.reshape((buf.shape[0],) + slot.shape))
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+def fused_dense_mix(
+    stacked: Pytree,
+    W: jax.Array,
+    *,
+    times: int = 1,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+) -> Pytree:
+    """Traceable fused gossip for embedding in a caller's own compiled
+    program (``bench.py``'s epoch): flatten once, ``times`` (static) dense
+    rounds on the fused buffers, unflatten once."""
+    buffers, layout = flatten_stacked(stacked)
+    for _ in range(int(times)):
+        buffers = dense_mix(buffers, W, precision=precision)
+    return unflatten_stacked(buffers, layout)
 
 
 def dense_mix(

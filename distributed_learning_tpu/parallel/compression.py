@@ -43,7 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_learning_tpu.ops import mixing as ops
-from ._spmd import cached_scan, mix_once
+from ._spmd import cached_scan, mix_once, residual
 from .consensus import ConsensusEngine
 
 Pytree = Any
@@ -251,6 +251,16 @@ class ChocoGossipEngine:
         (measured: top-k 10% on d=4096 converges to 2e-7 at gamma <= 0.2
         but oscillates at 0.4; top-k 25% on small d tolerates 0.4).  See
         :func:`compressor_delta` to measure delta.
+    fused:
+        Carry the scan state on the fused flat-buffer layout
+        (``ops.flatten_stacked``): iterates and estimates are raveled
+        ONCE per :meth:`run` call — not per round — and the mixing
+        product on the estimates moves O(dtype-buckets) messages per
+        round instead of O(leaves).  Compression stays per-leaf (top-k
+        fractions are a per-tensor contract): each round views the fused
+        correction through ``unflatten_stacked`` — slices the compiler
+        folds away — so the compressed values are identical to the
+        per-leaf path.  ``fused=False`` is the oracle.
     """
 
     def __init__(
@@ -261,13 +271,17 @@ class ChocoGossipEngine:
         gamma: float = 0.3,
         mesh: Optional[Mesh] = None,
         axis_name: str = "agents",
+        fused: bool = True,
     ):
-        self.engine = ConsensusEngine(W, mesh=mesh, axis_name=axis_name)
+        self.engine = ConsensusEngine(
+            W, mesh=mesh, axis_name=axis_name, fused=fused
+        )
         self.n = self.engine.n
         self.mesh = mesh
         self.axis_name = axis_name
         self.compressor = compressor
         self.gamma = float(gamma)
+        self.fused = bool(fused)
         self._jit_run: dict = {}
 
     # ------------------------------------------------------------------ #
@@ -316,9 +330,85 @@ class ChocoGossipEngine:
         xhat = jax.tree.map(jnp.zeros_like, x)
         return ChocoState(x=x, xhat=xhat, key=jax.random.key(seed))
 
+    def _step_fused(
+        self, s: ChocoState, layout, self_w, match_w
+    ) -> ChocoState:
+        """One CHOCO round on the fused carry: ``s.x``/``s.xhat`` are the
+        ``{dtype: (N, P)}`` buffer pytrees.  The correction is compressed
+        per ORIGINAL leaf (viewed through the layout — pure slices, no
+        data movement after fusion by XLA); the mixing product, the only
+        cross-agent traffic, runs on the fused estimate buffers."""
+        key, sub = jax.random.split(s.key)
+        delta = jax.tree.map(lambda a, b: a - b, s.x, s.xhat)
+        q_tree = self._compress_tree(
+            ops.unflatten_stacked(delta, layout), sub
+        )
+        q, _ = ops.flatten_stacked(q_tree, layout)
+        xhat = jax.tree.map(lambda h, qv: h + qv, s.xhat, q)
+        mixed_hat = self._mix(xhat, self_w, match_w)
+        x = jax.tree.map(
+            lambda xv, mh, h: xv + self.gamma * (mh - h),
+            s.x, mixed_hat, xhat,
+        )
+        return ChocoState(x=x, xhat=xhat, key=key)
+
+    def _run_fused(
+        self, state: ChocoState, rounds: int
+    ) -> Tuple[ChocoState, jax.Array]:
+        """Fused-carry scan: flatten x/xhat once at program entry, scan
+        ``rounds`` fused steps, unflatten once at exit — the flatten cost
+        is per call (the trainer calls once per epoch), never per round."""
+        rounds = int(rounds)
+        layout = ops.fused_layout(state.x)
+        ckey = ("fused", rounds, layout)
+        if ckey not in self._jit_run:
+            engine = self.engine
+
+            def scan_fused(s, self_w, match_w):
+                bx, _ = ops.flatten_stacked(s.x, layout)
+                bh, _ = ops.flatten_stacked(s.xhat, layout)
+
+                def body(st, _):
+                    st = self._step_fused(st, layout, self_w, match_w)
+                    return st, residual(engine, st.x)
+
+                fs, trace = jax.lax.scan(
+                    body, ChocoState(bx, bh, s.key), None, length=rounds
+                )
+                return (
+                    ChocoState(
+                        x=ops.unflatten_stacked(fs.x, layout),
+                        xhat=ops.unflatten_stacked(fs.xhat, layout),
+                        key=fs.key,
+                    ),
+                    trace,
+                )
+
+            if engine.mesh is None:
+                fn = jax.jit(lambda s: scan_fused(s, None, None))
+                self._jit_run[ckey] = fn
+            else:
+                spec = P(self.axis_name)
+                st_spec = ChocoState(x=spec, xhat=spec, key=P())
+                inner = jax.jit(
+                    jax.shard_map(
+                        scan_fused,
+                        mesh=engine.mesh,
+                        in_specs=(st_spec, spec, P(None, self.axis_name)),
+                        out_specs=(st_spec, P()),
+                        check_vma=True,
+                    )
+                )
+                self._jit_run[ckey] = lambda s: inner(
+                    s, engine._self_w, engine._match_w
+                )
+        return self._jit_run[ckey](state)
+
     def run(self, state: ChocoState, rounds: int) -> Tuple[ChocoState, jax.Array]:
         """``rounds`` CHOCO iterations in one jitted ``lax.scan``; returns
         the final state and the per-round consensus-residual trace."""
+        if self.fused:
+            return self._run_fused(state, rounds)
         spec = P(self.axis_name)
         st_spec = ChocoState(x=spec, xhat=spec, key=P())
         fn = cached_scan(self, self._jit_run, rounds, st_spec, self._step)

@@ -183,6 +183,15 @@ class ConsensusEngine:
     precision:
         Matmul precision for the dense path (HIGHEST: consensus residuals
         of ~1e-4 would be floored by bf16 accumulation).
+    fused:
+        Run every mixing program on the fused flat-buffer layout
+        (:func:`~distributed_learning_tpu.ops.mixing.flatten_stacked`):
+        the state is raveled once at program entry into one contiguous
+        ``(N, P)`` buffer per storage dtype, the whole gossip loop runs
+        on those O(buckets) buffers — O(1) ppermutes/GEMMs per round and
+        direction instead of O(leaves) — and unraveled once at exit.
+        ``fused=False`` keeps the per-leaf programs (the exact-equality
+        oracle; results differ only by GEMM accumulation order, ~1 ulp).
     """
 
     def __init__(
@@ -192,12 +201,14 @@ class ConsensusEngine:
         mesh: Optional[Mesh] = None,
         axis_name: str = "agents",
         precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+        fused: bool = True,
     ):
         self.W = validate_mixing_matrix(W)
         self.n = self.W.shape[0]
         self.axis_name = axis_name
         self.mesh = mesh
         self.precision = precision
+        self.fused = bool(fused)
         self.gamma = exact_gamma(self.W)
         self.schedule = MatchingSchedule.from_matrix(self.W)
         if mesh is not None:
@@ -277,6 +288,73 @@ class ConsensusEngine:
         return ops.dense_mix(x, self._W_dev, precision=self.precision)
 
     # ------------------------------------------------------------------ #
+    # Fused flat-buffer plumbing                                         #
+    # ------------------------------------------------------------------ #
+    def _fuse_state_fn(self, run):
+        """Wrap a state-first program onto the fused flat-buffer layout.
+
+        ``run(state, *args)`` must take the stacked state as its first
+        argument and return either the new state or a tuple whose first
+        element is the state.  With ``fused=True`` the state is raveled
+        into its dtype-bucket buffers ONCE at entry (a reshape+concat the
+        compiler folds into the program prologue), ``run`` executes on the
+        buffer pytree — every ``jax.tree.map``-built primitive in this
+        module is layout-agnostic, so the same loop bodies serve both
+        layouts — and the result is unraveled once at exit.  Applied to
+        the *local* body when the program runs under ``shard_map`` (the
+        per-device shard flattens; ppermutes then move one fused message
+        per bucket instead of one per leaf).
+        """
+        if not self.fused:
+            return run
+
+        def wrapped(x, *args):
+            buffers, layout = ops.flatten_stacked(x)
+            out = run(buffers, *args)
+            if isinstance(out, tuple):
+                return (ops.unflatten_stacked(out[0], layout),) + tuple(
+                    out[1:]
+                )
+            return ops.unflatten_stacked(out, layout)
+
+        return wrapped
+
+    def _fuse_in(self, x: Pytree) -> Pytree:
+        """Fused view of the state for pure reductions (deviations,
+        max_std): the statistic is leaf-order invariant, so computing it
+        on the buckets turns O(leaves) reductions into O(buckets)."""
+        if not self.fused:
+            return x
+        return ops.flatten_stacked(x)[0]
+
+    def _note_layout(self, stacked: Pytree, rounds=None) -> None:
+        """Fused-layout accounting (obs), host-side only: concrete calls
+        record the bucket/leaf geometry and — when the round count is
+        static — the bytes the gossip rounds touched.  Traced calls (the
+        caller is inside jit) and traced round counts are skipped, same
+        discipline as :meth:`_count_rounds`: never a device sync here."""
+        leaves = jax.tree.leaves(stacked)
+        if not leaves or any(
+            isinstance(l, jax.core.Tracer) for l in leaves
+        ):
+            return
+        try:
+            layout = ops.fused_layout(stacked)
+        except (ValueError, TypeError):
+            return
+        reg = get_registry()
+        reg.gauge("consensus.leaf_count", layout.leaf_count)
+        reg.gauge(
+            "consensus.fused_buckets",
+            layout.bucket_count if self.fused else layout.leaf_count,
+        )
+        if rounds is not None and not isinstance(rounds, jax.core.Tracer):
+            reg.inc(
+                "consensus.bytes_mixed",
+                layout.bytes_per_round(self.n) * int(rounds),
+            )
+
+    # ------------------------------------------------------------------ #
     # Public API                                                         #
     # ------------------------------------------------------------------ #
     def shard(self, stacked: Pytree) -> Pytree:
@@ -299,6 +377,7 @@ class ConsensusEngine:
         semantics, ``mixer.py:18-41``)."""
         fn = self._get_jitted("mix")
         self._count_rounds(times)
+        self._note_layout(stacked, rounds=times)
         with get_tracer().span("consensus.mix"):
             return fn(stacked, jnp.int32(times))
 
@@ -322,6 +401,7 @@ class ConsensusEngine:
         """
         fn = self._get_jitted("mix_until")
         get_registry().inc("consensus.mix_until.calls")
+        self._note_layout(stacked)
         with get_tracer().span("consensus.mix_until"):
             return fn(
                 stacked,
@@ -359,6 +439,7 @@ class ConsensusEngine:
             jnp.int32(max_rounds),
         )
         get_registry().inc("consensus.mix_until.calls")
+        self._note_layout(stacked)
         with get_tracer().span("consensus.mix_until_with"):
             if W_traced is not None:
                 return self._get_jitted("mix_until_with")(
@@ -413,6 +494,7 @@ class ConsensusEngine:
         if len(edges) == 0:
             return stacked
         self._count_rounds(rounds)
+        self._note_layout(stacked, rounds=rounds)
         if self.mesh is not None:
             with get_tracer().span("consensus.mix_pairwise"):
                 return self._mix_pairwise_sharded(stacked, key, rounds, edges)
@@ -440,7 +522,7 @@ class ConsensusEngine:
                 out, _ = jax.lax.fori_loop(0, rounds, body, (x, key))
                 return out
 
-            self._jit_cache[ckey] = jax.jit(f)
+            self._jit_cache[ckey] = jax.jit(self._fuse_state_fn(f))
         with get_tracer().span("consensus.mix_pairwise"):
             return self._jit_cache[ckey](stacked, key, jnp.int32(rounds))
 
@@ -532,7 +614,7 @@ class ConsensusEngine:
 
             self._jit_cache[ckey] = jax.jit(
                 jax.shard_map(
-                    local,
+                    self._fuse_state_fn(local),
                     mesh=mesh,
                     in_specs=(P(ax), P(), P()),
                     out_specs=P(ax),
@@ -556,6 +638,7 @@ class ConsensusEngine:
                 lambda x: self._run_chebyshev(x, omegas)
             )
         self._count_rounds(times)
+        self._note_layout(stacked, rounds=times)
         with get_tracer().span("consensus.mix_chebyshev"):
             return self._jit_cache[key](stacked)
 
@@ -627,6 +710,7 @@ class ConsensusEngine:
         """
         W_traced, decomp = self._traced_w_dispatch(W, route)
         self._count_rounds(times)
+        self._note_layout(stacked, rounds=times)
         with get_tracer().span("consensus.mix_with"):
             if W_traced is not None:
                 return self._get_jitted("mix_with")(
@@ -660,6 +744,7 @@ class ConsensusEngine:
         omegas = jnp.asarray(omegas, dtype=jnp.float32)
         W_traced, decomp = self._traced_w_dispatch(W, route)
         self._count_rounds(int(omegas.shape[0]))
+        self._note_layout(stacked, rounds=int(omegas.shape[0]))
         with get_tracer().span("consensus.mix_chebyshev_with"):
             if W_traced is not None:
                 return self._get_jitted("mix_chebyshev_with")(
@@ -690,6 +775,7 @@ class ConsensusEngine:
         the accumulated consensus error at bounded extra bandwidth).
         """
         get_registry().inc("consensus.global_averages")
+        self._note_layout(stacked, rounds=1)
         with get_tracer().span("consensus.global_average"):
             return self._get_jitted("global_average")(stacked)
 
@@ -750,49 +836,67 @@ class ConsensusEngine:
         def wrap(f):
             return jax.jit(f)
 
+        fuse = self._fuse_state_fn
+
         if self.mesh is None:
             if name == "mix":
-                fn = wrap(lambda x, t: self._run_times(x, t, self._dense_mix_once))
+                fn = wrap(
+                    fuse(lambda x, t: self._run_times(x, t, self._dense_mix_once))
+                )
             elif name == "mix_until":
                 fn = wrap(
-                    lambda x, eps, mn, mx: self._run_until(
-                        x,
-                        eps,
-                        mn,
-                        mx,
-                        self._dense_mix_once,
-                        lambda s: jnp.max(ops.agent_deviations(s)),
+                    fuse(
+                        lambda x, eps, mn, mx: self._run_until(
+                            x,
+                            eps,
+                            mn,
+                            mx,
+                            self._dense_mix_once,
+                            lambda s: jnp.max(ops.agent_deviations(s)),
+                        )
                     )
                 )
             elif name == "deviations":
-                fn = wrap(ops.agent_deviations)
+                fn = wrap(lambda x: ops.agent_deviations(self._fuse_in(x)))
             elif name == "max_std":
-                fn = wrap(ops.max_std)
+                fn = wrap(lambda x: ops.max_std(self._fuse_in(x)))
             elif name == "mix_with":
                 fn = wrap(
-                    lambda x, W, t: self._run_times(
-                        x,
-                        t,
-                        lambda s: ops.dense_mix(s, W, precision=self.precision),
+                    fuse(
+                        lambda x, W, t: self._run_times(
+                            x,
+                            t,
+                            lambda s: ops.dense_mix(
+                                s, W, precision=self.precision
+                            ),
+                        )
                     )
                 )
             elif name == "mix_until_with":
                 fn = wrap(
-                    lambda x, W, eps, mn, mx: self._run_until(
-                        x,
-                        eps,
-                        mn,
-                        mx,
-                        lambda s: ops.dense_mix(s, W, precision=self.precision),
-                        lambda s: jnp.max(ops.agent_deviations(s)),
+                    fuse(
+                        lambda x, W, eps, mn, mx: self._run_until(
+                            x,
+                            eps,
+                            mn,
+                            mx,
+                            lambda s: ops.dense_mix(
+                                s, W, precision=self.precision
+                            ),
+                            lambda s: jnp.max(ops.agent_deviations(s)),
+                        )
                     )
                 )
             elif name == "mix_chebyshev_with":
                 fn = wrap(
-                    lambda x, W, om: self._cheby_traced(
-                        x,
-                        om,
-                        lambda s: ops.dense_mix(s, W, precision=self.precision),
+                    fuse(
+                        lambda x, W, om: self._cheby_traced(
+                            x,
+                            om,
+                            lambda s: ops.dense_mix(
+                                s, W, precision=self.precision
+                            ),
+                        )
                     )
                 )
             elif name == "global_average":
@@ -805,7 +909,7 @@ class ConsensusEngine:
                         x,
                     )
 
-                fn = wrap(dense_avg)
+                fn = wrap(fuse(dense_avg))
             else:
                 raise KeyError(name)
         else:
@@ -821,6 +925,8 @@ class ConsensusEngine:
                     )
                 )
 
+            fuse = self._fuse_state_fn
+
             if name == "mix":
                 def local_mix(x, t, sw, mw):
                     return self._run_times(
@@ -828,7 +934,7 @@ class ConsensusEngine:
                     )
 
                 inner = sharded(
-                    local_mix, P(ax), extra_in=(P(), P(ax), P(None, ax))
+                    fuse(local_mix), P(ax), extra_in=(P(), P(ax), P(None, ax))
                 )
                 fn = lambda x, t: inner(x, t, self._self_w, self._match_w)
             elif name == "mix_until":
@@ -845,7 +951,7 @@ class ConsensusEngine:
                     )
 
                 inner = sharded(
-                    local_until,
+                    fuse(local_until),
                     (P(ax), P(), P()),
                     extra_in=(P(), P(), P(), P(ax), P(None, ax)),
                 )
@@ -854,14 +960,16 @@ class ConsensusEngine:
                 )
             elif name == "deviations":
                 inner = sharded(
-                    lambda x: jnp.sqrt(self._local_sq_deviation(x))[None],
+                    lambda x: jnp.sqrt(
+                        self._local_sq_deviation(self._fuse_in(x))
+                    )[None],
                     P(ax),
                 )
                 fn = inner
             elif name == "max_std":
                 def local_max_std(x):
                     m = jnp.float32(0.0)
-                    for leaf in jax.tree.leaves(x):
+                    for leaf in jax.tree.leaves(self._fuse_in(x)):
                         lf = leaf.astype(jnp.float32)
                         # graftlint: disable=raw-collective-in-shard-map -- telemetry: per-coordinate mean over agents (reference mixer.py:78-84 stats)
                         mean = lax.pmean(lf, ax)
@@ -877,7 +985,7 @@ class ConsensusEngine:
                         x, t, lambda s: self._local_allgather_mix(s, W_rows)
                     )
 
-                fn = sharded(local_mw, P(ax), extra_in=(P(ax), P()))
+                fn = sharded(fuse(local_mw), P(ax), extra_in=(P(ax), P()))
             elif name == "mix_until_with":
                 def local_uw(x, W_rows, eps, mn, mx):
                     return self._run_until(
@@ -892,7 +1000,7 @@ class ConsensusEngine:
                     )
 
                 fn = sharded(
-                    local_uw,
+                    fuse(local_uw),
                     (P(ax), P(), P()),
                     extra_in=(P(ax), P(), P(), P()),
                 )
@@ -902,7 +1010,7 @@ class ConsensusEngine:
                         x, om, lambda s: self._local_allgather_mix(s, W_rows)
                     )
 
-                fn = sharded(local_cw, P(ax), extra_in=(P(ax), P()))
+                fn = sharded(fuse(local_cw), P(ax), extra_in=(P(ax), P()))
             elif name == "global_average":
                 def local_avg(x):
                     return jax.tree.map(
@@ -913,7 +1021,7 @@ class ConsensusEngine:
                         x,
                     )
 
-                fn = sharded(local_avg, P(ax))
+                fn = sharded(fuse(local_avg), P(ax))
             else:
                 raise KeyError(name)
 
@@ -971,7 +1079,7 @@ class ConsensusEngine:
             raise KeyError(name)
         fn = jax.jit(
             jax.shard_map(
-                body,
+                self._fuse_state_fn(body),
                 mesh=mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,
@@ -1011,7 +1119,7 @@ class ConsensusEngine:
             def run(xx):
                 return self._cheby_loop(xx, omegas, mix_once)
 
-            return run(x)
+            return self._fuse_state_fn(run)(x)
         mesh, ax = self.mesh, self.axis_name
 
         def local(xx, sw, mw):
@@ -1020,7 +1128,7 @@ class ConsensusEngine:
             )
 
         return jax.shard_map(
-            local,
+            self._fuse_state_fn(local),
             mesh=mesh,
             in_specs=(P(ax), P(ax), P(None, ax)),
             out_specs=P(ax),

@@ -301,6 +301,7 @@ class GossipTrainer:
         mix_times_schedule: Optional[Callable[[int], int]] = None,
         compression: Any = None,
         compression_gamma: float = 0.2,
+        fused_consensus: bool = True,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         obs: Any = None,
@@ -432,6 +433,14 @@ class GossipTrainer:
                 compression = compressor_from_spec(compression)
         self._compression = compression
         self._compression_gamma = float(compression_gamma)
+        # Fused flat-buffer consensus (ops/mixing.py::flatten_stacked):
+        # the engines ravel the stacked params once per call — and the
+        # trainer gossips once per epoch, so the flatten cost is paid per
+        # EPOCH while every gossip round inside the call moves O(dtype-
+        # buckets) messages instead of O(leaves).  False restores the
+        # per-leaf oracle programs (bit-equal up to GEMM accumulation
+        # order; tests/test_trainer.py pins the equivalence).
+        self.fused_consensus = bool(fused_consensus)
 
         if weights is None and topology_schedule is not None:
             weights = topology_schedule(0)
@@ -452,7 +461,7 @@ class GossipTrainer:
                 " connected topology/matrix) for consensus training.",
                 stacklevel=2,
             )
-        self.engine = ConsensusEngine(W, mesh=mesh)
+        self.engine = ConsensusEngine(W, mesh=mesh, fused=self.fused_consensus)
         if self._compression is not None:
             from distributed_learning_tpu.parallel.compression import (
                 ChocoGossipEngine,
@@ -463,6 +472,7 @@ class GossipTrainer:
                 self._compression,
                 gamma=self._compression_gamma,
                 mesh=mesh,
+                fused=self.fused_consensus,
             )
         if (
             self.chebyshev
@@ -755,6 +765,13 @@ class GossipTrainer:
         eps-stopping ``lax.while_loop`` (one scalar host copy at the
         chunk boundary, which the carry contract allows) for ``mix_eps``
         paths.
+
+        With ``fused_consensus`` (default) every engine call here runs on
+        the fused flat-buffer layout: the params are raveled into one
+        contiguous buffer per dtype INSIDE the jitted program — once per
+        epoch, since gossip is one engine call per epoch — and all rounds
+        of the epoch's ``while_loop``/``scan`` move O(dtype-buckets)
+        messages per round instead of O(leaves).
         """
         mix_times = self.mix_times
         if self.mix_times_schedule is not None:

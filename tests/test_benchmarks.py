@@ -57,6 +57,25 @@ def test_bench_fast_averaging_smoke(capsys):
     assert "ppermute" in out
 
 
+def test_bench_fused_vs_perleaf_smoke(capsys):
+    """Measurement 2 rot guard: the fused flat-buffer engine beats the
+    per-leaf oracle on a many-leaf tree and the record carries the layout
+    geometry.  The headline benchmark shows >=2x; the test gate is looser
+    (>1.2x) so shared-CI timing noise cannot flake tier-1."""
+    from benchmarks import bench_fast_averaging
+
+    out = bench_fast_averaging.run_fused_vs_perleaf(8, rounds=500)
+    assert out["speedup"] > 1.2
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    (rec,) = [r for r in lines
+              if r["metric"] == "consensus_fused_rounds_per_sec"]
+    assert rec["leaf_count"] >= 50
+    assert rec["fused_buckets"] == 1
+    assert rec["bytes_mixed_per_round"] > 0
+    assert rec["rounds_per_sec_perleaf"] > 0
+
+
 def test_bench_cifar_mlp_smoke(capsys):
     from benchmarks import bench_cifar_mlp
 

@@ -177,3 +177,36 @@ def test_int8_compressor_contracts_and_choco_converges():
         np.asarray(state.x), np.tile(mean, (4, 1)), atol=1e-3
     )
     assert float(res[-1]) < 1e-3
+
+
+def test_choco_fused_carry_matches_perleaf_oracle():
+    """The fused flat-buffer carry (x/xhat raveled once per run, mixing
+    on the fused estimate buffers, compression per ORIGINAL leaf) is the
+    same recurrence as the per-leaf scan — allclose at GEMM-accumulation
+    tolerance on a mixed bf16+f32, multi-leaf, scalar-leaf tree."""
+    rng = np.random.default_rng(0)
+    x = {
+        "w": jnp.asarray(rng.normal(size=(N, 16)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(N, 3)), jnp.float32),
+        "h": jnp.asarray(rng.normal(size=(N, 5)), jnp.bfloat16),
+        "s": jnp.asarray(rng.normal(size=(N,)), jnp.float32),
+    }
+    W = Topology.ring(N).metropolis_weights()
+    ef = ChocoGossipEngine(W, top_k(0.3), gamma=0.2)
+    ep = ChocoGossipEngine(W, top_k(0.3), gamma=0.2, fused=False)
+    assert ef.fused and not ep.fused
+    sf, trf = ef.run(ef.init(x, seed=1), 10)
+    sp, trp = ep.run(ep.init(x, seed=1), 10)
+    for k in x:
+        np.testing.assert_allclose(
+            np.asarray(sf.x[k], np.float64), np.asarray(sp.x[k], np.float64),
+            rtol=2e-6, atol=2e-6, err_msg=f"x:{k}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(sf.xhat[k], np.float64),
+            np.asarray(sp.xhat[k], np.float64),
+            rtol=2e-6, atol=2e-6, err_msg=f"xhat:{k}",
+        )
+    np.testing.assert_allclose(
+        np.asarray(trf), np.asarray(trp), rtol=2e-5, atol=2e-6
+    )

@@ -457,3 +457,175 @@ def test_global_average_is_exact_consensus(sharded):
             atol=1e-6,
         )
     assert float(eng.max_deviation(out)) < 1e-5
+
+
+# --------------------------------------------------------------------- #
+# Fused flat-buffer layout (ops.flatten_stacked / fused=True engines)   #
+# --------------------------------------------------------------------- #
+def _mixed_dtype_state(n, seed=0):
+    """Stacked tree spanning the fused layout's edge cases: f32 + bf16
+    dtype buckets, a scalar-per-agent (n,) leaf, and an int32 leaf."""
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(n, 4, 3)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(n, 3)), jnp.float32),
+        "h": jnp.asarray(rng.normal(size=(n, 5)), jnp.bfloat16),
+        "scalar": jnp.asarray(rng.normal(size=(n,)), jnp.float32),
+        "step": jnp.asarray(rng.integers(0, 100, (n, 2)), jnp.int32),
+    }
+
+
+def _assert_trees_close(a, b, tag, tol=2e-6):
+    """Fused-vs-per-leaf tolerance: identical math, the only divergence
+    is GEMM accumulation order (~1 ulp for f32)."""
+    for ka, kb in zip(sorted(a), sorted(b)):
+        assert ka == kb
+        av = np.asarray(a[ka], np.float64)
+        bv = np.asarray(b[kb], np.float64)
+        assert a[ka].dtype == b[kb].dtype
+        np.testing.assert_allclose(av, bv, rtol=tol, atol=tol,
+                                   err_msg=f"{tag}:{ka}")
+
+
+def _fused_pair(W):
+    return ConsensusEngine(W), ConsensusEngine(W, fused=False)
+
+
+def test_flatten_unflatten_roundtrip_with_dtype_buckets():
+    x = _mixed_dtype_state(8)
+    bufs, layout = ops.flatten_stacked(x)
+    # One contiguous (N, P) buffer per storage dtype.
+    assert set(bufs) == {"float32", "bfloat16", "int32"}
+    assert bufs["float32"].shape == (8, 4 * 3 + 3 + 1)
+    assert layout.leaf_count == 5 and layout.bucket_count == 3
+    assert layout.bytes_per_round(8) == 8 * (16 * 4 + 5 * 2 + 2 * 4)
+    y = ops.unflatten_stacked(bufs, layout)
+    for k in x:
+        assert y[k].dtype == x[k].dtype and y[k].shape == x[k].shape
+        np.testing.assert_array_equal(np.asarray(y[k]), np.asarray(x[k]))
+
+
+def test_fused_layout_rejects_leaf_without_agent_axis():
+    with pytest.raises(ValueError, match="leading agent axis"):
+        ops.fused_layout({"a": jnp.ones((8, 2)), "bad": jnp.float32(1.0)})
+    with pytest.raises(ValueError, match="inconsistent"):
+        ops.fused_layout({"a": jnp.ones((8, 2)), "b": jnp.ones((4, 2))})
+
+
+def test_unstack_tree_rejects_scalar_leaf():
+    # The old hasattr-__getitem__ guard silently SHARED a scalar leaf
+    # across agents; now it errors, consistent with the stack_trees
+    # invariant (stack_trees turns per-agent scalars into an (n,) leaf,
+    # which unstacks fine).
+    with pytest.raises(ValueError, match="leading agent axis"):
+        ops.unstack_tree({"w": jnp.ones((4, 2)), "s": 3.0}, 4)
+    with pytest.raises(ValueError, match="leading agent axis"):
+        ops.unstack_tree({"w": jnp.ones((3, 2))}, 4)
+    stacked = ops.stack_trees([{"v": float(i)} for i in range(4)])
+    out = ops.unstack_tree(stacked, 4)
+    assert [float(t["v"]) for t in out] == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_fused_oracle_mix_and_until():
+    W = Topology.ring(8).metropolis_weights()
+    ef, ep = _fused_pair(W)
+    x = _mixed_dtype_state(8, seed=1)
+    _assert_trees_close(ef.mix(x, times=3), ep.mix(x, times=3), "mix")
+    of, tf, rf = ef.mix_until(x, eps=1e-3, max_rounds=200)
+    op_, tp_, rp_ = ep.mix_until(x, eps=1e-3, max_rounds=200)
+    _assert_trees_close(of, op_, "mix_until")
+    assert int(tf) == int(tp_)
+    np.testing.assert_allclose(float(rf), float(rp_), rtol=1e-5)
+
+
+def test_fused_oracle_traced_w_routes():
+    W = Topology.ring(8).metropolis_weights()
+    ef, ep = _fused_pair(W)
+    x = _mixed_dtype_state(8, seed=2)
+    W2 = Topology.erdos_renyi(8, 0.5, seed=3).metropolis_weights()
+    _assert_trees_close(
+        ef.mix_with(x, W2, times=2), ep.mix_with(x, W2, times=2), "mix_with"
+    )
+    of, tf, _ = ef.mix_until_with(x, W2, eps=1e-3)
+    op_, tp_, _ = ep.mix_until_with(x, W2, eps=1e-3)
+    _assert_trees_close(of, op_, "mix_until_with")
+    assert int(tf) == int(tp_)
+
+
+def test_fused_oracle_chebyshev_and_pairwise():
+    from distributed_learning_tpu.parallel.schedule import chebyshev_omegas
+
+    W = Topology.ring(8).metropolis_weights()
+    ef, ep = _fused_pair(W)
+    x = _mixed_dtype_state(8, seed=3)
+    _assert_trees_close(
+        ef.mix_chebyshev(x, times=5), ep.mix_chebyshev(x, times=5), "cheby"
+    )
+    W2 = _sparse_ring_plus_chords()
+    omegas = chebyshev_omegas(exact_gamma(W2), 4)
+    _assert_trees_close(
+        ef.mix_chebyshev_with(x, W2, omegas),
+        ep.mix_chebyshev_with(x, W2, omegas),
+        "cheby_with",
+    )
+    key = jax.random.key(0)
+    # Same key -> same edge draws -> identical pairwise averaging.
+    _assert_trees_close(
+        ef.mix_pairwise(x, key, 7), ep.mix_pairwise(x, key, 7), "pairwise"
+    )
+
+
+def test_fused_oracle_reductions_and_global_average():
+    W = Topology.grid2d(2, 4).metropolis_weights()
+    ef, ep = _fused_pair(W)
+    x = _mixed_dtype_state(8, seed=4)
+    np.testing.assert_allclose(
+        np.asarray(ef.deviations(x)), np.asarray(ep.deviations(x)), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(ef.max_std(x)), float(ep.max_std(x)), rtol=1e-6
+    )
+    _assert_trees_close(
+        ef.global_average(x), ep.global_average(x), "global_average"
+    )
+    w = np.asarray([1, 2, 3, 4, 4, 3, 2, 1], np.float32)
+    _assert_trees_close(
+        ef.run_round(x, w, convergence_eps=1e-3),
+        ep.run_round(x, w, convergence_eps=1e-3),
+        "run_round",
+        tol=5e-6,
+    )
+
+
+def test_fused_mix_records_layout_counters():
+    from distributed_learning_tpu.obs import MetricsRegistry, use_registry
+
+    W = Topology.ring(4).metropolis_weights()
+    eng = ConsensusEngine(W)
+    x = {
+        "w": jnp.ones((4, 6), jnp.float32),
+        "h": jnp.ones((4, 2), jnp.bfloat16),
+    }
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        eng.mix(x, times=3)
+    assert reg.gauges["consensus.fused_buckets"] == 2
+    assert reg.gauges["consensus.leaf_count"] == 2
+    # bytes/round = 4 * (6*4 + 2*2) = 112; 3 rounds.
+    assert reg.counters["consensus.bytes_mixed"] == 3 * 4 * (6 * 4 + 2 * 2)
+
+
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="sharded fused engine needs the jax.shard_map API (jax >= 0.7)",
+)
+def test_fused_oracle_sharded_mix_until():
+    W = Topology.ring(8).metropolis_weights()
+    mesh = make_agent_mesh(8)
+    ef = ConsensusEngine(W, mesh=mesh)
+    ep = ConsensusEngine(W, mesh=mesh, fused=False)
+    x = _mixed_dtype_state(8, seed=5)
+    of, tf, _ = ef.mix_until(ef.shard(x), eps=1e-3, max_rounds=200)
+    op_, tp_, _ = ep.mix_until(ep.shard(x), eps=1e-3, max_rounds=200)
+    _assert_trees_close(of, op_, "sharded_mix_until")
+    assert int(tf) == int(tp_)

@@ -100,3 +100,29 @@ def test_token_graphs_supported():
     # Triangle optimum: W = J/3 via w = 1/3 each, gamma = 0.
     assert g == pytest.approx(0.0, abs=5e-3)
     np.testing.assert_allclose(w, 1 / 3, atol=2e-2)
+
+
+def test_solver_matrix_drives_fused_and_perleaf_engines_identically():
+    """The SDP-equivalent W feeds straight into ConsensusEngine in both
+    layouts: fused (default) and per-leaf gossip under the optimal
+    weights agree to GEMM-accumulation tolerance and contract."""
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+
+    topo = Topology.ring(6)
+    W, g = solve_fastest_mixing(topo)
+    rng = np.random.default_rng(5)
+    x = {
+        "w": jnp.asarray(rng.normal(size=(6, 4, 3)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(6, 3)).astype(np.float32)),
+        "s": jnp.asarray(rng.normal(size=(6,)).astype(np.float32)),
+    }
+    ef, ep = ConsensusEngine(W), ConsensusEngine(W, fused=False)
+    of, op = ef.mix(x, times=8), ep.mix(x, times=8)
+    for k in x:
+        np.testing.assert_allclose(
+            np.asarray(of[k], np.float64), np.asarray(op[k], np.float64),
+            rtol=2e-6, atol=2e-6,
+        )
+    assert float(ef.max_deviation(of)) < float(ef.max_deviation(x))

@@ -486,3 +486,86 @@ def test_audit_entry_inventory_pinned(name):
         )
     res = jaxpr_audit.audit([name])[name]
     assert res["status"] == "ok", res
+
+
+def _count_primitive(jaxpr, name: str) -> int:
+    """Count `name` equations, descending into sub-jaxprs (while/scan/
+    pjit bodies) the same way collect_collectives does."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == name:
+            total += 1
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (tuple, list)) else [val]
+            for v in vals:
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    total += _count_primitive(inner, name)
+                elif hasattr(v, "eqns"):
+                    total += _count_primitive(v, name)
+    return total
+
+
+def test_fused_mix_until_dense_is_one_gemm_per_round():
+    """The fused flat-buffer program property, checked on the dense path
+    (runs on any jax): a 60-leaf single-dtype tree's eps-stopping gossip
+    loop contains exactly ONE dot_general — the whole while body mixes
+    one fused (N, P) buffer — while the per-leaf oracle carries one GEMM
+    per leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    x = {
+        f"l{i:02d}": jnp.ones((8, 3 + (i % 5)), jnp.float32)
+        for i in range(60)
+    }
+    W = Topology.ring(8).metropolis_weights()
+
+    def trace(engine):
+        return jax.make_jaxpr(
+            lambda s: engine.mix_until(s, eps=1e-6, max_rounds=32)[0]
+        )(x)
+
+    fused = trace(ConsensusEngine(W))
+    assert _count_primitive(fused.jaxpr, "dot_general") == 1
+    perleaf = trace(ConsensusEngine(W, fused=False))
+    assert _count_primitive(perleaf.jaxpr, "dot_general") == 60
+
+
+@pytest.mark.skipif(
+    not __import__("jax").__dict__.get("shard_map"),
+    reason="sharded fused engine needs the jax.shard_map API (jax >= 0.7)",
+)
+def test_fused_mix_until_sharded_one_ppermute_per_matching():
+    """The audit pin's property stated directly: the fused sharded
+    mix_until moves ONE ppermute per matching (ring(8) Metropolis has 2
+    matchings — one per ring direction) regardless of leaf count, where
+    the per-leaf program pays matchings x leaves."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.consensus import (
+        ConsensusEngine,
+        make_agent_mesh,
+    )
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    W = Topology.ring(8).metropolis_weights()
+    mesh = make_agent_mesh(8)
+    x = {f"l{i:02d}": jnp.ones((8, 2), jnp.float32) for i in range(12)}
+
+    def inventory(engine):
+        jx = jax.make_jaxpr(
+            lambda s: engine.mix_until(s, eps=1e-6, max_rounds=32)[0]
+        )(x)
+        return jaxpr_audit.collect_collectives(jx.jaxpr)
+
+    fused = inventory(ConsensusEngine(W, mesh=mesh))
+    matchings = ConsensusEngine(W).schedule.num_rounds
+    assert matchings == 2
+    assert fused[("ppermute", ("agents",))] == matchings  # one per direction
+    perleaf = inventory(ConsensusEngine(W, mesh=mesh, fused=False))
+    assert perleaf[("ppermute", ("agents",))] == matchings * 12

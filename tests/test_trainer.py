@@ -828,3 +828,45 @@ def test_compression_none_with_arg_still_disables():
             dropout=False, compression="sign",
             mix_times_schedule=lambda e: 1 + e,
         )
+
+
+def test_fused_consensus_matches_perleaf_oracle():
+    """fused_consensus=True (default) trains identically to the per-leaf
+    gossip programs — same losses, same deviations, same final accuracy —
+    with donate_state=True (the default) and an eps-stopping mix so the
+    fused while_loop's residual drives the round count too."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(450, 784)).astype(np.float32)
+    w = rng.normal(size=(784, 10)).astype(np.float32)
+    y = (X @ w).argmax(-1).astype(np.int32)
+    shards = {
+        i: (X[i * 150 : (i + 1) * 150], y[i * 150 : (i + 1) * 150])
+        for i in range(3)
+    }
+    kwargs = dict(
+        node_names=[0, 1, 2],
+        model="ann",
+        model_kwargs={"hidden_dim": 32, "output_dim": 10},
+        weights=np.full((3, 3), 1 / 3),
+        train_data=shards,
+        epoch=2,
+        epoch_len=2,
+        batch_size=50,
+        learning_rate=0.05,
+        mix_eps=1e-5,
+        donate_state=True,
+        seed=4,
+    )
+    runs = {}
+    for fused in (True, False):
+        t = GossipTrainer(fused_consensus=fused, **kwargs)
+        assert t.engine.fused is fused
+        runs[fused] = t.start_consensus()
+    for rf, rp in zip(runs[True], runs[False]):
+        np.testing.assert_allclose(
+            rf["train_loss"], rp["train_loss"], rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            rf["deviation"], rp["deviation"], rtol=1e-4, atol=1e-6
+        )
+        assert rf["mix_rounds"] == rp["mix_rounds"]

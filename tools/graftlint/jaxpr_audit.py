@@ -232,8 +232,16 @@ def _pp_1f1b_head_fn() -> Counter:
 @entry("consensus_mix_until", kind="jaxpr", requires=("shard_map",))
 def _consensus_mix_until() -> Counter:
     """The sharded eps-stopping gossip loop (ConsensusEngine.mix_until
-    on a ring(8) mesh engine): ppermute per matching inside the while
-    body plus the pmean/pmax deviation reductions."""
+    on a ring(8) mesh engine) over a FOUR-leaf, two-dtype-bucket state.
+
+    This is the fused flat-buffer pin: the while body moves one ppermute
+    per matching per dtype BUCKET (2 matchings x 2 buckets = 4) and the
+    residual is one pmean (psum) per bucket per evaluation (2 buckets x
+    2 evaluations = 4) plus the pmax — independent of the leaf count.
+    The per-leaf program would scale every entry with the 4 leaves
+    (8 ppermutes, 8 psums); a pin drift back to leaf-proportional counts
+    means the fused layout silently stopped engaging.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -244,7 +252,12 @@ def _consensus_mix_until() -> Counter:
     engine = ConsensusEngine(
         Topology.ring(8).metropolis_weights(), mesh=mesh
     )
-    x = {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4)}
+    x = {
+        "w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        "b": jnp.ones((8, 2), jnp.float32),
+        "s": jnp.zeros((8,), jnp.float32),
+        "h": jnp.ones((8, 3), jnp.bfloat16),
+    }
     jx = jax.make_jaxpr(
         lambda s: engine.mix_until(s, eps=1e-6, max_rounds=32)[0]
     )(x)
