@@ -68,7 +68,7 @@ def _phase_payload() -> dict:
 
 
 def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
-                mix=True, pregather=False):
+                mix=True, pregather=False, superstep=1):
     """One jitted, donated epoch: scan of vmapped train steps + one gossip
     round (the trainer's per-epoch mixing cadence).
 
@@ -80,6 +80,10 @@ def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
     ``take`` per step — attributing the in-scan gather's cost (the
     trainer uses in-scan gathers to avoid materializing the permuted
     epoch tensor; this measures what that choice pays).
+    ``superstep=K`` (``BENCH_SUPERSTEP``) wraps the epoch in an outer
+    epoch scan — the trainer's ``train_epochs`` cadence: the returned
+    program takes ``(K, steps, n, B)`` indices and runs K epochs of
+    scan+mix per dispatch.
     """
     if unroll is None:
         unroll = int(os.environ.get("BENCH_UNROLL", 2))
@@ -140,12 +144,23 @@ def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
         return (params, bs, opt, rng), losses
 
     donate = (0,) if jax.default_backend() != "cpu" else ()
-    return jax.jit(epoch, donate_argnums=donate)
+    if superstep <= 1:
+        return jax.jit(epoch, donate_argnums=donate)
+
+    def epoch_superstep(state, Xs, ys, idx):
+        # idx: (K, steps, n, B).  One dispatch covers K epochs of
+        # scan+mix; the carried state crosses epochs on device.
+        return jax.lax.scan(
+            lambda carry, idx_e: epoch(carry, Xs, ys, idx_e), state, idx
+        )
+
+    return jax.jit(epoch_superstep, donate_argnums=donate)
 
 
 def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
                        pool=None, unroll=None, remat=None, mix=True,
-                       pregather=False, trace_dir=None, on_first_op=None):
+                       pregather=False, superstep=1, trace_dir=None,
+                       on_first_op=None):
     """Steady-state samples/sec of :func:`build_epoch` on random resident
     data — the shared harness behind ``bench.py`` and
     ``benchmarks/profile_wrn.py``.
@@ -159,8 +174,15 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
     """
     if pool is None:
         pool = steps * batch
+    superstep = max(int(superstep), 1)
+    if epochs % superstep:
+        raise ValueError(
+            f"epochs ({epochs}) must be a multiple of superstep "
+            f"({superstep}) so every dispatch runs the same program"
+        )
     run_epoch = build_epoch(model, tx, engine, n_agents, unroll=unroll,
-                            remat=remat, mix=mix, pregather=pregather)
+                            remat=remat, mix=mix, pregather=pregather,
+                            superstep=superstep)
 
     rng = jax.random.key(0)
     x0 = jnp.ones((batch, 32, 32, 3), jnp.float32)
@@ -187,12 +209,21 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
         data_rng.integers(0, 10, size=(n_agents, pool)).astype(np.int32)
     )
 
-    def epoch_idx(e):
+    def _epoch_idx_np(e):
         r = np.random.default_rng(e)
         idx = np.stack(
             [r.permutation(pool)[: steps * batch] for _ in range(n_agents)]
         ).astype(np.int32)
-        return jnp.asarray(idx.reshape(n_agents, steps, batch).swapaxes(0, 1))
+        return idx.reshape(n_agents, steps, batch).swapaxes(0, 1)
+
+    def epoch_idx(e):
+        if superstep == 1:
+            return jnp.asarray(_epoch_idx_np(e))
+        # K epochs of indices, transferred once per superstep dispatch.
+        return jnp.asarray(
+            np.stack([_epoch_idx_np(e * superstep + j)
+                      for j in range(superstep)])
+        )
 
     with _TRACER.span("compile"):
         state, losses = run_epoch(state, Xs, ys, epoch_idx(0))  # compile
@@ -207,7 +238,7 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
         jax.profiler.start_trace(trace_dir)
     with _TRACER.span("measure"):
         t0 = time.perf_counter()
-        for e in range(epochs):
+        for e in range(epochs // superstep):
             state, losses = run_epoch(state, Xs, ys, epoch_idx(2 + e))
         np.asarray(losses)
         elapsed = time.perf_counter() - t0
@@ -469,6 +500,15 @@ def main():
     widen = int(os.environ.get("BENCH_WIDEN", 10 if full else 4))
     steps = int(os.environ.get("BENCH_STEPS", 16 if full else 3))
     epochs = int(os.environ.get("BENCH_EPOCHS", 3 if full else 1))
+    # Epoch superstep (trainer.train_epochs cadence): K epochs of
+    # scan+mix compiled into one donated dispatch.  1 = the headline
+    # per-epoch program; BENCH_EPOCHS must be a multiple of K.
+    superstep_k = max(int(os.environ.get("BENCH_SUPERSTEP", 1)), 1)
+    if epochs % superstep_k:
+        raise SystemExit(
+            f"BENCH_EPOCHS={epochs} must be a multiple of "
+            f"BENCH_SUPERSTEP={superstep_k}"
+        )
     pool = int(os.environ.get("BENCH_POOL", steps * batch))
     if pool < steps * batch:
         raise SystemExit(
@@ -478,7 +518,7 @@ def main():
         )
 
     def measure(batch: int, pool: int, *, depth=depth, widen=widen,
-                steps=steps, epochs=epochs) -> float:
+                steps=steps, epochs=epochs, superstep=superstep_k) -> float:
         model = WideResNet(
             depth=depth, widen_factor=widen, dropout_rate=0.3,
             num_classes=10, dtype=jnp.bfloat16,
@@ -489,7 +529,7 @@ def main():
         engine = ConsensusEngine(Topology.ring(n_agents).metropolis_weights())
         return measure_throughput(
             model, tx, engine, n_agents=n_agents, batch=batch, steps=steps,
-            epochs=epochs, pool=pool,
+            epochs=epochs, pool=pool, superstep=superstep,
             on_first_op=watchdog_progress.set,  # first op done: no wedge
         )
 
@@ -506,7 +546,7 @@ def main():
             prov_widen = int(os.environ.get("BENCH_PROV_WIDEN", 4))
             sps_small = measure(
                 small_b, steps * small_b, depth=prov_depth,
-                widen=prov_widen, steps=steps, epochs=1,
+                widen=prov_widen, steps=steps, epochs=1, superstep=1,
             )
             _BEST_RECORD.update({
                 "metric": f"gossip_sgd_wrn{prov_depth}x{prov_widen}"
@@ -518,6 +558,7 @@ def main():
                 "config": f"{n_agents} agents x batch {small_b}, bf16 — "
                           "small stand-in banked before the WRN-28-10 "
                           "attempt; not comparable to the T4 anchor",
+                "superstep": 1,
                 "consensus": dict(_LAYOUT_INFO),
                 "phases": _phase_payload(),
             })
@@ -609,6 +650,7 @@ def main():
             "provisional": False,
             "config": f"{n_agents} agents x batch {batch}, bf16, rbg dropout, "
                       "mix 1/epoch",
+            "superstep": superstep_k,
             "consensus": dict(_LAYOUT_INFO),
         }
     result["phases"] = _phase_payload()

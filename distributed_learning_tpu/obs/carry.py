@@ -55,12 +55,17 @@ def flush_chunk(
     """Flush one jitted chunk's carried metric traces to ``registry``.
 
     ``carry`` maps metric name to a per-chunk array: scalars, ``(steps,)``
-    traces, or ``(steps, n_nodes)`` stacked traces.  Each array is
-    materialized host-side exactly once (``np.asarray``) — the single
-    per-chunk sync the carry pattern allows.  Per-node chunk means are
-    recorded as ``{prefix}.{name}/{node}`` series points at the chunk's
-    final step, plus the cross-node mean as ``{prefix}.{name}``;
-    scalars record one point.  Returns the materialized numpy arrays so
+    traces, ``(steps, n_nodes)`` stacked traces, or — when the chunk is
+    an epoch *superstep* — ``(k_epochs, steps, n_nodes)`` doubly-stacked
+    traces (the outer epoch scan stacks the per-epoch traces; the two
+    leading axes collapse to one ``k*steps`` step trace here, so the
+    one-flush-per-chunk contract holds whether the chunk is one epoch or
+    K).  Each array is materialized host-side exactly once
+    (``np.asarray``) — the single per-chunk sync the carry pattern
+    allows.  Per-node chunk means are recorded as
+    ``{prefix}.{name}/{node}`` series points at the chunk's final step,
+    plus the cross-node mean as ``{prefix}.{name}``; scalars record one
+    point.  Returns the materialized numpy arrays (original shapes) so
     the caller reuses them (the trainer feeds the same arrays to its
     stats/telemetry paths — no second sync).
     """
@@ -74,13 +79,18 @@ def flush_chunk(
         if arr.ndim == 0:
             registry.observe(key, float(arr), step=step0)
             continue
-        steps = arr.shape[0]
+        flat = arr
+        if arr.ndim >= 3 and node_names is not None and \
+                arr.shape[-1] == len(node_names):
+            # (k_epochs, steps, n) superstep trace -> (k*steps, n).
+            flat = arr.reshape(-1, arr.shape[-1])
+        steps = flat.shape[0]
         end = step0 + steps
-        if arr.ndim >= 2 and node_names is not None and \
-                arr.shape[1] == len(node_names):
+        if flat.ndim >= 2 and node_names is not None and \
+                flat.shape[1] == len(node_names):
             for a, node in enumerate(node_names):
                 registry.observe(
-                    f"{key}/{node}", float(arr[:, a].mean()), step=end
+                    f"{key}/{node}", float(flat[:, a].mean()), step=end
                 )
-        registry.observe(key, float(arr.mean()), step=end)
+        registry.observe(key, float(flat.mean()), step=end)
     return arrays

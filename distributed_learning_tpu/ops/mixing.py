@@ -38,6 +38,7 @@ __all__ = [
     "flatten_stacked",
     "unflatten_stacked",
     "fused_dense_mix",
+    "fused_max_deviation",
 ]
 
 
@@ -213,6 +214,17 @@ def fused_dense_mix(
     for _ in range(int(times)):
         buffers = dense_mix(buffers, W, precision=precision)
     return unflatten_stacked(buffers, layout)
+
+
+def fused_max_deviation(stacked: Pytree, *, fused: bool = True) -> jax.Array:
+    """:func:`max_deviation` computed on the fused flat-buffer view —
+    O(dtype-buckets) reductions instead of O(leaves) — for embedding in a
+    caller's compiled program (the trainer's epoch superstep reads the
+    post-mix consensus residual out of the same dispatch that mixed).
+    ``fused=False`` keeps the per-leaf reduction; the statistic is
+    leaf-order invariant, so both layouts agree to accumulation order.
+    """
+    return max_deviation(flatten_stacked(stacked)[0] if fused else stacked)
 
 
 def dense_mix(

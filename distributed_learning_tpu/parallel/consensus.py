@@ -287,6 +287,35 @@ class ConsensusEngine:
     def _dense_mix_once(self, x: Pytree) -> Pytree:
         return ops.dense_mix(x, self._W_dev, precision=self.precision)
 
+    @staticmethod
+    def _dense_residual(x: Pytree) -> jax.Array:
+        """Max agent deviation of a (possibly fused) stacked state — the
+        eps-stopping residual of the dense programs."""
+        return jnp.max(ops.agent_deviations(x))
+
+    def _local_residual(self, x: Pytree) -> jax.Array:
+        """The sharded residual: this shard's deviation, pmax'd over the
+        agent axis (runs inside ``shard_map``)."""
+        return lax.pmax(jnp.sqrt(self._local_sq_deviation(x)), self.axis_name)
+
+    @staticmethod
+    def _dense_global_avg(x: Pytree) -> Pytree:
+        return jax.tree.map(
+            lambda v: jnp.broadcast_to(
+                v.astype(jnp.float32).mean(axis=0, keepdims=True),
+                v.shape,
+            ).astype(v.dtype),
+            x,
+        )
+
+    def _local_global_avg(self, x: Pytree) -> Pytree:
+        ax = self.axis_name
+        return jax.tree.map(
+            # graftlint: disable=raw-collective-in-shard-map -- exact consensus: the global average is the mixing fixed point, pmean over agents by definition
+            lambda v: lax.pmean(v.astype(jnp.float32), ax).astype(v.dtype),
+            x,
+        )
+
     # ------------------------------------------------------------------ #
     # Fused flat-buffer plumbing                                         #
     # ------------------------------------------------------------------ #
@@ -827,6 +856,122 @@ class ConsensusEngine:
         return self._get_jitted("max_std")(stacked)
 
     # ------------------------------------------------------------------ #
+    # Program bodies: traceable under a CALLER's jit                     #
+    # ------------------------------------------------------------------ #
+    # The entry points above are top-level jitted programs — one XLA
+    # dispatch per call.  The ``*_program`` methods expose the SAME
+    # computations (same building blocks, same fused layout, same op
+    # order) as plain traceable callables, so a caller can embed a whole
+    # gossip phase inside its own compiled program: the trainer's epoch
+    # superstep scans K epochs of train+gossip in ONE donated dispatch
+    # (``training/trainer.py::GossipTrainer.train_epochs``) instead of
+    # paying a dispatch boundary per epoch.  Static knobs (round counts,
+    # stopping thresholds) are baked at program-build time; they are
+    # compile-time constants of the caller's program anyway.
+
+    def mix_program(self, times: int):
+        """Traceable ``state -> state`` body of :meth:`mix` for a static
+        round count: ``times`` unrolled rounds of this engine's gossip
+        update — numerically identical to the ``fori_loop`` entry point
+        (same per-round ops, same order)."""
+        times = int(times)
+        if self.mesh is None:
+            def run(x):
+                for _ in range(times):
+                    x = self._dense_mix_once(x)
+                return x
+
+            return self._fuse_state_fn(run)
+        mesh, ax = self.mesh, self.axis_name
+        sw, mw = self._self_w, self._match_w
+
+        def local(x, sw, mw):
+            for _ in range(times):
+                x = self._local_mix_once(x, sw, mw)
+            return x
+
+        inner = jax.shard_map(
+            self._fuse_state_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(ax), P(None, ax)),
+            out_specs=P(ax),
+        )
+        return lambda x: inner(x, sw, mw)
+
+    def mix_until_program(
+        self, *, eps: float, min_times: int = 0, max_rounds: int = 10_000
+    ):
+        """Traceable ``state -> (state, rounds_done, residual)`` body of
+        :meth:`mix_until` with the stopping rule baked static — the
+        eps-stopping ``lax.while_loop`` itself is unchanged, so the
+        caller's program still decides the round count on device."""
+        eps_f = jnp.float32(eps)
+        mn = jnp.int32(min_times)
+        mx = jnp.int32(max_rounds)
+        if self.mesh is None:
+            def run(x):
+                return self._run_until(
+                    x, eps_f, mn, mx, self._dense_mix_once,
+                    self._dense_residual,
+                )
+
+            return self._fuse_state_fn(run)
+        mesh, ax = self.mesh, self.axis_name
+        sw, mw = self._self_w, self._match_w
+
+        def local(x, sw, mw):
+            return self._run_until(
+                x, eps_f, mn, mx,
+                lambda s: self._local_mix_once(s, sw, mw),
+                self._local_residual,
+            )
+
+        inner = jax.shard_map(
+            self._fuse_state_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(ax), P(None, ax)),
+            out_specs=(P(ax), P(), P()),
+        )
+        return lambda x: inner(x, sw, mw)
+
+    def chebyshev_program(self, times: int):
+        """Traceable ``state -> state`` body of :meth:`mix_chebyshev`:
+        the fixed accelerated schedule from this engine's exact gamma
+        (``times`` static, as in the entry point)."""
+        omegas = chebyshev_omegas(self.gamma, int(times))
+        return lambda x: self._run_chebyshev(x, omegas)
+
+    def global_average_program(self):
+        """Traceable ``state -> state`` body of :meth:`global_average`
+        (the Gossip-PGA exact all-reduce epoch)."""
+        if self.mesh is None:
+            return self._fuse_state_fn(self._dense_global_avg)
+        mesh, ax = self.mesh, self.axis_name
+        return jax.shard_map(
+            self._fuse_state_fn(self._local_global_avg),
+            mesh=mesh,
+            in_specs=(P(ax),),
+            out_specs=P(ax),
+        )
+
+    def max_deviation_program(self):
+        """Traceable ``state -> scalar`` max agent deviation — the
+        :meth:`max_deviation` statistic embedded in a caller's program
+        (the superstep reads the post-mix residual out of the same
+        dispatch that produced it)."""
+        if self.mesh is None:
+            return lambda x: ops.fused_max_deviation(x, fused=self.fused)
+
+        mesh, ax = self.mesh, self.axis_name
+
+        def local(x):
+            return self._local_residual(self._fuse_in(x))
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(P(ax),), out_specs=P()
+        )
+
+    # ------------------------------------------------------------------ #
     # Jit plumbing                                                       #
     # ------------------------------------------------------------------ #
     def _get_jitted(self, name: str):
@@ -852,7 +997,7 @@ class ConsensusEngine:
                             mn,
                             mx,
                             self._dense_mix_once,
-                            lambda s: jnp.max(ops.agent_deviations(s)),
+                            self._dense_residual,
                         )
                     )
                 )
@@ -883,7 +1028,7 @@ class ConsensusEngine:
                             lambda s: ops.dense_mix(
                                 s, W, precision=self.precision
                             ),
-                            lambda s: jnp.max(ops.agent_deviations(s)),
+                            self._dense_residual,
                         )
                     )
                 )
@@ -900,16 +1045,7 @@ class ConsensusEngine:
                     )
                 )
             elif name == "global_average":
-                def dense_avg(x):
-                    return jax.tree.map(
-                        lambda v: jnp.broadcast_to(
-                            v.astype(jnp.float32).mean(axis=0, keepdims=True),
-                            v.shape,
-                        ).astype(v.dtype),
-                        x,
-                    )
-
-                fn = wrap(fuse(dense_avg))
+                fn = wrap(fuse(self._dense_global_avg))
             else:
                 raise KeyError(name)
         else:
@@ -945,9 +1081,7 @@ class ConsensusEngine:
                         mn,
                         mx,
                         lambda s: self._local_mix_once(s, sw, mw),
-                        lambda s: lax.pmax(
-                            jnp.sqrt(self._local_sq_deviation(s)), ax
-                        ),
+                        self._local_residual,
                     )
 
                 inner = sharded(
@@ -994,9 +1128,7 @@ class ConsensusEngine:
                         mn,
                         mx,
                         lambda s: self._local_allgather_mix(s, W_rows),
-                        lambda s: lax.pmax(
-                            jnp.sqrt(self._local_sq_deviation(s)), ax
-                        ),
+                        self._local_residual,
                     )
 
                 fn = sharded(
@@ -1012,16 +1144,7 @@ class ConsensusEngine:
 
                 fn = sharded(fuse(local_cw), P(ax), extra_in=(P(ax), P()))
             elif name == "global_average":
-                def local_avg(x):
-                    return jax.tree.map(
-                        # graftlint: disable=raw-collective-in-shard-map -- exact consensus: the global average is the mixing fixed point, pmean over agents by definition
-                        lambda v: lax.pmean(
-                            v.astype(jnp.float32), ax
-                        ).astype(v.dtype),
-                        x,
-                    )
-
-                fn = sharded(fuse(local_avg), P(ax))
+                fn = sharded(fuse(self._local_global_avg), P(ax))
             else:
                 raise KeyError(name)
 
@@ -1067,9 +1190,7 @@ class ConsensusEngine:
                     mn,
                     mx,
                     lambda s: ring_once(s, sw, wf, wb, k),
-                    lambda s: lax.pmax(
-                        jnp.sqrt(self._local_sq_deviation(s)), ax
-                    ),
+                    self._local_residual,
                 )
 
             body = local_ur

@@ -83,6 +83,9 @@ class ExperimentConfig:
     chebyshev: bool = False
     time_varying_p: Optional[float] = None  # erdos_renyi edge prob per epoch
     global_avg_every: Optional[int] = None  # Gossip-PGA period (2105.09080)
+    superstep: int = 1  # epochs fused into one compiled dispatch
+                        # (train_epochs; schedule/compression configs
+                        # fall back to 1 with a warning)
     compression: Optional[str] = None  # CHOCO spec: topk:F | atopk:F | randk:F | sign | int8
     compression_gamma: float = 0.2
     # misc
@@ -266,6 +269,7 @@ class ExperimentConfig:
             mix_times=self.mix_times,
             mix_eps=self.mix_eps,
             global_avg_every=self.global_avg_every,
+            superstep=self.superstep,
             compression=self.compression,
             compression_gamma=self.compression_gamma,
             mesh=mesh,

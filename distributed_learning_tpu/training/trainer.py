@@ -302,6 +302,7 @@ class GossipTrainer:
         compression: Any = None,
         compression_gamma: float = 0.2,
         fused_consensus: bool = True,
+        superstep: int = 1,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         obs: Any = None,
@@ -433,6 +434,16 @@ class GossipTrainer:
                 compression = compressor_from_spec(compression)
         self._compression = compression
         self._compression_gamma = float(compression_gamma)
+        # Epoch superstep (train_epochs): compile K epochs of local SGD +
+        # gossip into ONE donated dispatch — start_consensus then runs the
+        # schedule in chunks of K.  1 = the per-epoch path.  Configs whose
+        # gossip needs per-epoch host logic (mix_times_schedule,
+        # topology_schedule, compression) fall back to K=1 with a warning.
+        self.superstep = int(superstep)
+        if self.superstep < 1:
+            raise ValueError(f"superstep must be >= 1, got {superstep}")
+        self._superstep_cache: Dict[int, Any] = {}
+        self._superstep_warned = False
         # Fused flat-buffer consensus (ops/mixing.py::flatten_stacked):
         # the engines ravel the stacked params once per call — and the
         # trainer gossips once per epoch, so the flatten cost is paid per
@@ -660,6 +671,10 @@ class GossipTrainer:
         self._donate_active = (
             self.donate_state and jax.default_backend() != "cpu"
         )
+        # The raw epoch body is kept for the superstep path, which embeds
+        # it (plus the gossip program) inside its own jitted scan.
+        self._epoch_fn = epoch_fn
+        self._superstep_cache = {}
         self._jit_epoch = jax.jit(
             epoch_fn, donate_argnums=(0,) if self._donate_active else ()
         )
@@ -743,28 +758,45 @@ class GossipTrainer:
         return self
 
     # ------------------------------------------------------------------ #
-    def _epoch_indices(self, epoch_idx: int) -> jax.Array:
-        """Per-node shuffle indices for one epoch, laid out (steps, n, B).
-
-        Only these int32 indices cross host->device; the batches themselves
-        are gathered from the resident shards inside the jitted epoch."""
+    def _epoch_perm(self, epoch_idx: int) -> np.ndarray:
+        """Host-side (steps, n, B) shuffle indices for one epoch — one
+        ``np.random.default_rng(seed*1000 + epoch)`` stream per epoch, so
+        the trajectory is a pure function of (seed, epoch) regardless of
+        whether epochs run one per dispatch or K per superstep."""
         n, m = self._Xs.shape[0], self._Xs.shape[1]
         steps = self.epoch_len
         rng = np.random.default_rng(self.seed * 1000 + epoch_idx)
         idx = np.stack(
             [rng.permutation(m)[: steps * self.batch_size] for _ in range(n)]
         ).astype(np.int32)
-        idx = idx.reshape(n, steps, self.batch_size).swapaxes(0, 1)
-        return jnp.asarray(idx)
+        return idx.reshape(n, steps, self.batch_size).swapaxes(0, 1)
+
+    def _epoch_indices(self, epoch_idx: int) -> jax.Array:
+        """Per-node shuffle indices for one epoch, laid out (steps, n, B).
+
+        Only these int32 indices cross host->device; the batches themselves
+        are gathered from the resident shards inside the jitted epoch."""
+        return jnp.asarray(self._epoch_perm(epoch_idx))
+
+    def _superstep_indices(self, epoch0: int, k: int) -> jax.Array:
+        """Shuffle indices for ``k`` consecutive epochs, laid out
+        (k, steps, n, B) and transferred host->device ONCE per superstep —
+        per-epoch streams identical to :meth:`_epoch_indices`, so a
+        superstep samples exactly the batches the per-epoch loop would."""
+        return jnp.asarray(
+            np.stack([self._epoch_perm(epoch0 + j) for j in range(k)])
+        )
 
     def _gossip(self, epoch_idx: int, params: Pytree):
         """One epoch's consensus phase; returns ``(params, rounds_run)``.
 
         ``rounds_run`` is the gossip-round count this epoch actually
-        executed — static for fixed-count paths, read back from the
-        eps-stopping ``lax.while_loop`` (one scalar host copy at the
-        chunk boundary, which the carry contract allows) for ``mix_eps``
-        paths.
+        executed — a static python int for fixed-count paths, the
+        **device scalar** from the eps-stopping ``lax.while_loop`` for
+        ``mix_eps`` paths.  The caller materializes it at the same chunk
+        boundary as ``flush_chunk`` (one host sync region per epoch):
+        reading it back here, between the gossip dispatch and the trace
+        flush, would insert a second blocking round-trip per epoch.
 
         With ``fused_consensus`` (default) every engine call here runs on
         the fused flat-buffer layout: the params are raveled into one
@@ -822,7 +854,7 @@ class GossipTrainer:
                 params, t, _ = self.engine.mix_until_with(
                     params, W_e, eps=self.mix_eps, min_times=mix_times
                 )
-                rounds = int(t)
+                rounds = t  # device scalar; materialized at the flush
             else:
                 params = self.engine.mix_with(params, W_e, times=mix_times)
         elif self._choco is not None:
@@ -852,7 +884,7 @@ class GossipTrainer:
             params, t, _ = self.engine.mix_until(
                 params, eps=self.mix_eps, min_times=mix_times
             )
-            rounds = int(t)
+            rounds = t  # device scalar; materialized at the flush
         return params, rounds
 
     def _span(self, name: str):
@@ -869,19 +901,46 @@ class GossipTrainer:
         with self._span("trainer.epoch"):
             return self._train_epoch()
 
+    def _count_dispatch(self, n: int = 1) -> None:
+        """Obs counter of train-path XLA program launches (epoch chunk /
+        superstep, gossip, deviation readout — eval and checkpoint IO are
+        reporting, not the train path).  The superstep's headline claim —
+        host dispatches per epoch drop from >=3 to 1/K — is asserted off
+        this counter (``benchmarks/bench_superstep.py``)."""
+        if self._obs_registry is not None:
+            self._obs_registry.inc("trainer.dispatches", n)
+
     def _train_epoch(self) -> Dict[str, Any]:
         if self._state is None:
             self.initialize_nodes()
         epoch_idx = self._epochs_done
         idx = self._epoch_indices(epoch_idx)
+        mixed = False
+        rounds: Any = 0
         try:
             with self._span("trainer.chunk"):
                 self._state, losses, accs, gnorms = self._jit_epoch(
                     self._state, self._Xs, self._ys, idx
                 )
+                self._count_dispatch()
+                # Consensus from epoch_cons_num onward (parity: Man_Colab
+                # cell 21 "the first epoch from which consensus begins";
+                # 1-based epochs).  Dispatched BEFORE the chunk flush so
+                # the eps path's device-side round count materializes at
+                # the same host boundary as the metric traces — one sync
+                # region per epoch, not a flush sync plus a blocking
+                # ``int(t)`` readback.
+                params, bs, opt, rng = self._state
+                if (epoch_idx + 1 >= self.epoch_cons_num
+                        and len(self.node_names) > 1):
+                    with self._span("trainer.mix"):
+                        params, rounds = self._gossip(epoch_idx, params)
+                    self._count_dispatch()
+                    mixed = True
+                    self._state = (params, bs, opt, rng)
                 # Materialize inside the try: dispatch is async, so an
                 # execution failure (e.g. OOM) surfaces here, not at the
-                # call above.  flush_chunk is the carry's single
+                # calls above.  flush_chunk is the carry's single
                 # per-chunk host materialization; with obs enabled the
                 # same arrays also land in the registry as series.
                 arrs = flush_chunk(
@@ -893,6 +952,7 @@ class GossipTrainer:
                 losses = arrs["loss"]  # (steps, n)
                 accs = arrs["acc"]
                 gnorms = arrs["grad_norm"]
+                mix_rounds = int(np.asarray(rounds))
         except BaseException:
             # BaseException: KeyboardInterrupt mid-epoch must also drop the
             # state, or the next call crashes on deleted arrays.
@@ -903,16 +963,6 @@ class GossipTrainer:
                 # deleted arrays.
                 self._state = None
             raise
-        # Consensus from epoch_cons_num onward (parity: Man_Colab cell 21
-        # "the first epoch from which consensus begins"; 1-based epochs).
-        mixed = False
-        mix_rounds = 0
-        params, bs, opt, rng = self._state
-        if epoch_idx + 1 >= self.epoch_cons_num and len(self.node_names) > 1:
-            with self._span("trainer.mix"):
-                params, mix_rounds = self._gossip(epoch_idx, params)
-            mixed = True
-            self._state = (params, bs, opt, rng)
 
         # Stats every stat_step batches.
         for s in range(0, losses.shape[0], self.stat_step):
@@ -934,6 +984,7 @@ class GossipTrainer:
                 node.stats.test_acc.append(float(test_accs[a]))
                 node.stats.test_epochs.append(self._global_step)
 
+        self._count_dispatch()  # the deviation readout below
         payload = {
             "epoch": epoch_idx,
             "mixed": mixed,
@@ -981,9 +1032,275 @@ class GossipTrainer:
                     )
         return payload
 
+    # ------------------------------------------------------------------ #
+    # Epoch superstep: K epochs of local SGD + gossip, ONE dispatch      #
+    # ------------------------------------------------------------------ #
+    def _epoch_mode(self, epoch_idx: int) -> int:
+        """Static per-epoch gossip mode — the host-side gating of
+        :meth:`_train_epoch`/:meth:`_gossip` as data: 0 = no gossip
+        (before ``epoch_cons_num``, or a single node), 1 = this config's
+        mixing program (mix / mix_until / chebyshev), 2 = the Gossip-PGA
+        exact all-reduce epoch (``global_avg_every``)."""
+        if len(self.node_names) <= 1 or epoch_idx + 1 < self.epoch_cons_num:
+            return 0
+        consensus_epochs = epoch_idx + 1 - self.epoch_cons_num
+        if (
+            self.global_avg_every is not None
+            and consensus_epochs % self.global_avg_every
+            == self.global_avg_every - 1
+        ):
+            return 2
+        return 1
+
+    def _superstep_supported(self) -> bool:
+        """Whether this config's gossip compiles into the superstep.
+        ``mix_times_schedule`` / ``topology_schedule`` / compression run
+        host logic between epochs (per-epoch python schedules, CHOCO's
+        cross-epoch estimate bookkeeping) — inherently chunk-hostile, so
+        they keep the per-epoch path rather than silently changing
+        semantics."""
+        return (
+            self.mix_times_schedule is None
+            and self.topology_schedule is None
+            and self._choco is None
+        )
+
+    def _make_superstep_fn(self, k: int):
+        """The raw (unjitted) K-epoch superstep program.
+
+        An outer ``lax.scan`` over ``k`` epochs; each iteration runs the
+        SAME epoch body the per-epoch path jits (``self._epoch_fn`` — the
+        per-step scan of the vmapped train step) followed by this
+        config's gossip program body (``parallel/consensus.py``
+        ``*_program`` — the same computations the top-level engine entry
+        points jit), selected per epoch by the traced ``modes`` vector so
+        ``epoch_cons_num`` gating and the Gossip-PGA cadence keep their
+        per-epoch semantics inside one compiled program.  The per-epoch
+        loss/acc/grad-norm traces stack to ``(k, steps, n)`` in the scan
+        ys (the metrics carry, ``obs/carry.py``), the per-epoch gossip
+        round counts to ``(k,)``, and the post-mix consensus residual of
+        the FINAL state is computed in-program — so one dispatch plus one
+        flush covers everything K calls of ``train_epoch`` would read.
+        """
+        engine = self.engine
+        mix_times = self.mix_times
+        if self.chebyshev:
+            mix_body = engine.chebyshev_program(mix_times)
+
+            def mix_branch(p):
+                return mix_body(p), jnp.int32(mix_times)
+        elif self.mix_eps is not None:
+            until = engine.mix_until_program(
+                eps=self.mix_eps, min_times=mix_times
+            )
+
+            def mix_branch(p):
+                p, t, _res = until(p)
+                return p, t
+        else:
+            mix_body = engine.mix_program(mix_times)
+
+            def mix_branch(p):
+                return mix_body(p), jnp.int32(mix_times)
+
+        gavg_body = engine.global_average_program()
+        branches = [
+            lambda p: (p, jnp.int32(0)),            # mode 0: isolated epoch
+            mix_branch,                              # mode 1: config's mix
+            lambda p: (gavg_body(p), jnp.int32(1)),  # mode 2: Gossip-PGA
+        ]
+        max_dev = engine.max_deviation_program()
+        epoch_fn = self._epoch_fn
+
+        def superstep_fn(state, Xs, ys, idx, modes):
+            def body(carry, inp):
+                idx_e, mode_e = inp
+                carry, losses, accs, gnorms = epoch_fn(carry, Xs, ys, idx_e)
+                params, bs, opt, rng = carry
+                params, rounds = jax.lax.switch(mode_e, branches, params)
+                return (params, bs, opt, rng), (losses, accs, gnorms, rounds)
+
+            state, (losses, accs, gnorms, rounds) = jax.lax.scan(
+                body, state, (idx, modes)
+            )
+            dev = max_dev(state[0])
+            return state, losses, accs, gnorms, rounds, dev
+
+        return superstep_fn
+
+    def _build_superstep(self, k: int):
+        """Jitted superstep for chunk size ``k`` (cached per k; the index
+        array's leading axis is part of the program shape).  The carried
+        state is donated exactly like ``_jit_epoch``'s — across the whole
+        superstep the stacked params/opt buffers are updated in place."""
+        fn = self._superstep_cache.get(k)
+        if fn is None:
+            fn = jax.jit(
+                self._make_superstep_fn(k),
+                donate_argnums=(0,) if self._donate_active else (),
+            )
+            self._superstep_cache[k] = fn
+        return fn
+
+    def train_epochs(self, k: int) -> List[Dict[str, Any]]:
+        """Run ``k`` epochs as ONE compiled superstep dispatch; returns
+        the per-epoch payloads (same schema as :meth:`train_epoch`).
+
+        The trajectory is bit-identical to ``k`` calls of
+        :meth:`train_epoch` — same shuffle streams, same step/gossip
+        programs, same PRNG threading — for the compiled gossip paths
+        (plain ``mix_times``, ``mix_eps``, ``chebyshev``,
+        ``global_avg_every``).  Two reporting differences: test-set
+        evaluation and the consensus residual are produced once per
+        superstep (at the boundary, on the final state) rather than per
+        epoch — intermediate payloads carry ``test_acc=None`` /
+        ``deviation=None``.  Configs with per-epoch host logic
+        (``mix_times_schedule``, ``topology_schedule``, ``compression``)
+        fall back to the per-epoch loop with a one-time warning.
+        """
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"train_epochs needs k >= 1, got {k}")
+        if not self._superstep_supported():
+            if k > 1 and not self._superstep_warned:
+                self._superstep_warned = True
+                warnings.warn(
+                    "superstep: mix_times_schedule/topology_schedule/"
+                    "compression configs run per-epoch host logic between "
+                    "epochs and cannot be fused into one dispatch; "
+                    "falling back to K=1 (the per-epoch path, unchanged "
+                    "semantics)",
+                    stacklevel=2,
+                )
+            return [self.train_epoch() for _ in range(k)]
+        if k == 1:
+            # One epoch needs no outer scan; the per-epoch program is
+            # already compiled (and is the oracle the superstep is
+            # measured against).
+            return [self.train_epoch()]
+        with self._span("trainer.superstep"):
+            return self._train_superstep(k)
+
+    def _train_superstep(self, k: int) -> List[Dict[str, Any]]:
+        if self._state is None:
+            self.initialize_nodes()
+        epoch0 = self._epochs_done
+        idx = self._superstep_indices(epoch0, k)  # ONE host->device copy
+        modes_host = [self._epoch_mode(epoch0 + j) for j in range(k)]
+        modes = jnp.asarray(modes_host, dtype=jnp.int32)
+        fn = self._build_superstep(k)
+        try:
+            with self._span("trainer.chunk"):
+                (self._state, losses, accs, gnorms, rounds, dev) = fn(
+                    self._state, self._Xs, self._ys, idx, modes
+                )
+                self._count_dispatch()
+                # The superstep's single host boundary: traces, per-epoch
+                # round counts, and the final residual all materialize
+                # here (flush_chunk collapses the (k, steps, n) traces to
+                # one k*steps-step chunk for the registry).
+                arrs = flush_chunk(
+                    self._obs_registry,
+                    {"loss": losses, "acc": accs, "grad_norm": gnorms},
+                    step0=self._global_step,
+                    node_names=self.node_names,
+                )
+                losses = arrs["loss"]  # (k, steps, n)
+                accs = arrs["acc"]
+                gnorms = arrs["grad_norm"]
+                rounds_host = np.asarray(rounds)  # (k,)
+                deviation = float(np.asarray(dev))
+        except BaseException:
+            # Same donation discipline as _train_epoch: the donated input
+            # buffers may already be gone; drop the dangling reference.
+            if self._donate_active:
+                self._state = None
+            raise
+
+        steps = losses.shape[1]
+        params, bs, _opt, _rng = self._state
+        test_accs = None
+        if self.test_data is not None:
+            # Evaluated once per superstep, on the boundary state.
+            with self._span("trainer.eval"):
+                test_accs = self._eval_accuracy(params, bs)
+
+        payloads: List[Dict[str, Any]] = []
+        for j in range(k):
+            epoch_idx = epoch0 + j
+            final = j == k - 1
+            step_base = self._global_step
+            for s in range(0, steps, self.stat_step):
+                chunk = slice(s, min(s + self.stat_step, steps))
+                for a, name in enumerate(self.node_names):
+                    node = self.network[name]
+                    node.stats.steps.append(step_base + chunk.stop)
+                    node.stats.train_loss.append(
+                        float(losses[j, chunk, a].mean())
+                    )
+                    node.stats.train_acc.append(
+                        float(accs[j, chunk, a].mean())
+                    )
+            self._global_step += steps
+            self._epochs_done += 1
+            payloads.append({
+                "epoch": epoch_idx,
+                "mixed": modes_host[j] != 0,
+                "train_loss": losses[j].mean(axis=0),
+                "train_acc": accs[j].mean(axis=0),
+                "grad_norm": gnorms[j].mean(axis=0),
+                "test_acc": test_accs if final else None,
+                "mix_rounds": int(rounds_host[j]),
+                "deviation": deviation if final else None,
+            })
+        if test_accs is not None:
+            for a, name in enumerate(self.node_names):
+                node = self.network[name]
+                node.stats.test_acc.append(float(test_accs[a]))
+                node.stats.test_epochs.append(self._global_step)
+
+        if self._obs_registry is not None:
+            self._obs_registry.observe(
+                "consensus.residual", deviation, step=self._global_step
+            )
+            total_rounds = int(rounds_host.sum())
+            if total_rounds:
+                self._obs_registry.inc("consensus.rounds_run", total_rounds)
+            if test_accs is not None:
+                self._obs_registry.observe(
+                    "eval.test_acc", float(np.mean(test_accs)),
+                    step=self._global_step,
+                )
+        if self.telemetry is not None:
+            with self._span("trainer.telemetry"):
+                for payload in payloads:
+                    for a, name in enumerate(self.node_names):
+                        self.telemetry.process(
+                            name,
+                            {
+                                "epoch": payload["epoch"],
+                                "train_loss": float(payload["train_loss"][a]),
+                                "train_acc": float(payload["train_acc"][a]),
+                                "grad_norm": float(payload["grad_norm"][a]),
+                                "test_acc": None
+                                if payload["test_acc"] is None
+                                else float(payload["test_acc"][a]),
+                                "mix_rounds": payload["mix_rounds"],
+                                "deviation": payload["deviation"],
+                            },
+                        )
+        return payloads
+
     def start_consensus(self) -> List[Dict[str, Any]]:
-        """Run the full training schedule (parity: ``master.start_consensus()``)."""
-        return [self.train_epoch() for _ in range(self.num_epochs - self._epochs_done)]
+        """Run the full training schedule (parity:
+        ``master.start_consensus()``) — in superstep chunks of
+        ``self.superstep`` epochs when configured (one compiled dispatch
+        per chunk; a short final chunk compiles once more)."""
+        results: List[Dict[str, Any]] = []
+        while self._epochs_done < self.num_epochs:
+            k = min(self.superstep, self.num_epochs - self._epochs_done)
+            results.extend(self.train_epochs(k))
+        return results
 
     # ------------------------------------------------------------------ #
     @property

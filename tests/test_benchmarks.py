@@ -76,6 +76,28 @@ def test_bench_fused_vs_perleaf_smoke(capsys):
     assert rec["rounds_per_sec_perleaf"] > 0
 
 
+def test_bench_superstep_smoke(capsys):
+    """Epoch-superstep rot guard: K=16 beats the per-epoch path (the
+    headline run shows ~6x on the 1-core CPU harness; the test gate is
+    1.3x — the acceptance floor — so shared-CI timing noise cannot flake
+    tier-1), and host dispatches per epoch drop from >=3 (epoch + gossip
+    + residual readout) to exactly 1/K (one fused dispatch per
+    superstep), counted from the obs ``trainer.dispatches`` counter."""
+    from benchmarks import bench_superstep
+
+    out = bench_superstep.run(epochs=16)
+    assert out["speedup"] > 1.3
+    assert out["dispatches_per_epoch"][1] >= 3
+    assert out["dispatches_per_epoch"][16] == pytest.approx(1 / 16)
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    (rec,) = [r for r in lines
+              if r["metric"] == "trainer_superstep_epochs_per_sec"]
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["value"] > 0
+    assert rec["dispatches_per_epoch_by_k"]["1"] >= 3
+
+
 def test_bench_cifar_mlp_smoke(capsys):
     from benchmarks import bench_cifar_mlp
 

@@ -569,3 +569,99 @@ def test_fused_mix_until_sharded_one_ppermute_per_matching():
     assert fused[("ppermute", ("agents",))] == matchings  # one per direction
     perleaf = inventory(ConsensusEngine(W, mesh=mesh, fused=False))
     assert perleaf[("ppermute", ("agents",))] == matchings * 12
+
+
+def _count_weighted_gossip_gemms(jaxpr, n: int, *, mult: int = 1) -> int:
+    """Executed-count of gossip GEMMs — ``dot_general`` equations whose
+    lhs is the (n, n) mixing matrix — descending into sub-jaxprs with
+    scan counts multiplied by their trip length.  Model GEMMs never
+    contract an (n, n) lhs (the vmapped step's operands carry batch/
+    feature dims), so the shape filter isolates the gossip rounds."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+            if shape == (n, n):
+                total += mult
+        inner_mult = mult
+        if eqn.primitive.name == "scan":
+            inner_mult = mult * int(eqn.params.get("length", 1))
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (tuple, list)) else [val]
+            for v in vals:
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    total += _count_weighted_gossip_gemms(
+                        inner, n, mult=inner_mult
+                    )
+                elif hasattr(v, "eqns"):
+                    total += _count_weighted_gossip_gemms(
+                        v, n, mult=inner_mult
+                    )
+    return total
+
+
+def test_superstep_has_exactly_k_times_mixtimes_gossip_gemms():
+    """The superstep fusion proof (dense route): a K=3, mix_times=2
+    superstep program executes exactly K x 2 gossip GEMMs — the epoch
+    scan's body carries mix_times dot_generals against the (n, n)
+    mixing matrix and the scan runs K times.  Fewer would mean fusion
+    HOISTED gossip out of the epoch loop (mixing once for K epochs);
+    more would mean it duplicated rounds; zero outside the scan means
+    nothing leaked to a per-superstep position.  The per-leaf oracle
+    (fused=False) pays leaf_count GEMMs per round — fused engagement
+    inside the superstep is part of the pin."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_tpu.training.trainer import GossipTrainer
+
+    n, k, mix_times = 3, 3, 2
+    rng = np.random.default_rng(0)
+    train = {
+        i: (
+            rng.normal(size=(32, 6)).astype(np.float32),
+            rng.integers(0, 2, size=(32,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+
+    def trace(fused):
+        tr = GossipTrainer(
+            node_names=list(range(n)),
+            model="mlp",
+            model_kwargs={"hidden_dim": 8, "output_dim": 2},
+            weights=np.full((n, n), 1.0 / n),
+            train_data=train,
+            batch_size=8,
+            epoch_len=2,
+            mix_times=mix_times,
+            dropout=False,
+            fused_consensus=fused,
+            superstep=k,
+        )
+        tr.initialize_nodes()
+        idx = tr._superstep_indices(0, k)
+        modes = jnp.asarray(
+            [tr._epoch_mode(j) for j in range(k)], dtype=jnp.int32
+        )
+        fn = tr._make_superstep_fn(k)
+        jx = jax.make_jaxpr(fn)(tr.state, tr._Xs, tr._ys, idx, modes)
+        leaves = len(jax.tree.leaves(tr.state[0]))
+        return jx, leaves
+
+    fused_jx, leaves = trace(fused=True)
+    assert _count_weighted_gossip_gemms(fused_jx.jaxpr, n) == k * mix_times
+    # Top-level (outside every scan): nothing hoisted.
+    top = sum(
+        1 for eqn in fused_jx.jaxpr.eqns
+        if eqn.primitive.name == "dot_general"
+        and tuple(getattr(eqn.invars[0].aval, "shape", ())) == (n, n)
+    )
+    assert top == 0
+    perleaf_jx, leaves = trace(fused=False)
+    assert leaves > 1
+    assert _count_weighted_gossip_gemms(perleaf_jx.jaxpr, n) == (
+        k * mix_times * leaves
+    )

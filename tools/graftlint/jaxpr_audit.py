@@ -264,6 +264,61 @@ def _consensus_mix_until() -> Counter:
     return collect_collectives(jx.jaxpr)
 
 
+@entry("gossip_superstep", kind="jaxpr", requires=("shard_map",))
+def _gossip_superstep() -> Counter:
+    """The trainer's K-epoch superstep on a ring(8) agent mesh
+    (``GossipTrainer.train_epochs``): K=3 epochs of the per-step scan
+    plus the static-2-round gossip program fused into ONE program.
+
+    Pin: the epoch scan's mix branch moves one ppermute per matching
+    per dtype bucket per round (ring(8) Metropolis = 2 matchings, one
+    f32 bucket, 2 rounds -> 4 ppermutes), the Gossip-PGA branch is one
+    pmean (psum) per bucket, and the boundary residual readout is one
+    pmean (psum) plus the pmax.  The counts are flat (per scan-body
+    trace): a drift upward means fusing duplicated gossip, a gossip
+    collective OUTSIDE the scan means it was hoisted — either fails
+    tier-1 with the op and axis named.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_tpu.parallel.consensus import make_agent_mesh
+    from distributed_learning_tpu.parallel.topology import Topology
+    from distributed_learning_tpu.training.trainer import GossipTrainer
+
+    n, k = 8, 3
+    rng = np.random.default_rng(0)
+    train = {
+        i: (
+            rng.normal(size=(32, 6)).astype(np.float32),
+            rng.integers(0, 3, size=(32,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+    tr = GossipTrainer(
+        node_names=list(range(n)),
+        model="mlp",
+        model_kwargs={"hidden_dim": 8, "output_dim": 3},
+        weights=Topology.ring(n),
+        train_data=train,
+        batch_size=8,
+        epoch_len=2,
+        mix_times=2,
+        dropout=False,
+        mesh=make_agent_mesh(n),
+        superstep=k,
+    )
+    tr.initialize_nodes()
+    idx = tr._superstep_indices(0, k)
+    modes = jnp.asarray(
+        [tr._epoch_mode(j) for j in range(k)], dtype=jnp.int32
+    )
+    fn = tr._make_superstep_fn(k)
+    jx = jax.make_jaxpr(fn)(tr.state, tr._Xs, tr._ys, idx, modes)
+    return collect_collectives(jx.jaxpr)
+
+
 def load_expected(path: str = EXPECTED_PATH) -> Dict[str, dict]:
     with open(path, "r", encoding="utf-8") as fh:
         return json.load(fh)
