@@ -47,6 +47,10 @@ import optax
 from distributed_learning_tpu.models import WideResNet
 from distributed_learning_tpu.obs import SpanTracer
 from distributed_learning_tpu.ops import mixing as mixing_ops
+from distributed_learning_tpu.parallel.compression import (
+    FusedCompressor,
+    top_k as choco_top_k,
+)
 from distributed_learning_tpu.parallel.consensus import ConsensusEngine
 from distributed_learning_tpu.parallel.topology import Topology
 
@@ -196,6 +200,13 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
         leaf_count=layout.leaf_count,
         fused_buckets=layout.bucket_count,
         mix_bytes_per_round=layout.bytes_per_round(n_agents),
+        # What one CHOCO round's corrections would ship over the sparse
+        # wire at the nominal 10% top-k budget (the fused frame's
+        # u32-index + stored-dtype-value accounting) — the compressed
+        # counterpart of mix_bytes_per_round; host-side arithmetic only.
+        choco_bytes_per_round=FusedCompressor(
+            choco_top_k(0.1)
+        ).wire_bytes_per_round(layout, n_agents),
     )
     bs = stack(variables["batch_stats"])
     opt = jax.vmap(tx.init)(params)

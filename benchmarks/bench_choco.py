@@ -1,0 +1,154 @@
+"""Fused compressed gossip (CHOCO) vs the per-leaf oracle.
+
+PR 3 fused the dense *mixing* family onto one contiguous ``(N, P)``
+buffer per dtype bucket but left compression per leaf, so a CHOCO round
+on a model-shaped state still paid O(leaves) ``lax.top_k`` sorts,
+scatters, and RNG splits per agent per round — dwarfing the single fused
+GEMM they feed.  This benchmark measures what routing compression through
+the ``FusedCompressor`` (segment-aware selection in O(dtype-buckets x
+size-classes) device ops, ``parallel/compression.py``) buys, on two
+64-leaf mixed-dtype (bf16 + f32) trees:
+
+* ``tail`` — leaf sizes in the bias/norm-scale range (4-45 elements),
+  the regime where per-op overhead dominates a compressed round and the
+  fusion pays most (the same regime ``bench_fast_averaging.py`` uses for
+  the mixing fusion).  The >= 2x acceptance gate (ISSUE 5) applies here.
+* ``conv`` — leaf sizes in the small-conv range (4-~280), where the
+  selection FLOPs themselves (identical in both layouts) take a larger
+  share; the fused win is correspondingly smaller (~1.3-1.7x measured)
+  and is REPORTED, not gated — no silent cherry-picking.
+
+Also recorded: the nominal sparse-wire bytes one round's corrections
+occupy (``FusedCompressor.wire_bytes_per_round`` — what the TCP fused
+sparse frame ships) next to the dense state volume.
+
+The tier-1 rot guard in ``tests/test_benchmarks.py`` gates the tail
+speedup at a looser 1.5x so shared-CI timing noise cannot flake tier-1.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks import common
+from distributed_learning_tpu.ops import mixing as mixing_ops
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.parallel.compression import (
+    ChocoGossipEngine,
+    FusedCompressor,
+    top_k,
+)
+
+
+def _tail_stack(n_agents: int, leaves: int, base: int) -> dict:
+    """``leaves`` bias/norm-scale-sized mixed-dtype leaves: pairs of a
+    ``(N, base..base+6)`` scale and a ``(N, 4)`` bias, every fourth pair
+    stored bf16 — the per-op-overhead-dominated tail regime."""
+    rng = np.random.default_rng(11)
+    tree = {}
+    for i in range(leaves // 2):
+        d = base + (i % 7)
+        dt = jnp.bfloat16 if i % 4 == 3 else jnp.float32
+        tree[f"l{i:03d}"] = {
+            "s": jnp.asarray(rng.normal(size=(n_agents, d)), dt),
+            "b": jnp.asarray(rng.normal(size=(n_agents, 4)), dt),
+        }
+    return tree
+
+
+def _conv_stack(n_agents: int, leaves: int, width: int) -> dict:
+    """``leaves`` small-conv-sized leaves (w/b pairs of varying fan-in,
+    every fourth pair bf16): per-element selection work takes a larger
+    share, so this is the fused path's UNFAVORABLE regime."""
+    rng = np.random.default_rng(11)
+    tree = {}
+    for i in range(leaves // 2):
+        d = width + (i % 7)
+        dt = jnp.bfloat16 if i % 4 == 3 else jnp.float32
+        tree[f"l{i:03d}"] = {
+            "w": jnp.asarray(rng.normal(size=(n_agents, d, 4)), dt),
+            "b": jnp.asarray(rng.normal(size=(n_agents, 4)), dt),
+        }
+    return tree
+
+
+def _measure(
+    x: dict, n_agents: int, rounds: int, fraction: float, label: str
+) -> dict:
+    layout = mixing_ops.fused_layout(x)
+    W = Topology.ring(n_agents).metropolis_weights()
+    comp = top_k(fraction)
+    out: dict = {}
+    for mode, fused in (("fused", True), ("perleaf", False)):
+        eng = ChocoGossipEngine(W, comp, gamma=0.3, fused=fused)
+        state = eng.init(x, seed=3)
+        warm, _ = eng.run(state, rounds)  # compile at the timed length
+        common.sync(warm.x)
+        best = 0.0
+        for _ in range(3):  # best-of-3: rounds are ~ms-scale on CPU
+            with common.stopwatch() as t:
+                done, _trace = eng.run(state, rounds)
+                common.sync(done.x)
+            best = max(best, rounds / t["s"])
+        out[mode] = best
+    out["speedup"] = out["fused"] / out["perleaf"]
+    wire = FusedCompressor(comp).wire_bytes_per_round(layout, n_agents)
+    out["wire_bytes_per_round"] = wire
+    out["dense_bytes_per_round"] = layout.bytes_per_round(n_agents)
+    common.emit(
+        {
+            "metric": f"choco_fused_rounds_per_sec_{label}",
+            "value": round(out["fused"], 2),
+            "unit": "rounds/sec",
+            "vs_baseline": None,
+            "config": "choco-ring-metropolis-topk",
+            "tree_regime": label,
+            "rounds_per_sec_perleaf": round(out["perleaf"], 2),
+            "speedup_vs_perleaf": round(out["speedup"], 3),
+            "top_k_fraction": fraction,
+            "leaf_count": layout.leaf_count,
+            "fused_buckets": layout.bucket_count,
+            "wire_bytes_per_round": wire,
+            "dense_bytes_per_round": layout.bytes_per_round(n_agents),
+            "rounds_timed": rounds,
+            "n_agents": n_agents,
+        }
+    )
+    return out
+
+
+def run_fused_vs_perleaf(
+    n_agents: int = 8,
+    leaves: int = 64,
+    rounds: int | None = None,
+    fraction: float = 0.1,
+) -> dict:
+    """Compressed rounds/sec fused vs per-leaf on the tail tree (the
+    gated headline) and the conv tree (the disclosed unfavorable
+    regime); returns ``{"fused", "perleaf", "speedup", ...}`` of the
+    tail tree plus ``conv_speedup``."""
+    if rounds is None:
+        # Enough rounds that per-call fixed cost (dispatch, flatten
+        # prologue) amortizes and the per-ROUND cost — what fused
+        # compression changes — is what the clock sees.
+        rounds = 100 if common.smoke() else 200
+    base = 16 if common.smoke() else 32
+    out = _measure(
+        _tail_stack(n_agents, leaves, base), n_agents, rounds, fraction,
+        "tail",
+    )
+    conv = _measure(
+        _conv_stack(n_agents, leaves, base), n_agents, rounds, fraction,
+        "conv",
+    )
+    out["conv_speedup"] = conv["speedup"]
+    return out
+
+
+def run(n_agents: int = 8, leaves: int = 64) -> dict:
+    return run_fused_vs_perleaf(n_agents, leaves)
+
+
+if __name__ == "__main__":
+    run()

@@ -75,6 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compression", default=None,
                    help="CHOCO-SGD compressed gossip: topk:F | atopk:F | randk:F | sign | int8 | none (disables, overriding a saved config)")
     p.add_argument("--compression-gamma", type=float, default=None)
+    p.add_argument("--compression-budget", default=None,
+                   choices=["per-leaf", "global"],
+                   help="fused CHOCO k budget: per-leaf keeps each "
+                        "tensor's fraction (oracle-identical), global "
+                        "spends one budget per fused dtype bucket")
     p.add_argument("--augment", action="store_true",
                    help="jitted RandomCrop+Flip train augmentation")
     p.add_argument("--remat", action="store_true",
@@ -161,6 +166,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         ("superstep", args.superstep),
         ("compression", args.compression),
         ("compression_gamma", args.compression_gamma),
+        ("compression_budget", args.compression_budget),
         ("n_train", args.n_train),
         ("seed", args.seed),
         ("stat_step", args.stat_step),

@@ -27,8 +27,10 @@ from distributed_learning_tpu.comm.framing import FramedStream, FrameError, open
 from distributed_learning_tpu.comm.master import ConsensusMaster
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
 from distributed_learning_tpu.comm.tensor_codec import (
+    decode_fused_sparse,
     decode_sparse,
     decode_tensor,
+    encode_fused_sparse,
     encode_sparse,
     encode_tensor,
     top_k_sparse,
@@ -72,5 +74,7 @@ __all__ = [
     "decode_tensor",
     "encode_sparse",
     "decode_sparse",
+    "encode_fused_sparse",
+    "decode_fused_sparse",
     "top_k_compressor",
 ]

@@ -153,6 +153,14 @@ class ConsensusAgent:
         self._prev_value: Optional[np.ndarray] = None
         # Two-slot (array, sparse-beats-dense) memo for _sparse_wins.
         self._sparse_cache: list = [(None, False), (None, False)]
+        # Fused tree gossip (run_choco_tree): the TreeSpec of the gossiped
+        # model (a deployment invariant — every agent has the same model)
+        # and its dtype-bucket spans; _fused_spans is non-None exactly
+        # while a fused tree op is in flight, switching _make_response to
+        # the one-frame-per-round fused sparse encoding.
+        self._tree_spec = None
+        self._tree_buckets = None
+        self._fused_spans = None
         self._deferred: Dict[Tuple[int, int], list] = {}
         # Persistent read tasks: a FramedStream.recv interrupted mid-frame
         # would corrupt the stream, so reads are never cancelled — a
@@ -386,13 +394,33 @@ class ConsensusAgent:
 
     def _make_response(self, round_id: int, iteration: int, value):
         """Pick the wire encoding per message: sparse only when it
-        actually saves bytes; a dense value on a ``sparse_wire`` agent
-        would otherwise cost ~2-3x the dense wire."""
+        actually saves bytes (a dense value on a ``sparse_wire`` agent
+        would otherwise cost ~2-3x the dense wire); during a fused tree
+        op (``run_choco_tree``) a sparse win ships as ONE fused frame
+        with per-dtype-bucket value sections.  Counts the choice as
+        ``sparse_frames``/``dense_frames`` (fused additionally as
+        ``fused_frames``)."""
+        if self._fused_spans is not None and value is not None:
+            # Fused tree op: the fused frame IS this round's value
+            # contract — the sender's own estimate was updated with the
+            # fused-rounded bytes (per-bucket value narrowing), so a
+            # per-message dense fallback here would hand neighbors
+            # different bytes and permanently diverge the replicated
+            # estimates.
+            self._count("sparse_frames")
+            self._count("fused_frames")
+            return P.ValueResponseFusedSparse(
+                round_id=round_id, iteration=iteration, value=value,
+                buckets=self._fused_spans,
+                bf16_wire=self.bf16_wire, int8_wire=self._int8_active,
+            )
         if self.sparse_wire and value is not None and self._sparse_wins(value):
+            self._count("sparse_frames")
             return P.ValueResponseSparse(
                 round_id=round_id, iteration=iteration, value=value,
                 bf16_wire=self.bf16_wire, int8_wire=self._int8_active,
             )
+        self._count("dense_frames")
         return P.ValueResponse(
             round_id=round_id, iteration=iteration, value=value,
             bf16_wire=self.bf16_wire, int8_wire=self._int8_active,
@@ -476,7 +504,14 @@ class ConsensusAgent:
                 raise ConnectionError(f"neighbor {token} disconnected mid-gossip")
             if isinstance(msg, P.ValueRequest):
                 await self._answer(token, msg)
-            elif isinstance(msg, (P.ValueResponse, P.ValueResponseSparse)):
+            elif isinstance(
+                msg,
+                (
+                    P.ValueResponse,
+                    P.ValueResponseSparse,
+                    P.ValueResponseFusedSparse,
+                ),
+            ):
                 if (msg.round_id, msg.iteration) == (
                     self._op_id,
                     self._iteration,
@@ -592,6 +627,24 @@ class ConsensusAgent:
         every agent followed by one master ``run_round`` (tag re-align),
         then the compressed stream resumes.
         """
+        x = self._choco_begin(value)
+        q = np.asarray(compressor(x - self._choco_hat_self), np.float32).ravel()
+        q = self._wire_round(q)
+        self._op_id += 1
+        self._iteration = 0
+        self._count("choco_iterations")
+        self._int8_active = self.int8_wire  # int8 only for this exchange
+        try:
+            neighbor_qs = await self._exchange_values(q)
+        finally:
+            self._int8_active = False
+        assert neighbor_qs is not None  # no master Done in masterless mode
+        return self._choco_finish(x, q, neighbor_qs, gamma)
+
+    def _choco_begin(self, value: np.ndarray) -> np.ndarray:
+        """Shared CHOCO preamble: readiness/realignment/invalidation
+        guards, flatten to the f32 wire vector, lazy zero-init of the
+        replicated estimates."""
         if self.status not in (AgentStatus.READY, AgentStatus.IN_ROUND):
             raise RuntimeError(f"agent not ready (status={self.status})")
         self._require_neighbors()
@@ -614,48 +667,175 @@ class ConsensusAgent:
             )
         for t in self._neighbors:
             self._choco_hat_nbrs.setdefault(t, np.zeros_like(x))
+        return x
 
-        q = np.asarray(compressor(x - self._choco_hat_self), np.float32).ravel()
-        # CRITICAL: every holder of an estimate must apply the SAME bytes.
-        # Neighbors receive q after the wire round-trip (bf16 narrowing,
-        # sparse re-densification); the sender must update its own hat with
-        # that wire-rounded q, not the exact one, or the replicated
-        # estimates permanently diverge and consensus stalls (measured:
-        # 0.167 residual floor with bf16_wire and the exact-q update).
+    def _wire_round(self, q: np.ndarray) -> np.ndarray:
+        """Round a correction through this agent's own wire encoding.
+
+        CRITICAL: every holder of an estimate must apply the SAME bytes.
+        Neighbors receive q after the wire round-trip (bf16 narrowing,
+        sparse re-densification); the sender must update its own hat with
+        that wire-rounded q, not the exact one, or the replicated
+        estimates permanently diverge and consensus stalls (measured:
+        0.167 residual floor with bf16_wire and the exact-q update)."""
         from distributed_learning_tpu.comm.tensor_codec import (
+            decode_fused_sparse,
             decode_sparse,
             decode_tensor,
+            encode_fused_sparse,
             encode_sparse,
             encode_tensor,
         )
 
+        if self._fused_spans is not None:
+            return decode_fused_sparse(encode_fused_sparse(
+                q, self._fused_spans,
+                bf16_wire=self.bf16_wire, int8_wire=self.int8_wire,
+            ))
         if self.sparse_wire:
-            q = decode_sparse(encode_sparse(
+            return decode_sparse(encode_sparse(
                 q, bf16_wire=self.bf16_wire, int8_wire=self.int8_wire
             ))
-        elif self.bf16_wire or self.int8_wire:
-            q = decode_tensor(encode_tensor(
+        if self.bf16_wire or self.int8_wire:
+            return decode_tensor(encode_tensor(
                 q, bf16_wire=self.bf16_wire, int8_wire=self.int8_wire
             ))
-        self._op_id += 1
-        self._iteration = 0
-        self._count("choco_iterations")
-        self._int8_active = self.int8_wire  # int8 only for this exchange
-        try:
-            neighbor_qs = await self._exchange_values(q)
-        finally:
-            self._int8_active = False
-        assert neighbor_qs is not None  # no master Done in masterless mode
+        return q
 
+    def _choco_finish(
+        self, x: np.ndarray, q: np.ndarray, neighbor_qs, gamma: float
+    ) -> np.ndarray:
+        """Shared CHOCO epilogue: apply the exchanged corrections to the
+        replicated estimates and step the iterate."""
         self._choco_hat_self = self._choco_hat_self + q
         out = x.copy()
         for t, qn in neighbor_qs.items():
-            self._choco_hat_nbrs[t] = self._choco_hat_nbrs[t] + qn
+            self._choco_hat_nbrs[t] = self._choco_hat_nbrs[t] + np.asarray(
+                qn, np.float32
+            ).ravel()
             out += gamma * self._weights[t] * (
                 self._choco_hat_nbrs[t] - self._choco_hat_self
             )
         # Self term of sum_j W_ij (xhat_j - xhat_i): j = i contributes 0.
         return out
+
+    async def run_choco_tree(
+        self,
+        tree: Any,
+        compressor: Callable[[np.ndarray], np.ndarray],
+        *,
+        gamma: float = 0.3,
+        budget: str = "per-leaf",
+        fused: bool = True,
+    ) -> Any:
+        """One CHOCO-GOSSIP iteration over a whole model pytree.
+
+        The tree crosses the wire as its ``pytree_codec.TreeSpec`` ravel
+        (the spec is a deployment invariant — same model class + config
+        on every agent).  ``budget`` scopes the compressor exactly like
+        the on-device engine (``parallel/compression.py``):
+        ``"per-leaf"`` applies it to each leaf span of the ravel (a
+        top-k fraction stays a per-tensor contract), ``"global"`` once
+        to the whole ravel (one k budget across the model).
+
+        ``fused=True`` (default) runs ONE collective exchange per round
+        and — under ``sparse_wire`` — ships the correction as ONE fused
+        sparse frame with one ``indices|values`` section per dtype
+        bucket (``ValueResponseFusedSparse``), collapsing per-leaf
+        framing/CRC/header overhead.  ``fused=False`` is the per-leaf
+        baseline it replaces: one exchange (one frame per neighbor and
+        direction) PER LEAF per round — kept as the wire-level oracle;
+        the frame-count loopback test pins the >= 2x frame reduction.
+
+        All agents must call it concurrently with the same tree
+        structure, compressor family, ``budget``, ``gamma``, and
+        ``fused`` flag; estimates persist across calls (and are shared
+        with :meth:`run_choco_once` — one estimate stream per agent).
+        """
+        from distributed_learning_tpu.comm.pytree_codec import (
+            flat_to_tree,
+            tree_to_flat,
+        )
+
+        if budget not in ("per-leaf", "global"):
+            raise ValueError(
+                f"unknown compression budget {budget!r} (want 'per-leaf' "
+                "or 'global')"
+            )
+        flat, spec = tree_to_flat(tree)
+        if self._tree_spec is None:
+            self._tree_spec = spec
+            self._tree_buckets = spec.dtype_buckets()
+        elif spec != self._tree_spec:
+            raise ValueError(
+                "tree structure changed across run_choco_tree calls; the "
+                "TreeSpec is a deployment invariant (reset_choco() and "
+                "restart the stream to change models)"
+            )
+        x = self._choco_begin(flat)
+        delta = x - self._choco_hat_self
+        if budget == "global":
+            q = np.asarray(compressor(delta), np.float32).ravel()
+        else:
+            q = np.empty_like(delta)
+            off = 0
+            for size in spec.sizes:
+                q[off : off + size] = np.asarray(
+                    compressor(delta[off : off + size]), np.float32
+                ).ravel()
+                off += size
+
+        if fused:
+            # The fused sparse frame engages under sparse_wire (CHOCO
+            # corrections are k-sparse by construction); without it the
+            # round still fuses to ONE exchange with the plain dense
+            # wire-rounding — the framing win, minus the sparse payload.
+            self._fused_spans = (
+                self._tree_buckets if self.sparse_wire else None
+            )
+            try:
+                q = self._wire_round(q)
+                self._op_id += 1
+                self._iteration = 0
+                self._count("choco_tree_rounds")
+                self._int8_active = self.int8_wire
+                neighbor_qs = await self._exchange_values(q)
+            finally:
+                self._int8_active = False
+                self._fused_spans = None
+            assert neighbor_qs is not None
+        else:
+            # Per-leaf baseline: one collective exchange per leaf span,
+            # each wire-rounded exactly as a standalone correction.
+            parts: Dict[str, list] = {t: [] for t in self._neighbors}
+            rounded = []
+            off = 0
+            for size in spec.sizes:
+                piece = self._wire_round(
+                    np.ascontiguousarray(q[off : off + size])
+                )
+                rounded.append(piece)
+                self._op_id += 1
+                self._iteration = 0
+                self._count("choco_tree_leaf_rounds")
+                self._int8_active = self.int8_wire
+                try:
+                    vals = await self._exchange_values(piece)
+                finally:
+                    self._int8_active = False
+                assert vals is not None
+                for t, v in vals.items():
+                    parts[t].append(np.asarray(v, np.float32).ravel())
+                off += size
+            q = (
+                np.concatenate(rounded)
+                if rounded else np.zeros(0, np.float32)
+            )
+            neighbor_qs = {
+                t: np.concatenate(ps) for t, ps in parts.items()
+            }
+        out = self._choco_finish(x, q, neighbor_qs, gamma)
+        return flat_to_tree(out, spec)
 
     def reset_choco(self) -> None:
         """Restart the compressed-gossip stream: drop all public estimates.

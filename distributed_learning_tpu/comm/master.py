@@ -124,6 +124,21 @@ class ConsensusMaster:
         self.counters[name] = self.counters.get(name, 0) + value
         get_registry().inc(f"comm.master.{name}", value)
 
+    def wire_stats(self) -> Dict[str, int]:
+        """Whole-frame byte/frame totals over the master's live control
+        streams — the control-plane counterpart of
+        ``ConsensusAgent.wire_stats()``.  The master never carries gossip
+        values, so these totals are pure coordination overhead; the
+        fused-wire loopback test pins that per-leaf -> fused data-plane
+        framing changes leave them untouched."""
+        streams = list(self._control.values())
+        return {
+            "bytes_sent": sum(s.bytes_sent for s in streams),
+            "bytes_received": sum(s.bytes_received for s in streams),
+            "frames_sent": sum(s.frames_sent for s in streams),
+            "frames_received": sum(s.frames_received for s in streams),
+        }
+
     @property
     def address(self) -> Tuple[str, int]:
         assert self._server is not None, "master not started"

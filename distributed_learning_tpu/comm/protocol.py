@@ -41,6 +41,7 @@ __all__ = [
     "Shutdown",
     "Telemetry",
     "ValueResponseSparse",
+    "ValueResponseFusedSparse",
     "pack_message",
     "unpack_message",
 ]
@@ -383,12 +384,62 @@ class ValueResponseSparse(Message):
         return cls(round_id=r, iteration=i, value=decode_sparse(buf[20 : 20 + n]))
 
 
+@dataclasses.dataclass
+class ValueResponseFusedSparse(Message):
+    """Neighbor -> agent: a whole model-tree correction as ONE fused
+    sparse frame — one ``indices|values`` payload per dtype bucket, flat
+    positions into the ``pytree_codec.TreeSpec`` ravel
+    (:func:`~distributed_learning_tpu.comm.tensor_codec.encode_fused_sparse`).
+    Collapses the per-leaf framing/CRC/header overhead of gossiping a
+    tree leaf by leaf to one frame per round.  ``buckets`` (the
+    ``TreeSpec.dtype_buckets()`` spans) is encode-side only: the frame
+    is self-describing on decode, which returns the densified f32 wire
+    vector."""
+
+    TYPE_CODE: ClassVar[int] = 15
+    round_id: int = 0
+    iteration: int = 0
+    value: Optional[np.ndarray] = None
+    buckets: Optional[Tuple] = None
+    bf16_wire: bool = False
+    int8_wire: bool = False
+
+    def _pack(self) -> bytes:
+        from distributed_learning_tpu.comm.tensor_codec import (
+            encode_fused_sparse,
+        )
+
+        v = self.value if self.value is not None else np.zeros(0, np.float32)
+        buckets = self.buckets
+        if buckets is None:
+            # Degenerate single-bucket framing for spec-less callers.
+            buckets = (("float32", ((0, int(np.asarray(v).size)),)),)
+        t = encode_fused_sparse(
+            np.asarray(v), buckets,
+            bf16_wire=self.bf16_wire, int8_wire=self.int8_wire,
+        )
+        return struct.pack("<qqI", self.round_id, self.iteration, len(t)) + t
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "ValueResponseFusedSparse":
+        from distributed_learning_tpu.comm.tensor_codec import (
+            decode_fused_sparse,
+        )
+
+        r, i, n = struct.unpack_from("<qqI", buf, 0)
+        return cls(
+            round_id=r, iteration=i,
+            value=decode_fused_sparse(buf[20 : 20 + n]),
+        )
+
+
 _REGISTRY: Dict[int, Type[Message]] = {
     cls.TYPE_CODE: cls
     for cls in (
         Register, Ok, ErrorException, NeighborhoodData, NewRoundRequest,
         NewRoundNotification, ValueRequest, ValueResponse, Converged,
         NotConverged, Done, Shutdown, Telemetry, ValueResponseSparse,
+        ValueResponseFusedSparse,
     )
 }
 

@@ -57,6 +57,27 @@ class TreeSpec:
     def total(self) -> int:
         return int(sum(self.sizes))
 
+    def dtype_buckets(self) -> Tuple[Tuple[str, Tuple[Tuple[int, int], ...]], ...]:
+        """Leaf spans of the wire ravel grouped by ORIGINAL storage dtype.
+
+        ``((dtype_name, ((offset, size), ...)), ...)``, dtype names
+        sorted, offsets ascending flat positions into the f32 wire
+        vector.  This is the wire-side twin of
+        ``ops.mixing.FusedLayout.bucket_spans``: the fused sparse frame
+        (``tensor_codec.encode_fused_sparse``) ships one
+        ``indices|values`` payload per bucket, so bf16-origin leaves
+        ride a bf16 value section while f32 leaves keep full precision
+        — per-leaf framing collapses to one frame with per-bucket value
+        encodings."""
+        by_dtype: dict = {}
+        off = 0
+        for dt, size in zip(self.dtypes, self.sizes):
+            by_dtype.setdefault(str(np.dtype(dt)), []).append((off, size))
+            off += size
+        return tuple(
+            (name, tuple(spans)) for name, spans in sorted(by_dtype.items())
+        )
+
 
 def tree_to_flat(tree: Pytree) -> Tuple[np.ndarray, TreeSpec]:
     """Flatten a float pytree into one f32 wire vector plus its spec.

@@ -30,6 +30,8 @@ __all__ = [
     "decode_tensor",
     "encode_sparse",
     "decode_sparse",
+    "encode_fused_sparse",
+    "decode_fused_sparse",
     "top_k_sparse",
     "FLAG_BF16_COMPRESSED",
     "FLAG_INT8_COMPRESSED",
@@ -223,6 +225,135 @@ def decode_sparse(buf: bytes) -> np.ndarray:
     out = np.zeros(count, dtype=vals.dtype)
     out[idx] = vals
     return out.reshape(dims)
+
+
+# --------------------------------------------------------------------- #
+# Fused sparse wire format (one frame per gossip round)                 #
+# --------------------------------------------------------------------- #
+_FUSED_MAGIC = 0xFE
+#: bf16-precision storage dtypes: their value sections always narrow to
+#: bf16 on the wire (that IS their information content).
+_BF16_ORIGIN = ("bfloat16", "float16")
+
+
+def encode_fused_sparse(
+    x: np.ndarray,
+    buckets,
+    *,
+    bf16_wire: bool = False,
+    int8_wire: bool = False,
+) -> bytes:
+    """Serialize a k-sparse wire vector as ONE frame with one
+    ``indices|values`` payload per dtype bucket.
+
+    ``x`` is the dense flat f32 wire vector of a whole model
+    (``pytree_codec.tree_to_flat``); ``buckets`` is
+    ``TreeSpec.dtype_buckets()`` — leaf spans grouped by ORIGINAL
+    storage dtype.  Where per-leaf gossip ships one sparse frame per
+    leaf (leaf_count x framing/CRC/headers per neighbor per round), this
+    format collapses a round's whole correction to one frame: indices
+    are u32 flat positions into the TreeSpec ravel, and each bucket's
+    value section is encoded at that bucket's precision — bf16-origin
+    leaves ride bf16 values regardless of ``bf16_wire``, f32 buckets
+    honor ``bf16_wire``; ``int8_wire`` quantizes every section (the
+    CHOCO error-feedback loop absorbs the noise).
+
+    Layout::
+
+        u8 0xFE | u8 0 | u8 nbuckets | u8 0 | u32 total_dim |
+        per bucket: u32 k | u32 idx[k] | u32 vlen | encode_tensor(vals)
+    """
+    if bf16_wire and int8_wire:
+        raise ValueError("bf16_wire and int8_wire are mutually exclusive")
+    flat = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    if flat.size > _MAX_SPARSE_DENSE_ELEMS:
+        raise ValueError(
+            f"sparse wire limited to {_MAX_SPARSE_DENSE_ELEMS} dense "
+            f"elements, got {flat.size}"
+        )
+    buckets = tuple(buckets)
+    if len(buckets) > 0xFF:
+        raise ValueError(f"{len(buckets)} dtype buckets exceed wire limit 255")
+    covered = 0
+    for _name, spans in buckets:
+        for off, size in spans:
+            if off < 0 or size < 0 or off + size > flat.size:
+                raise ValueError(
+                    f"bucket span ({off}, {size}) outside the wire vector "
+                    f"of {flat.size} elements"
+                )
+            covered += size
+    if covered != flat.size:
+        raise ValueError(
+            f"bucket spans cover {covered} of {flat.size} wire elements — "
+            "buckets must tile the TreeSpec ravel exactly"
+        )
+    out = [struct.pack("<BBBBI", _FUSED_MAGIC, 0, len(buckets), 0, flat.size)]
+    for name, spans in buckets:
+        pos = np.concatenate(
+            [np.arange(off, off + size, dtype=np.uint32)
+             for off, size in spans]
+        ) if spans else np.empty(0, np.uint32)
+        sub = flat[pos]
+        nz = np.flatnonzero(sub)
+        idx = pos[nz]
+        section_bf16 = bf16_wire or name in _BF16_ORIGIN
+        vals = encode_tensor(
+            sub[nz],
+            bf16_wire=section_bf16 and not int8_wire,
+            int8_wire=int8_wire,
+        )
+        out.append(struct.pack("<I", idx.size))
+        out.append(idx.tobytes())
+        out.append(struct.pack("<I", len(vals)))
+        out.append(vals)
+    return b"".join(out)
+
+
+def decode_fused_sparse(buf: bytes) -> np.ndarray:
+    """Inverse of :func:`encode_fused_sparse`; returns the densified flat
+    f32 wire vector (the receiver rebuilds the pytree via its own
+    ``TreeSpec`` — the deployment invariant: same model, same spec)."""
+    if len(buf) < 8:
+        raise ValueError("fused sparse frame too short")
+    magic, _flags, nbuckets, _r, total = struct.unpack_from("<BBBBI", buf, 0)
+    if magic != _FUSED_MAGIC:
+        raise ValueError(f"not a fused sparse frame (magic {magic:#x})")
+    if total > _MAX_SPARSE_DENSE_ELEMS:
+        raise ValueError(
+            f"fused sparse frame densifies to {total} elements "
+            f"(limit {_MAX_SPARSE_DENSE_ELEMS})"
+        )
+    out = np.zeros(total, np.float32)
+    off = 8
+    for _ in range(nbuckets):
+        if len(buf) < off + 4:
+            raise ValueError("fused sparse frame truncated at bucket header")
+        (k,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        if k > total:
+            raise ValueError(
+                f"fused sparse bucket claims {k} entries in {total} slots"
+            )
+        idx_bytes = buf[off : off + 4 * k]
+        if len(idx_bytes) != 4 * k:
+            raise ValueError("fused sparse frame truncated in indices")
+        idx = np.frombuffer(idx_bytes, dtype=np.uint32)
+        off += 4 * k
+        if k and int(idx.max()) >= total:
+            raise ValueError("fused sparse index out of range")
+        if len(buf) < off + 4:
+            raise ValueError("fused sparse frame truncated at value header")
+        (vlen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        vals = decode_tensor(buf[off : off + vlen])
+        off += vlen
+        if vals.shape != (k,):
+            raise ValueError(
+                f"fused sparse value count {vals.shape} != {k}"
+            )
+        out[idx] = vals.astype(np.float32)
+    return out
 
 
 def top_k_sparse(v: "np.ndarray", k: int):

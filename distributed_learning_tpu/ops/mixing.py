@@ -116,6 +116,30 @@ class FusedLayout(NamedTuple):
             n * width * np.dtype(name).itemsize for name, width in self.buckets
         )
 
+    def bucket_width(self, bucket: str) -> int:
+        """Column count P of one dtype bucket."""
+        for name, width in self.buckets:
+            if name == bucket:
+                return width
+        raise KeyError(bucket)
+
+    def bucket_spans(self, bucket: str) -> Tuple[Tuple[int, int], ...]:
+        """``(offset, size)`` leaf spans of one dtype bucket, ascending.
+
+        Offsets are column positions inside the bucket's ``(N, P)``
+        buffer; spans tile ``[0, P)`` exactly (leaves of a bucket are
+        laid out consecutively in tree order).  This is the static
+        segment map fused *compression* selects against
+        (``parallel/compression.py::FusedCompressor``): a per-leaf k
+        budget is a per-span budget over these columns.
+        """
+        spans = tuple(
+            (s.offset, s.size) for s in self.slots if s.bucket == bucket
+        )
+        if not spans:
+            raise KeyError(bucket)
+        return spans
+
 
 def fused_layout(stacked: Pytree) -> FusedLayout:
     """Compute the fused flat-buffer layout of a stacked pytree.

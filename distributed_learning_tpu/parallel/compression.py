@@ -24,34 +24,40 @@ compression error is driven to zero instead of accumulating.
 TPU mapping: the recurrence is two stacked elementwise updates plus one
 mixing product on the estimate stack, so it rides the same fabric as every
 other engine here (dense batched MXU matmuls, or the ppermute matching
-schedule under ``shard_map``).  On-chip the full estimates move through
-the mixing product — the compression *math* is exact, and the wire saving
-is realized where the wire is real: the TCP backend runs the same
-recurrence over sockets (``comm.agent.ConsensusAgent.run_choco_once`` with
-``sparse_wire=True``), shipping each top-k correction as ``k`` values +
-indices (``comm.tensor_codec.encode_sparse``) instead of the dense vector;
-a sparse collective-permute would be the ICI/DCN analogue.
+schedule under ``shard_map``).  With ``fused=True`` (default) the whole
+round — compression included — runs on the fused ``{dtype: (N, P)}``
+flat buffers (:class:`FusedCompressor`): O(dtype-buckets) selection and
+scatter ops per round instead of O(leaves).  On-chip the full estimates
+move through the mixing product — the compression *math* is exact, and
+the wire saving is realized where the wire is real: the TCP backend runs
+the same recurrence over sockets (``comm.agent.ConsensusAgent.
+run_choco_once`` with ``sparse_wire=True``, or ``run_choco_tree`` for a
+whole model pytree as ONE fused sparse frame per round), shipping each
+top-k correction as ``k`` values + indices
+(``comm.tensor_codec.encode_sparse`` / ``encode_fused_sparse``) instead
+of the dense vector; a sparse collective-permute would be the ICI/DCN
+analogue.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributed_learning_tpu.obs import get_registry
 from distributed_learning_tpu.ops import mixing as ops
 from ._spmd import cached_scan, mix_once, residual
 from .consensus import ConsensusEngine
 
 Pytree = Any
-# Compressor: (value, key) -> compressed value of the SAME shape (the wire
-# format is the codec's concern; the engine works with densified values).
-Compressor = Callable[[jax.Array, jax.Array], jax.Array]
 
 __all__ = [
+    "Compressor",
+    "FusedCompressor",
     "top_k",
     "approx_top_k",
     "random_k",
@@ -63,6 +69,58 @@ __all__ = [
     "ChocoState",
     "ChocoGossipEngine",
 ]
+
+
+def _k_of(fraction: float, size: int) -> int:
+    """The per-vector keep count of a top-k/random-k fraction — max(1,
+    round(fraction * size)), the single source for per-leaf, per-bucket,
+    and wire-byte accounting."""
+    return max(1, int(round(fraction * size)))
+
+
+def _sel_mag(v: jax.Array) -> jax.Array:
+    """|v| as a selection key, sub-f32 floats widened to f32: bf16 -> f32
+    is exact and order-preserving, so the selected index set is
+    bit-identical, while CPU ``lax.top_k``/``lax.sort`` on f32 keys run
+    ~13x faster than the emulated bf16 comparators (measured at bench
+    geometry).  Values are never touched — only the comparison keys."""
+    mag = jnp.abs(v)
+    if mag.dtype in (jnp.bfloat16, jnp.float16):
+        mag = mag.astype(jnp.float32)
+    return mag
+
+
+class Compressor:
+    """A delta-contractive compressor: callable ``(value, key) ->
+    compressed value`` of the SAME shape (the wire format is the codec's
+    concern; the engine works with densified values).
+
+    Instances carry their algebraic identity — ``kind`` plus parameters —
+    so the fused engine (:class:`FusedCompressor`) can execute the same
+    math directly on the fused ``(N, P)`` dtype-bucket buffers instead of
+    mapping the callable over leaves.  Any plain ``(value, key)`` callable
+    still satisfies the engine contract (``kind="custom"``: correct, but
+    compressed per leaf view — only the named kinds fuse)."""
+
+    def __init__(
+        self,
+        fn: Callable[[jax.Array, jax.Array], jax.Array],
+        kind: str = "custom",
+        *,
+        fraction: Optional[float] = None,
+        recall_target: Optional[float] = None,
+    ):
+        self._fn = fn
+        self.kind = str(kind)
+        self.fraction = fraction
+        self.recall_target = recall_target
+
+    def __call__(self, v: jax.Array, key: jax.Array) -> jax.Array:
+        return self._fn(v, key)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        arg = "" if self.fraction is None else f":{self.fraction}"
+        return f"Compressor({self.kind}{arg})"
 
 
 def compressor_from_spec(spec: str) -> "Compressor":
@@ -106,12 +164,12 @@ def top_k(fraction: float) -> Compressor:
 
     def compress(v: jax.Array, key: jax.Array) -> jax.Array:
         flat = v.ravel()
-        k = max(1, int(round(fraction * flat.size)))
-        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        k = _k_of(fraction, flat.size)
+        _, idx = jax.lax.top_k(_sel_mag(flat), k)
         out = jnp.zeros_like(flat).at[idx].set(flat[idx])
         return out.reshape(v.shape)
 
-    return compress
+    return Compressor(compress, "top_k", fraction=fraction)
 
 
 def approx_top_k(fraction: float, recall_target: float = 0.95) -> Compressor:
@@ -136,14 +194,17 @@ def approx_top_k(fraction: float, recall_target: float = 0.95) -> Compressor:
 
     def compress(v: jax.Array, key: jax.Array) -> jax.Array:
         flat = v.ravel()
-        k = max(1, int(round(fraction * flat.size)))
+        k = _k_of(fraction, flat.size)
         _, idx = jax.lax.approx_max_k(
-            jnp.abs(flat), k, recall_target=recall_target
+            _sel_mag(flat), k, recall_target=recall_target
         )
         out = jnp.zeros_like(flat).at[idx].set(flat[idx])
         return out.reshape(v.shape)
 
-    return compress
+    return Compressor(
+        compress, "approx_top_k", fraction=fraction,
+        recall_target=recall_target,
+    )
 
 
 def random_k(fraction: float) -> Compressor:
@@ -155,12 +216,12 @@ def random_k(fraction: float) -> Compressor:
 
     def compress(v: jax.Array, key: jax.Array) -> jax.Array:
         flat = v.ravel()
-        k = max(1, int(round(fraction * flat.size)))
+        k = _k_of(fraction, flat.size)
         idx = jax.random.choice(key, flat.size, (k,), replace=False)
         out = jnp.zeros_like(flat).at[idx].set(flat[idx])
         return out.reshape(v.shape)
 
-    return compress
+    return Compressor(compress, "random_k", fraction=fraction)
 
 
 def scaled_sign() -> Compressor:
@@ -172,7 +233,7 @@ def scaled_sign() -> Compressor:
         scale = jnp.sum(jnp.abs(flat)) / flat.size
         return (scale * jnp.sign(flat)).reshape(v.shape)
 
-    return compress
+    return Compressor(compress, "scaled_sign")
 
 
 def int8_quant() -> Compressor:
@@ -201,29 +262,338 @@ def int8_quant() -> Compressor:
         q = jnp.clip(jnp.round(flat / safe), -127, 127)
         return jnp.where(scale > 0, q * safe, 0.0).reshape(v.shape)
 
-    return compress
+    return Compressor(compress, "int8_quant")
 
 
 def identity() -> Compressor:
     """No compression (delta = 1): CHOCO then reduces to plain gossip on
     the estimates — useful as a correctness reference."""
-    return lambda v, key: v
+    return Compressor(lambda v, key: v, "identity")
 
 
 def compressor_delta(
     compress: Compressor, dim: int = 256, trials: int = 50, seed: int = 0
 ) -> float:
     """Empirical contraction factor ``min_v 1 - ||C(v)-v||^2 / ||v||^2``
-    over random gaussian vectors — a measurement aid for picking gamma."""
-    rng = jax.random.key(seed)
-    worst = 1.0
-    for t in range(trials):
-        rng, k1, k2 = jax.random.split(rng, 3)
+    over random gaussian vectors — a measurement aid for picking gamma.
+
+    All ``trials`` run as ONE jitted, vmapped batch with a single host
+    sync at the end; the former per-trial ``float(...)`` loop paid one
+    device round-trip per trial, which is painfully slow over a tunneled
+    TPU backend.  Same statistic, same one-independent-key-per-trial
+    structure."""
+
+    def one(k: jax.Array) -> jax.Array:
+        k1, k2 = jax.random.split(k)
         v = jax.random.normal(k1, (dim,))
         err = v - compress(v, k2)
-        ratio = float(jnp.sum(err * err) / jnp.sum(v * v))
-        worst = min(worst, 1.0 - ratio)
-    return worst
+        return jnp.sum(err * err) / jnp.sum(v * v)
+
+    ratios = jax.jit(
+        lambda key: jax.vmap(one)(jax.random.split(key, trials))
+    )(jax.random.key(seed))
+    return float(1.0 - jnp.max(ratios))
+
+
+# --------------------------------------------------------------------- #
+# Fused whole-buffer compression                                        #
+# --------------------------------------------------------------------- #
+def _keep_columns(buf: jax.Array, idx: jax.Array) -> jax.Array:
+    """Densify per-row selected column indices into a keep-masked copy of
+    ``buf`` — the fused analogue of ``zeros.at[idx].set(flat[idx])``
+    (selected values are exact copies, everything else exact zero)."""
+    rows = buf.shape[0]
+    mask = (
+        jnp.zeros(buf.shape, jnp.bool_)
+        .at[jnp.arange(rows)[:, None], idx]
+        .set(True)
+    )
+    return jnp.where(mask, buf, jnp.zeros_like(buf))
+
+
+class FusedCompressor:
+    """Compression executed directly on the fused ``{dtype: (rows, P)}``
+    flat buffers (:func:`~distributed_learning_tpu.ops.mixing.flatten_stacked`).
+
+    The per-leaf contract maps a :class:`Compressor` over every leaf of
+    the correction — O(leaves) selection sorts, scatters, and RNG splits
+    per agent per round, which dwarf the single fused mixing GEMM they
+    feed on model-shaped states (~100 leaves).  This class runs the SAME
+    math as O(dtype-buckets) whole-buffer programs:
+
+    ``budget="per-leaf"`` preserves today's selection semantics exactly.
+    The top-k family becomes ONE segment-aware selection per bucket
+    (:meth:`_segment_top_k`: a stable three-operand ``lax.sort`` over
+    ``(leaf-segment, -|v|, column)`` plus one scatter — bit-identical
+    values AND index sets to per-leaf ``lax.top_k``, which ties to the
+    lowest index exactly like a stable sort); ``scaled_sign`` /
+    ``int8_quant`` reduce their per-leaf scale over the layout's leaf
+    spans (pure slices of the contiguous buffer — the identical reduce
+    the vmapped per-leaf op performs) and apply ONE elementwise pass per
+    bucket.  ``random_k`` and custom callables keep per-leaf ops through
+    the layout views: their per-(leaf, agent) RNG stream / opaque body
+    IS the contract (``fused=False`` on the engine remains the oracle).
+
+    ``budget="global"`` spends one k-budget across the whole bucket —
+    a single ``lax.top_k``/``approx_max_k`` over the ``(rows, P)``
+    buffer, one scale per bucket, and one RNG key per round for
+    ``random_k`` instead of one per leaf.  Better kept mass at equal
+    bytes than per-leaf budgeting (large leaves donate budget to the
+    coordinates that matter; measure with :func:`compressor_delta` /
+    ``tests/test_compression.py``); requires a named compressor kind.
+
+    ``rows`` is ``N`` in dense mode and 1 inside ``shard_map`` (the
+    per-device shard); pass ``axis_name`` there so RNG-dependent kinds
+    fold the device's agent index into the key — the same key
+    discipline as the per-leaf engine path.
+    """
+
+    _KINDS = (
+        "top_k", "approx_top_k", "random_k", "scaled_sign", "int8_quant",
+        "identity",
+    )
+
+    def __init__(self, base: Compressor, budget: str = "per-leaf"):
+        if budget not in ("per-leaf", "global"):
+            raise ValueError(
+                f"unknown compression budget {budget!r} (want 'per-leaf' "
+                "or 'global')"
+            )
+        self.base = base
+        self.budget = budget
+        self.kind = getattr(base, "kind", "custom")
+        if self.kind not in self._KINDS:
+            self.kind = "custom"
+        if budget == "global" and self.kind == "custom":
+            raise ValueError(
+                "budget='global' needs a named compressor kind "
+                f"({'/'.join(self._KINDS)}); got a custom callable whose "
+                "whole-buffer form is unknowable"
+            )
+
+    # ------------------------------------------------------------------ #
+    def compress(
+        self,
+        buffers: Dict[str, jax.Array],
+        layout: "ops.FusedLayout",
+        key: jax.Array,
+        *,
+        n: int,
+        axis_name: Optional[str] = None,
+    ) -> Dict[str, jax.Array]:
+        """Compress the fused correction buffers (same tree of
+        ``{dtype: (rows, P)}`` arrays back)."""
+        if self.kind == "identity":
+            return dict(buffers)
+        if self.kind == "custom" or (
+            self.kind == "random_k" and self.budget == "per-leaf"
+        ):
+            return self._per_leaf_views(
+                buffers, layout, key, n=n, axis_name=axis_name
+            )
+        return {
+            name: self._bucket(
+                buffers[name], layout, name, key, axis_name=axis_name
+            )
+            for name, _w in layout.buckets
+        }
+
+    def _per_leaf_views(
+        self, buffers, layout, key, *, n: int, axis_name: Optional[str]
+    ) -> Dict[str, jax.Array]:
+        """Exact per-leaf compression through the layout views — the
+        fallback for kinds whose per-leaf semantics cannot fuse (the
+        random-k RNG stream, custom callables).  Key derivation matches
+        the per-leaf engine path bit for bit: one split per leaf in tree
+        order, then one per agent."""
+        tree = ops.unflatten_stacked(buffers, layout)
+        leaves, treedef = jax.tree.flatten(tree)
+        keys = jax.random.split(key, len(leaves))
+        if axis_name is None:
+            comp = [
+                jax.vmap(self.base)(leaf, jax.random.split(k, n))
+                for leaf, k in zip(leaves, keys)
+            ]
+        else:
+            i = jax.lax.axis_index(axis_name)
+            comp = [
+                self.base(leaf[0], jax.random.fold_in(k, i))[None]
+                for leaf, k in zip(leaves, keys)
+            ]
+        out, _ = ops.flatten_stacked(
+            jax.tree.unflatten(treedef, comp), layout
+        )
+        return out
+
+    # ------------------------------------------------------------------ #
+    def _bucket(
+        self, buf, layout, name: str, key, *, axis_name: Optional[str]
+    ) -> jax.Array:
+        P_ = buf.shape[1]
+        if self.kind in ("top_k", "approx_top_k"):
+            if self.budget == "per-leaf":
+                return self._segment_top_k(buf, layout.bucket_spans(name))
+            k = _k_of(self.base.fraction, P_)
+            if self.kind == "top_k":
+                _, idx = jax.lax.top_k(_sel_mag(buf), k)
+            else:
+                _, idx = jax.lax.approx_max_k(
+                    _sel_mag(buf), k, recall_target=self.base.recall_target
+                )
+            return _keep_columns(buf, idx)
+        if self.kind == "random_k":  # global budget (per-leaf is views)
+            k = _k_of(self.base.fraction, P_)
+            if axis_name is None:
+                idx = jax.vmap(
+                    lambda kk: jax.random.choice(kk, P_, (k,), replace=False)
+                )(jax.random.split(key, buf.shape[0]))
+            else:
+                folded = jax.random.fold_in(
+                    key, jax.lax.axis_index(axis_name)
+                )
+                idx = jax.random.choice(folded, P_, (k,), replace=False)[None]
+            return _keep_columns(buf, idx)
+        if self.kind == "scaled_sign":
+            scale = self._scale_cols(
+                buf, layout, name,
+                lambda sl: jnp.sum(jnp.abs(sl), axis=1, keepdims=True)
+                / sl.shape[1],
+            )
+            return scale * jnp.sign(buf)
+        if self.kind == "int8_quant":
+            scale = self._scale_cols(
+                buf, layout, name,
+                lambda sl: jnp.max(jnp.abs(sl), axis=1, keepdims=True)
+                / 127.0,
+            )
+            safe = jnp.where(scale > 0, scale, 1.0)
+            q = jnp.clip(jnp.round(buf / safe), -127, 127)
+            return jnp.where(scale > 0, q * safe, 0.0)
+        raise AssertionError(self.kind)  # pragma: no cover
+
+    def _scale_cols(self, buf, layout, name: str, red) -> jax.Array:
+        """Per-column scale array: the bucket-wide scale (global budget)
+        or each leaf span's scale broadcast over its columns (per-leaf
+        budget; the slice-wise reduce is the identical XLA reduce the
+        vmapped per-leaf op performs, so scales are bit-identical)."""
+        if self.budget == "global":
+            return red(buf)
+        parts = []
+        for off, size in layout.bucket_spans(name):
+            sl = jax.lax.slice_in_dim(buf, off, off + size, axis=1)
+            parts.append(jnp.broadcast_to(red(sl), sl.shape))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+    def _segment_top_k(self, buf, spans) -> jax.Array:
+        """Segment-aware top-k selection over a whole bucket: every leaf
+        span keeps its top ``max(1, round(fraction * size))`` columns by
+        |value| — exactly per-leaf ``lax.top_k`` (magnitude ties at the
+        boundary go to the LOWEST column; NaN counts as above every
+        finite magnitude — ``lax.top_k``'s total order) — in a
+        leaf-count-INDEPENDENT number of device ops.
+
+        Strategy: spans are grouped into power-of-two size classes (so
+        within-class padding wastes < 2x); each class is gathered into an
+        ``(rows, L_class, max_span)`` padded layout through a static
+        index map (padding reads a -inf magnitude sentinel column), runs
+        ONE batched ``lax.top_k`` (``approx_max_k`` for the approx kind —
+        exact on CPU) at the class's max per-leaf k, masks each leaf's
+        surplus ranks with a static boolean, and scatters the surviving
+        global columns into a shared keep mask.  Ops per bucket per
+        round = O(size classes) ≈ 1-4 regardless of leaf count (a
+        uniform-width bucket is exactly one top_k + one scatter);
+        measured ~2.7x faster than the per-leaf top_k chain at bench
+        geometry.  Selected index sets and values are bit-identical to
+        the per-leaf oracle (``tests/test_compression.py``)."""
+        rows, P_ = buf.shape
+        classes: Dict[int, list] = {}
+        for j, (_off, size) in enumerate(spans):
+            classes.setdefault(max(int(size).bit_length(), 1), []).append(j)
+        mag = _sel_mag(buf)
+        mag_ext = jnp.concatenate(
+            [mag, jnp.full((rows, 1), -jnp.inf, mag.dtype)], axis=1
+        )
+        all_cols = []
+        for _cls, members in sorted(classes.items()):
+            sizes = [spans[j][1] for j in members]
+            ks = [_k_of(self.base.fraction, s) for s in sizes]
+            L, maxd, kmax = len(members), max(sizes), max(ks)
+            # Static padded-position -> bucket-column map; P_ is the
+            # sentinel (the extra -inf magnitude column, never selected:
+            # k_i <= size_i).
+            gidx = np.full((L, maxd), P_, np.int32)
+            for i, j in enumerate(members):
+                off, size = spans[j]
+                gidx[i, :size] = np.arange(off, off + size, dtype=np.int32)
+            keep = np.arange(kmax)[None, :] < np.asarray(ks)[:, None]
+            padded = mag_ext[:, jnp.asarray(gidx.ravel())].reshape(
+                rows, L, maxd
+            )
+            if self.kind == "approx_top_k":
+                _, idx = jax.lax.approx_max_k(
+                    padded, kmax, recall_target=self.base.recall_target
+                )
+            else:
+                _, idx = jax.lax.top_k(padded, kmax)
+            cols = jnp.take(
+                jnp.asarray(gidx),
+                idx
+                + (jnp.arange(L, dtype=jnp.int32) * maxd)[None, :, None],
+            )
+            # Surplus ranks (a leaf whose k is below the class max) are
+            # redirected to the sentinel column, sliced away below.
+            cols = jnp.where(jnp.asarray(keep)[None], cols, P_)
+            all_cols.append(cols.reshape(rows, L * kmax))
+        cols = (
+            all_cols[0]
+            if len(all_cols) == 1
+            else jnp.concatenate(all_cols, axis=1)
+        )
+        # ONE boolean scatter (all classes' selections) + one select
+        # builds the densified output: selected values are exact copies
+        # of ``buf``, everything else exact zero.  (A value-scatter
+        # variant — gather the kept values, scatter them into zeros —
+        # measured ~1.5x slower on the CPU harness.)
+        mask = (
+            jnp.zeros((rows, P_ + 1), jnp.bool_)
+            .at[jnp.arange(rows)[:, None], cols]
+            .set(True)
+        )
+        return jnp.where(mask[:, :P_], buf, jnp.zeros_like(buf))
+
+    # ------------------------------------------------------------------ #
+    def wire_bytes_per_round(
+        self, layout: "ops.FusedLayout", n: int
+    ) -> Optional[int]:
+        """Nominal sparse-wire bytes one compressed round ships for ``n``
+        agents — what the TCP fused sparse frame moves (u32 index + one
+        stored-dtype value per kept entry for the k-sparse kinds; 1
+        bit/entry + one scale for scaled_sign; 1 byte/entry + one scale
+        for int8; the dense buffer for identity).  ``None`` for custom
+        callables (their k is unknowable statically).  Feeds the
+        ``consensus.compressed_bytes`` obs counter and the benchmark
+        bytes/round column."""
+        if self.kind == "custom":
+            return None
+        total = 0
+        for name, width in layout.buckets:
+            item = np.dtype(name).itemsize
+            if self.kind in ("top_k", "approx_top_k", "random_k"):
+                if self.budget == "global":
+                    k = _k_of(self.base.fraction, width)
+                else:
+                    k = sum(
+                        _k_of(self.base.fraction, size)
+                        for _off, size in layout.bucket_spans(name)
+                    )
+                total += k * (4 + item)
+            elif self.kind == "scaled_sign":
+                total += (width + 7) // 8 + item
+            elif self.kind == "int8_quant":
+                total += width + 4
+            else:  # identity
+                total += width * item
+        return total * n
 
 
 # --------------------------------------------------------------------- #
@@ -254,13 +624,18 @@ class ChocoGossipEngine:
     fused:
         Carry the scan state on the fused flat-buffer layout
         (``ops.flatten_stacked``): iterates and estimates are raveled
-        ONCE per :meth:`run` call — not per round — and the mixing
-        product on the estimates moves O(dtype-buckets) messages per
-        round instead of O(leaves).  Compression stays per-leaf (top-k
-        fractions are a per-tensor contract): each round views the fused
-        correction through ``unflatten_stacked`` — slices the compiler
-        folds away — so the compressed values are identical to the
-        per-leaf path.  ``fused=False`` is the oracle.
+        ONCE per :meth:`run` call — not per round — the mixing product
+        on the estimates moves O(dtype-buckets) messages per round
+        instead of O(leaves), and the correction is compressed by a
+        :class:`FusedCompressor` directly on the buffers — O(buckets)
+        selection/scatter ops and one RNG split per round.
+        ``fused=False`` is the per-leaf oracle.
+    budget:
+        Compression budget of the fused path: ``"per-leaf"`` (default)
+        keeps each leaf's k/scale/RNG contract exactly (bit-identical
+        compressed values to the oracle); ``"global"`` spends one budget
+        across each whole dtype bucket (better kept mass at equal
+        bytes).  See :class:`FusedCompressor`.
     """
 
     def __init__(
@@ -272,6 +647,7 @@ class ChocoGossipEngine:
         mesh: Optional[Mesh] = None,
         axis_name: str = "agents",
         fused: bool = True,
+        budget: str = "per-leaf",
     ):
         self.engine = ConsensusEngine(
             W, mesh=mesh, axis_name=axis_name, fused=fused
@@ -282,6 +658,13 @@ class ChocoGossipEngine:
         self.compressor = compressor
         self.gamma = float(gamma)
         self.fused = bool(fused)
+        if not fused and budget != "per-leaf":
+            raise ValueError(
+                "budget='global' requires fused=True (the per-leaf "
+                "oracle is, by definition, per-leaf budgeted)"
+            )
+        self.budget = budget
+        self._fused_comp = FusedCompressor(compressor, budget=budget)
         self._jit_run: dict = {}
 
     # ------------------------------------------------------------------ #
@@ -335,15 +718,16 @@ class ChocoGossipEngine:
     ) -> ChocoState:
         """One CHOCO round on the fused carry: ``s.x``/``s.xhat`` are the
         ``{dtype: (N, P)}`` buffer pytrees.  The correction is compressed
-        per ORIGINAL leaf (viewed through the layout — pure slices, no
-        data movement after fusion by XLA); the mixing product, the only
-        cross-agent traffic, runs on the fused estimate buffers."""
+        by the :class:`FusedCompressor` directly on the buffers —
+        O(dtype-buckets) selection/scatter ops per round — and the mixing
+        product, the only cross-agent traffic, runs on the fused estimate
+        buffers."""
         key, sub = jax.random.split(s.key)
         delta = jax.tree.map(lambda a, b: a - b, s.x, s.xhat)
-        q_tree = self._compress_tree(
-            ops.unflatten_stacked(delta, layout), sub
+        q = self._fused_comp.compress(
+            delta, layout, sub, n=self.n,
+            axis_name=None if self.mesh is None else self.axis_name,
         )
-        q, _ = ops.flatten_stacked(q_tree, layout)
         xhat = jax.tree.map(lambda h, qv: h + qv, s.xhat, q)
         mixed_hat = self._mix(xhat, self_w, match_w)
         x = jax.tree.map(
@@ -352,61 +736,90 @@ class ChocoGossipEngine:
         )
         return ChocoState(x=x, xhat=xhat, key=key)
 
+    def _fused_program(self, layout, rounds: int):
+        """Traceable fused-carry program ``state -> (state, trace)``:
+        flatten x/xhat once at program entry, scan ``rounds`` fused
+        steps, unflatten once at exit — the flatten cost is per call (the
+        trainer calls once per epoch), never per round.  Exposed unjitted
+        so the graftlint ``choco_run_fused`` audit entry can pin its
+        collective inventory (``tools/graftlint/jaxpr_audit.py``)."""
+        engine = self.engine
+
+        def scan_fused(s, self_w, match_w):
+            bx, _ = ops.flatten_stacked(s.x, layout)
+            bh, _ = ops.flatten_stacked(s.xhat, layout)
+
+            def body(st, _):
+                st = self._step_fused(st, layout, self_w, match_w)
+                return st, residual(engine, st.x)
+
+            fs, trace = jax.lax.scan(
+                body, ChocoState(bx, bh, s.key), None, length=rounds
+            )
+            return (
+                ChocoState(
+                    x=ops.unflatten_stacked(fs.x, layout),
+                    xhat=ops.unflatten_stacked(fs.xhat, layout),
+                    key=fs.key,
+                ),
+                trace,
+            )
+
+        if engine.mesh is None:
+            return lambda s: scan_fused(s, None, None)
+        spec = P(self.axis_name)
+        st_spec = ChocoState(x=spec, xhat=spec, key=P())
+        inner = jax.shard_map(
+            scan_fused,
+            mesh=engine.mesh,
+            in_specs=(st_spec, spec, P(None, self.axis_name)),
+            out_specs=(st_spec, P()),
+            check_vma=True,
+        )
+        return lambda s: inner(s, engine._self_w, engine._match_w)
+
     def _run_fused(
         self, state: ChocoState, rounds: int
     ) -> Tuple[ChocoState, jax.Array]:
-        """Fused-carry scan: flatten x/xhat once at program entry, scan
-        ``rounds`` fused steps, unflatten once at exit — the flatten cost
-        is per call (the trainer calls once per epoch), never per round."""
         rounds = int(rounds)
         layout = ops.fused_layout(state.x)
         ckey = ("fused", rounds, layout)
         if ckey not in self._jit_run:
-            engine = self.engine
-
-            def scan_fused(s, self_w, match_w):
-                bx, _ = ops.flatten_stacked(s.x, layout)
-                bh, _ = ops.flatten_stacked(s.xhat, layout)
-
-                def body(st, _):
-                    st = self._step_fused(st, layout, self_w, match_w)
-                    return st, residual(engine, st.x)
-
-                fs, trace = jax.lax.scan(
-                    body, ChocoState(bx, bh, s.key), None, length=rounds
-                )
-                return (
-                    ChocoState(
-                        x=ops.unflatten_stacked(fs.x, layout),
-                        xhat=ops.unflatten_stacked(fs.xhat, layout),
-                        key=fs.key,
-                    ),
-                    trace,
-                )
-
-            if engine.mesh is None:
-                fn = jax.jit(lambda s: scan_fused(s, None, None))
-                self._jit_run[ckey] = fn
-            else:
-                spec = P(self.axis_name)
-                st_spec = ChocoState(x=spec, xhat=spec, key=P())
-                inner = jax.jit(
-                    jax.shard_map(
-                        scan_fused,
-                        mesh=engine.mesh,
-                        in_specs=(st_spec, spec, P(None, self.axis_name)),
-                        out_specs=(st_spec, P()),
-                        check_vma=True,
-                    )
-                )
-                self._jit_run[ckey] = lambda s: inner(
-                    s, engine._self_w, engine._match_w
-                )
+            self._jit_run[ckey] = jax.jit(
+                self._fused_program(layout, rounds)
+            )
         return self._jit_run[ckey](state)
+
+    def _note_compression(self, state: ChocoState, rounds: int) -> None:
+        """Compressed-gossip accounting (obs), host-side only: on
+        concrete calls record the nominal sparse-wire bytes the rounds'
+        corrections occupy (``consensus.compressed_bytes``) and the
+        ratio to the dense state volume (``consensus.compression_ratio``
+        gauge).  Tracer inputs and custom compressors (unknowable k) are
+        skipped — never a device sync here, same discipline as
+        ``ConsensusEngine._note_layout``."""
+        leaves = jax.tree.leaves(state.x)
+        if not leaves or any(
+            isinstance(l, jax.core.Tracer) for l in leaves
+        ):
+            return
+        try:
+            layout = ops.fused_layout(state.x)
+        except (ValueError, TypeError):
+            return
+        wire = self._fused_comp.wire_bytes_per_round(layout, self.n)
+        if wire is None:
+            return
+        reg = get_registry()
+        reg.inc("consensus.compressed_bytes", wire * int(rounds))
+        dense = layout.bytes_per_round(self.n)
+        if dense:
+            reg.gauge("consensus.compression_ratio", wire / dense)
 
     def run(self, state: ChocoState, rounds: int) -> Tuple[ChocoState, jax.Array]:
         """``rounds`` CHOCO iterations in one jitted ``lax.scan``; returns
         the final state and the per-round consensus-residual trace."""
+        self._note_compression(state, int(rounds))
         if self.fused:
             return self._run_fused(state, rounds)
         spec = P(self.axis_name)

@@ -88,6 +88,7 @@ class ExperimentConfig:
                         # fall back to 1 with a warning)
     compression: Optional[str] = None  # CHOCO spec: topk:F | atopk:F | randk:F | sign | int8
     compression_gamma: float = 0.2
+    compression_budget: str = "per-leaf"  # fused k budget: per-leaf | global
     # misc
     seed: int = 0
     dropout: bool = True
@@ -272,6 +273,7 @@ class ExperimentConfig:
             superstep=self.superstep,
             compression=self.compression,
             compression_gamma=self.compression_gamma,
+            compression_budget=self.compression_budget,
             mesh=mesh,
             telemetry=telemetry,
             seed=self.seed,

@@ -301,6 +301,7 @@ class GossipTrainer:
         mix_times_schedule: Optional[Callable[[int], int]] = None,
         compression: Any = None,
         compression_gamma: float = 0.2,
+        compression_budget: str = "per-leaf",
         fused_consensus: bool = True,
         superstep: int = 1,
         mesh=None,
@@ -434,6 +435,11 @@ class GossipTrainer:
                 compression = compressor_from_spec(compression)
         self._compression = compression
         self._compression_gamma = float(compression_gamma)
+        # Compression budget of the fused CHOCO path: "per-leaf" keeps
+        # each tensor's k/scale contract (the oracle-identical default),
+        # "global" spends one budget across each fused dtype bucket
+        # (better kept mass at equal bytes; parallel/compression.py).
+        self._compression_budget = str(compression_budget)
         # Epoch superstep (train_epochs): compile K epochs of local SGD +
         # gossip into ONE donated dispatch — start_consensus then runs the
         # schedule in chunks of K.  1 = the per-epoch path.  Configs whose
@@ -484,6 +490,7 @@ class GossipTrainer:
                 gamma=self._compression_gamma,
                 mesh=mesh,
                 fused=self.fused_consensus,
+                budget=self._compression_budget,
             )
         if (
             self.chebyshev

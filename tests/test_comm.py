@@ -107,6 +107,11 @@ def test_protocol_message_roundtrips():
             round_id=7, iteration=3,
             value=np.array([0, 0, 2.5, 0, -1.0, 0], np.float32),
         ),
+        P.ValueResponseFusedSparse(
+            round_id=7, iteration=3,
+            value=np.array([0, 0, 2.5, 0, -1.0, 0], np.float32),
+            buckets=(("float32", ((0, 4),)), ("bfloat16", ((4, 2),))),
+        ),
         P.Converged(round_id=7, iteration=3),
         P.NotConverged(round_id=7, iteration=3),
         P.Done(round_id=7),
@@ -124,7 +129,9 @@ def test_protocol_message_roundtrips():
         for f, v in vars(msg).items():
             if isinstance(v, np.ndarray):
                 np.testing.assert_array_equal(getattr(out, f), v)
-            elif f != "bf16_wire":  # wire-only hint, not a field
+            elif f not in ("bf16_wire", "buckets"):
+                # wire-only encode hints (narrowing flags, bucket spans),
+                # not round-tripped fields
                 assert getattr(out, f) == v, (msg, f)
 
 
@@ -588,6 +595,210 @@ def test_native_int8_matches_fallback_bit_exact(monkeypatch):
         native.i8_to_f32(q_native, scale),
         q_native.astype(np.float32) * np.float32(scale),
     )
+
+
+# ---------------------------------------------------------------------- #
+# Fused sparse wire (one frame per round)                                #
+# ---------------------------------------------------------------------- #
+def test_fused_sparse_codec_roundtrip_and_bucket_precision():
+    """The fused frame round-trips a k-sparse TreeSpec ravel through one
+    frame with per-dtype-bucket value sections: f32 buckets exact (or
+    bf16-narrowed under bf16_wire), bf16-origin buckets always bf16 —
+    which is LOSSLESS for values that came from bf16 leaves."""
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.comm.pytree_codec import tree_to_flat
+    from distributed_learning_tpu.comm.tensor_codec import (
+        decode_fused_sparse,
+        encode_fused_sparse,
+        encode_sparse,
+    )
+
+    rng = np.random.default_rng(0)
+    tree = {
+        "w": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+        "h": jnp.asarray(rng.normal(size=(40,)), jnp.bfloat16),
+        "b": jnp.asarray(rng.normal(size=(24,)), jnp.float32),
+    }
+    flat, spec = tree_to_flat(tree)
+    buckets = spec.dtype_buckets()
+    assert [name for name, _ in buckets] == ["bfloat16", "float32"]
+    # Sparsify: keep ~10% of entries.
+    q = np.asarray(flat)
+    mask = rng.random(q.size) < 0.9
+    q = np.where(mask, 0.0, q).astype(np.float32)
+
+    out = decode_fused_sparse(encode_fused_sparse(q, buckets))
+    # Exact everywhere: f32 sections are exact by construction, and the
+    # bf16 section's values are f32-widened bf16 originals.
+    np.testing.assert_array_equal(out, q)
+
+    # One frame beats per-leaf sparse frames on bytes (3 leaves here).
+    fused_bytes = len(encode_fused_sparse(q, buckets))
+    per_leaf_bytes = 0
+    off = 0
+    for size in spec.sizes:
+        per_leaf_bytes += len(encode_sparse(q[off : off + size]))
+        off += size
+    assert fused_bytes < per_leaf_bytes
+
+    # bf16_wire narrows the f32 sections too.
+    nb = decode_fused_sparse(
+        encode_fused_sparse(q, buckets, bf16_wire=True)
+    )
+    nz = q != 0
+    np.testing.assert_allclose(nb[nz], q[nz], rtol=1e-2)
+    assert (nb[~nz] == 0).all()
+
+
+def test_fused_sparse_codec_rejects_corrupt_and_hostile_frames():
+    import struct
+
+    from distributed_learning_tpu.comm.tensor_codec import (
+        decode_fused_sparse,
+        encode_fused_sparse,
+        encode_tensor,
+    )
+
+    buckets = (("float32", ((0, 8),)),)
+    good = encode_fused_sparse(
+        np.asarray([1, 0, 0, 2, 0, 0, 0, 3], np.float32), buckets
+    )
+    np.testing.assert_array_equal(
+        decode_fused_sparse(good),
+        np.asarray([1, 0, 0, 2, 0, 0, 0, 3], np.float32),
+    )
+    with pytest.raises(ValueError, match="magic"):
+        decode_fused_sparse(encode_tensor(np.zeros(3, np.float32)))
+    with pytest.raises(ValueError):
+        decode_fused_sparse(good[: len(good) - 3])  # truncated values
+    # Hostile: huge claimed total must be rejected before densification.
+    huge = struct.pack("<BBBBI", 0xFE, 0, 1, 0, 1 << 31)
+    with pytest.raises(ValueError, match="densifies"):
+        decode_fused_sparse(huge + struct.pack("<I", 0))
+    # Out-of-range index.
+    bad = bytearray(good)
+    bad[12:16] = (10 ** 6).to_bytes(4, "little")  # first index u32
+    with pytest.raises(ValueError, match="range"):
+        decode_fused_sparse(bytes(bad))
+    # Encode-side: spans must tile the vector.
+    with pytest.raises(ValueError, match="tile"):
+        encode_fused_sparse(
+            np.zeros(8, np.float32), (("float32", ((0, 4),)),)
+        )
+
+
+def _mk_tree(seed):
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(r.normal(size=(8, 4)), jnp.float32),
+        "h": jnp.asarray(r.normal(size=(6,)), jnp.bfloat16),
+        "b": jnp.asarray(r.normal(size=(3,)), jnp.float32),
+    }
+
+
+def test_tcp_choco_tree_fused_halves_frames_and_converges():
+    """The wire-level acceptance: gossiping a whole model tree per round
+    via run_choco_tree ships ONE fused sparse frame per neighbor per
+    round (fused=True) instead of one frame per leaf (fused=False, the
+    per-leaf baseline) — >= 2x fewer data-plane frames on this 3-leaf
+    tree (leaf_count x fewer in general) — while both modes reach exact
+    consensus at the initial mean, and the master's control-plane
+    framing is untouched by the data-plane change."""
+    from distributed_learning_tpu.comm import top_k_compressor
+    from distributed_learning_tpu.comm.pytree_codec import tree_to_flat
+
+    comp = top_k_compressor(0.5)
+    results = {}
+
+    async def run(fused):
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3"), ("3", "1")], ["1", "2", "3"],
+            sparse_wire=True,
+        )
+        trees = [_mk_tree(i) for i in range(3)]
+        flats = [tree_to_flat(t)[0] for t in trees]
+        mean = np.mean(flats, axis=0)
+        base = {a.token: dict(a.wire_stats()) for a in agents}
+        rounds = 40
+        xs = list(trees)
+        for _ in range(rounds):
+            xs = list(await asyncio.gather(
+                *(a.run_choco_tree(xs[i], comp, gamma=0.4, fused=fused)
+                  for i, a in enumerate(agents))
+            ))
+        for t in xs:
+            got = tree_to_flat(t)[0]
+            np.testing.assert_allclose(got, mean, atol=2e-2)
+        frames = sum(
+            a.wire_stats()["frames_sent"] - base[a.token]["frames_sent"]
+            for a in agents
+        ) / rounds
+        counters = {
+            k: agents[0].counters.get(k, 0)
+            for k in ("sparse_frames", "fused_frames", "dense_frames",
+                      "choco_tree_rounds", "choco_tree_leaf_rounds")
+        }
+        mstats = master.wire_stats()
+        await _teardown(master, agents)
+        return frames, counters, mstats
+
+    async def main():
+        results[True] = await run(True)
+        results[False] = await run(False)
+
+    asyncio.run(asyncio.wait_for(main(), 240))
+    frames_fused, c_fused, m_fused = results[True]
+    frames_perleaf, c_perleaf, m_perleaf = results[False]
+    # >= 2x fewer wire frames per round (3 leaves -> expect ~3x).
+    assert frames_fused * 2 <= frames_perleaf, (frames_fused, frames_perleaf)
+    # Fused rounds ship fused frames; the per-leaf baseline never does.
+    assert c_fused["fused_frames"] > 0 and c_fused["choco_tree_rounds"] == 40
+    assert c_perleaf["fused_frames"] == 0
+    assert c_perleaf["choco_tree_leaf_rounds"] == 40 * 3
+    assert c_perleaf["sparse_frames"] > 0
+    # Control plane (master) untouched by the data-plane framing change.
+    assert m_fused["frames_sent"] == m_perleaf["frames_sent"]
+
+
+def test_tcp_choco_tree_global_budget_and_spec_guard():
+    """budget='global' spends one k across the whole ravel and still
+    converges (error feedback); changing the tree structure mid-stream
+    is rejected loudly."""
+    from distributed_learning_tpu.comm import top_k_compressor
+
+    comp = top_k_compressor(0.4)
+
+    async def main():
+        master, agents = await _deploy(
+            [("1", "2"), ("2", "3"), ("3", "1")], ["1", "2", "3"],
+            sparse_wire=True,
+        )
+        trees = [_mk_tree(10 + i) for i in range(3)]
+        from distributed_learning_tpu.comm.pytree_codec import tree_to_flat
+
+        mean = np.mean([tree_to_flat(t)[0] for t in trees], axis=0)
+        xs = list(trees)
+        for _ in range(50):
+            xs = list(await asyncio.gather(
+                *(a.run_choco_tree(xs[i], comp, gamma=0.4, budget="global")
+                  for i, a in enumerate(agents))
+            ))
+        for t in xs:
+            np.testing.assert_allclose(tree_to_flat(t)[0], mean, atol=3e-2)
+        with pytest.raises(ValueError, match="structure"):
+            await agents[0].run_choco_tree(
+                {"other": np.ones(4, np.float32)}, comp
+            )
+        with pytest.raises(ValueError, match="budget"):
+            await agents[0].run_choco_tree(
+                xs[0], comp, budget="per-bucket"
+            )
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 240))
 
 
 def test_tcp_choco_converges_with_int8_wire():

@@ -11,13 +11,16 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from distributed_learning_tpu.ops import mixing as mixing_ops
 from distributed_learning_tpu.parallel import Topology
 from distributed_learning_tpu.parallel.compression import (
     ChocoGossipEngine,
+    FusedCompressor,
     approx_top_k,
     compressor_delta,
     compressor_from_spec,
     identity,
+    int8_quant,
     random_k,
     scaled_sign,
     top_k,
@@ -177,6 +180,248 @@ def test_int8_compressor_contracts_and_choco_converges():
         np.asarray(state.x), np.tile(mean, (4, 1)), atol=1e-3
     )
     assert float(res[-1]) < 1e-3
+
+
+# --------------------------------------------------------------------- #
+# Fused whole-buffer compression (ISSUE 5 tentpole)                     #
+# --------------------------------------------------------------------- #
+def _mixed_tree(seed=0):
+    """Mixed bf16+f32, multi-shape, scalar-leaf stacked tree."""
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(N, 16)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(N, 3)), jnp.float32),
+        "h": jnp.asarray(rng.normal(size=(N, 5)), jnp.bfloat16),
+        "g": jnp.asarray(rng.normal(size=(N, 7)), jnp.bfloat16),
+        "s": jnp.asarray(rng.normal(size=(N,)), jnp.float32),
+        "m": jnp.asarray(rng.normal(size=(N, 2, 4)), jnp.float32),
+    }
+
+
+def _per_leaf_reference(comp, tree, key, n):
+    """The exact per-leaf compression the engine's ``fused=False`` path
+    performs (``ChocoGossipEngine._compress_tree``, dense mode)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree.unflatten(
+        treedef,
+        [
+            jax.vmap(comp)(leaf, jax.random.split(k, n))
+            for leaf, k in zip(leaves, keys)
+        ],
+    )
+
+
+@pytest.mark.parametrize(
+    "comp",
+    [top_k(0.3), approx_top_k(0.3), random_k(0.25), scaled_sign(),
+     int8_quant(), identity()],
+    ids=["top_k", "approx_top_k", "random_k", "scaled_sign", "int8",
+         "identity"],
+)
+def test_fused_per_leaf_budget_bit_identical(comp):
+    """The acceptance oracle: budget='per-leaf' fused compression is
+    BIT-identical to the per-leaf path — values AND selected index sets
+    (array_equal covers both: a different index set would put a nonzero
+    where the oracle has a zero) — on a mixed bf16+f32 tree, for every
+    shipped compressor kind.  For random_k this pins the per-(leaf,
+    agent) RNG stream; for the top-k family the segment-aware selection
+    (ties to the lowest index)."""
+    x = _mixed_tree()
+    layout = mixing_ops.fused_layout(x)
+    buffers, _ = mixing_ops.flatten_stacked(x, layout)
+    key = jax.random.key(7)
+    fused = mixing_ops.unflatten_stacked(
+        FusedCompressor(comp, budget="per-leaf").compress(
+            buffers, layout, key, n=N
+        ),
+        layout,
+    )
+    want = _per_leaf_reference(comp, x, key, N)
+    for (ka, a), (kb, b) in zip(
+        sorted(fused.items()), sorted(want.items())
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), ka
+
+
+def test_fused_segment_top_k_keeps_nan_and_ties_like_lax_top_k():
+    """NaN counts as above every finite magnitude and boundary ties go
+    to the lowest index — the lax.top_k total order, preserved by the
+    fused segment selection."""
+    x = {"a": jnp.asarray(
+        [[1.0, np.nan, 3.0, 0.5, 2.0, 0.1, -2.0, 0.0]], jnp.float32
+    )}
+    layout = mixing_ops.fused_layout(x)
+    buffers, _ = mixing_ops.flatten_stacked(x, layout)
+    got = mixing_ops.unflatten_stacked(
+        FusedCompressor(top_k(0.5)).compress(
+            buffers, layout, jax.random.key(0), n=1
+        ),
+        layout,
+    )["a"]
+    want = _per_leaf_reference(top_k(0.5), x, jax.random.key(0), 1)["a"]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert np.isnan(np.asarray(got)[0, 1])  # the NaN was kept, loudly
+
+
+def test_fused_compressor_rejects_bad_configs():
+    with pytest.raises(ValueError, match="budget"):
+        FusedCompressor(top_k(0.1), budget="per-tensor")
+    with pytest.raises(ValueError, match="named compressor"):
+        FusedCompressor(lambda v, k: v, budget="global")
+    with pytest.raises(ValueError, match="fused=True"):
+        ChocoGossipEngine(
+            Topology.ring(N).metropolis_weights(), top_k(0.1),
+            fused=False, budget="global",
+        )
+
+
+def test_fused_custom_callable_falls_back_to_per_leaf_views():
+    """An arbitrary (value, key) callable still works through the fused
+    interface — compressed per leaf view, exact per-leaf semantics."""
+    x = _mixed_tree(3)
+    layout = mixing_ops.fused_layout(x)
+    buffers, _ = mixing_ops.flatten_stacked(x, layout)
+    key = jax.random.key(5)
+    halve = lambda v, k: 0.5 * v  # noqa: E731 - deliberately a bare lambda
+    fc = FusedCompressor(halve)
+    assert fc.kind == "custom"
+    assert fc.wire_bytes_per_round(layout, N) is None
+    got = mixing_ops.unflatten_stacked(
+        fc.compress(buffers, layout, key, n=N), layout
+    )
+    want = _per_leaf_reference(halve, x, key, N)
+    for k in got:
+        np.testing.assert_array_equal(
+            np.asarray(got[k], np.float32), np.asarray(want[k], np.float32)
+        )
+
+
+def test_global_budget_keeps_more_mass_at_fewer_bytes():
+    """budget='global' spends one k across the bucket: at the same
+    fraction it ships no more bytes (rounding aside) and keeps at least
+    the per-leaf-budget L2 mass on heterogeneous-magnitude states (big
+    leaves donate budget to the coordinates that matter)."""
+    rng = np.random.default_rng(2)
+    # One loud leaf, many quiet ones: per-leaf budget wastes k on noise.
+    x = {"loud": jnp.asarray(10.0 * rng.normal(size=(1, 64)), jnp.float32)}
+    x.update({
+        f"quiet{i}": jnp.asarray(
+            0.01 * rng.normal(size=(1, 8)), jnp.float32
+        )
+        for i in range(8)
+    })
+    layout = mixing_ops.fused_layout(x)
+    buffers, _ = mixing_ops.flatten_stacked(x, layout)
+    key = jax.random.key(0)
+    comp = top_k(0.25)
+    kept = {}
+    for budget in ("per-leaf", "global"):
+        fc = FusedCompressor(comp, budget=budget)
+        out = fc.compress(buffers, layout, key, n=1)
+        kept[budget] = sum(
+            float(jnp.sum(jnp.square(b.astype(jnp.float32))))
+            for b in out.values()
+        )
+        assert fc.wire_bytes_per_round(layout, 1) > 0
+    assert kept["global"] >= kept["per-leaf"]
+    assert (
+        FusedCompressor(comp, budget="global").wire_bytes_per_round(layout, 1)
+        <= FusedCompressor(comp, budget="per-leaf").wire_bytes_per_round(
+            layout, 1
+        )
+    )
+
+
+def test_choco_global_budget_converges():
+    """The whole-buffer budget is still a delta-contractive compressor:
+    CHOCO reaches exact consensus through it."""
+    W = Topology.ring(N).metropolis_weights()
+    eng = ChocoGossipEngine(W, top_k(0.1), gamma=0.3, budget="global")
+    x0 = _x0()
+    state, res = eng.run(eng.init(x0), 400)
+    mean = np.asarray(x0).mean(axis=0)
+    np.testing.assert_allclose(
+        np.asarray(state.x), np.tile(mean, (N, 1)), atol=1e-3
+    )
+    assert float(res[-1]) < 1e-3
+
+
+def test_compressed_bytes_counter_and_ratio_gauge():
+    """Obs satellite: a concrete fused run books the nominal sparse-wire
+    bytes of its rounds and a compression-ratio gauge — host-side only."""
+    from distributed_learning_tpu.obs import MetricsRegistry, use_registry
+
+    reg = MetricsRegistry()
+    W = Topology.ring(N).metropolis_weights()
+    x = _mixed_tree(4)
+    layout = mixing_ops.fused_layout(x)
+    eng = ChocoGossipEngine(W, top_k(0.25), gamma=0.2)
+    wire = FusedCompressor(top_k(0.25)).wire_bytes_per_round(layout, N)
+    with use_registry(reg):
+        eng.run(eng.init(x), 5)
+    snap = reg.snapshot()
+    assert snap["counters"]["consensus.compressed_bytes"] == wire * 5
+    ratio = snap["gauges"]["consensus.compression_ratio"]
+    assert 0 < ratio < 1
+    assert ratio == pytest.approx(wire / layout.bytes_per_round(N))
+
+
+def test_compressor_delta_single_sync_matches_loop_reference():
+    """The vectorized compressor_delta (one jitted batch, one sync) is
+    deterministic and agrees with a hand-rolled per-trial loop over the
+    same split(key, trials) streams."""
+    comp = top_k(0.25)
+    got = compressor_delta(comp, dim=64, trials=16, seed=3)
+    assert got == compressor_delta(comp, dim=64, trials=16, seed=3)
+    worst = 1.0
+    for k in jax.random.split(jax.random.key(3), 16):
+        k1, k2 = jax.random.split(k)
+        v = jax.random.normal(k1, (64,))
+        err = v - comp(v, k2)
+        worst = min(
+            worst,
+            1.0 - float(jnp.sum(err * err) / jnp.sum(v * v)),
+        )
+    assert got == pytest.approx(worst, rel=1e-6)
+    assert 0.0 < got <= 1.0
+
+
+def test_host_and_device_top_k_selection_agree():
+    """Cross-path consistency (ISSUE 5 satellite): the host-side wire
+    selection (``tensor_codec.top_k_sparse``) and the device compressor
+    (``compression.top_k``) pick the SAME entries — ties to the lowest
+    index, NaN kept — so the TCP sparse wire and the on-device CHOCO
+    engine cannot silently diverge."""
+    from distributed_learning_tpu.comm.tensor_codec import top_k_sparse
+
+    rng = np.random.default_rng(9)
+    cases = [
+        rng.normal(size=100).astype(np.float32),
+        np.repeat([2.0, -2.0, 1.0, 2.0], 5).astype(np.float32),  # ties
+    ]
+    nan_case = rng.normal(size=50).astype(np.float32)
+    nan_case[7] = np.nan
+    for v in cases:
+        k = 10
+        dev = np.asarray(
+            top_k(k / v.size)(jnp.asarray(v), jax.random.key(0))
+        )
+        idx_host, vals_host = top_k_sparse(v, k)
+        dev_idx = np.flatnonzero(dev)
+        np.testing.assert_array_equal(dev_idx, idx_host)
+        np.testing.assert_array_equal(dev[dev_idx], vals_host)
+    # NaN: both selection paths keep the poisoned coordinate, loudly.
+    k = 5
+    dev = np.asarray(
+        top_k(k / nan_case.size)(jnp.asarray(nan_case), jax.random.key(0))
+    )
+    idx_host, _ = top_k_sparse(nan_case, k)
+    assert 7 in idx_host and np.isnan(dev[7])
+    dev_sel = set(np.flatnonzero(dev != 0)) | {
+        i for i in range(dev.size) if np.isnan(dev[i])
+    }
+    assert dev_sel == set(int(i) for i in idx_host)
 
 
 def test_choco_fused_carry_matches_perleaf_oracle():

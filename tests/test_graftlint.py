@@ -571,6 +571,48 @@ def test_fused_mix_until_sharded_one_ppermute_per_matching():
     assert perleaf[("ppermute", ("agents",))] == matchings * 12
 
 
+def test_fused_choco_selection_is_per_bucket_not_per_leaf():
+    """The ISSUE 5 jaxpr proof (dense route — runs on any jax): a
+    compressed gossip round on the fused carry executes O(dtype-buckets)
+    selection + scatter ops — exactly ONE top_k and ONE selection
+    scatter per bucket on this uniform-span tree (one size class per
+    bucket) — where the per-leaf oracle pays one of each PER LEAF.  The
+    counts come from the scan body, so they are per ROUND."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.compression import (
+        ChocoGossipEngine,
+        top_k,
+    )
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    leaves, n = 12, 8
+    x = {
+        f"l{i:02d}": jnp.ones(
+            (n, 16), jnp.bfloat16 if i % 2 else jnp.float32
+        )
+        for i in range(leaves)
+    }
+    W = Topology.ring(n).metropolis_weights()
+
+    def counts(fused):
+        eng = ChocoGossipEngine(W, top_k(0.25), fused=fused)
+        jx = jax.make_jaxpr(lambda s: eng.run(s, 3)[0].x)(eng.init(x))
+        return {
+            "top_k": _count_primitive(jx.jaxpr, "top_k"),
+            "scatter": _count_primitive(jx.jaxpr, "scatter"),
+        }
+
+    buckets = 2
+    fused = counts(True)
+    assert fused["top_k"] == buckets, fused
+    assert fused["scatter"] == buckets, fused
+    perleaf = counts(False)
+    assert perleaf["top_k"] == leaves, perleaf
+    assert perleaf["scatter"] == leaves, perleaf
+
+
 def _count_weighted_gossip_gemms(jaxpr, n: int, *, mult: int = 1) -> int:
     """Executed-count of gossip GEMMs — ``dot_general`` equations whose
     lhs is the (n, n) mixing matrix — descending into sub-jaxprs with

@@ -751,6 +751,68 @@ def test_choco_compressed_mixing_trains_and_converges():
     assert choco._choco_xhat is not None
 
 
+def test_choco_fused_matches_perleaf_through_trainer_donate_on_off():
+    """ISSUE 5 acceptance: CHOCO training with the fused whole-buffer
+    compressor (fused_consensus=True, budget='per-leaf') tracks the
+    per-leaf oracle (fused_consensus=False) at GEMM-accumulation
+    tolerance — compressed values are bit-identical, only the mixing
+    product's accumulation order differs — under donate_state on AND off
+    (donation is inert on CPU but the config path must not perturb the
+    carry)."""
+    from distributed_learning_tpu.models import ANNModel
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    rng = np.random.default_rng(1)
+    n, d = 4, 6
+    train = {
+        i: (
+            rng.normal(size=(32, d)).astype(np.float32),
+            rng.integers(0, 3, size=(32,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+    kw = dict(
+        node_names=list(range(n)),
+        model=ANNModel(hidden_dim=8, output_dim=3),
+        optimizer="sgd",
+        learning_rate=0.05,
+        error="cross_entropy",
+        weights=Topology.ring(n),
+        train_data=train,
+        batch_size=16,
+        epoch=2,
+        dropout=False,
+        seed=0,
+        mix_times=3,
+        compression="topk:0.3",
+        compression_gamma=0.3,
+    )
+    for donate in (True, False):
+        runs = {}
+        for fused in (True, False):
+            tr = GossipTrainer(
+                fused_consensus=fused, donate_state=donate, **kw
+            )
+            tr.initialize_nodes()
+            for _ in range(3):
+                tr.train_epoch()
+            runs[fused] = (tr.state[0], tr._choco_xhat)
+        for a, b in zip(
+            jax.tree.leaves(runs[True][0]), jax.tree.leaves(runs[False][0])
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float64), np.asarray(b, np.float64),
+                rtol=2e-5, atol=2e-6, err_msg=f"donate={donate}",
+            )
+        for a, b in zip(
+            jax.tree.leaves(runs[True][1]), jax.tree.leaves(runs[False][1])
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float64), np.asarray(b, np.float64),
+                rtol=2e-5, atol=2e-6, err_msg=f"donate={donate} xhat",
+            )
+
+
 def test_choco_exclusive_with_other_mixing_modes():
     from distributed_learning_tpu.models import ANNModel
     from distributed_learning_tpu.parallel.topology import Topology

@@ -319,6 +319,51 @@ def _gossip_superstep() -> Counter:
     return collect_collectives(jx.jaxpr)
 
 
+@entry("choco_run_fused", kind="jaxpr", requires=("shard_map",))
+def _choco_run_fused() -> Counter:
+    """A compressed (CHOCO) gossip round on the fused carry, sharded over
+    a ring(8) agent mesh, on a FOUR-leaf two-dtype-bucket state.
+
+    This is the fused-compression pin: with the correction compressed by
+    the FusedCompressor directly on the ``{dtype: (1, P)}`` buffers, the
+    scan body moves one ppermute per matching per dtype bucket (2
+    matchings x 2 buckets = 4) and the residual is one pmean (psum) per
+    bucket plus the pmax — independent of the leaf count.  The per-leaf
+    compression path cannot change these counts (compression is local),
+    but a regression that re-expands the CARRY to per-leaf (the state the
+    compressor hands to mixing) would scale the ppermutes with the 4
+    leaves (8) — pin drift means the fused compressed round silently
+    stopped engaging.  The selection-op side (one top-k-family sort +
+    one scatter per bucket, x leaf_count for the per-leaf oracle) is
+    pinned by the dense jaxpr proof in ``tests/test_graftlint.py``,
+    which runs on any jax.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.compression import (
+        ChocoGossipEngine,
+        top_k,
+    )
+    from distributed_learning_tpu.ops import mixing as mixing_ops
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    mesh = _mesh((8,), ("agents",))
+    eng = ChocoGossipEngine(
+        Topology.ring(8).metropolis_weights(), top_k(0.25), mesh=mesh
+    )
+    x = {
+        "w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        "b": jnp.ones((8, 2), jnp.float32),
+        "s": jnp.zeros((8,), jnp.float32),
+        "h": jnp.ones((8, 3), jnp.bfloat16),
+    }
+    st = eng.init(x)
+    layout = mixing_ops.fused_layout(st.x)
+    jx = jax.make_jaxpr(eng._fused_program(layout, rounds=2))(st)
+    return collect_collectives(jx.jaxpr)
+
+
 def load_expected(path: str = EXPECTED_PATH) -> Dict[str, dict]:
     with open(path, "r", encoding="utf-8") as fh:
         return json.load(fh)
