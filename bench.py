@@ -71,6 +71,27 @@ def _phase_payload() -> dict:
     }
 
 
+def _obs_payload() -> dict:
+    """Telemetry-plane summary INSIDE the one JSON record (stdout
+    contract: fields ride the record, never extra lines): the obs.delta
+    schema version this build speaks, the default registry's nonzero
+    counter totals, and the ring-eviction picture — so the driver log
+    shows what a run observed, not just what it measured."""
+    from distributed_learning_tpu.obs import OBS_PAYLOAD_VERSION, get_registry
+
+    snap = get_registry().snapshot()
+    return {
+        "schema": OBS_PAYLOAD_VERSION,
+        "counters": {
+            name: round(total, 3)
+            for name, total in sorted(snap["counters"].items())
+            if total
+        },
+        "events": sum(snap["series"].values()),
+        "dropped": snap["dropped"],
+    }
+
+
 def build_epoch(model, tx, engine, n_agents, *, unroll=None, remat=None,
                 mix=True, pregather=False, superstep=1):
     """One jitted, donated epoch: scan of vmapped train steps + one gossip
@@ -572,6 +593,7 @@ def main():
                 "superstep": 1,
                 "consensus": dict(_LAYOUT_INFO),
                 "phases": _phase_payload(),
+                "obs": _obs_payload(),
             })
             import sys
             print(
@@ -665,6 +687,7 @@ def main():
             "consensus": dict(_LAYOUT_INFO),
         }
     result["phases"] = _phase_payload()
+    result["obs"] = _obs_payload()
     # Bank the completed headline FIRST (one dict, one schema): a
     # deadline that fires anywhere past this line emits THIS
     # measurement, never the inferior provisional record.  Then stand

@@ -14,9 +14,15 @@ reproducibility (``--config``/``--dump-config``).
 Subcommands (dispatched before the trainer flag surface):
 
     python -m distributed_learning_tpu.cli obs-report <run.jsonl>
+    python -m distributed_learning_tpu.cli obs-report --merge <a.jsonl> <b.jsonl>
+    python -m distributed_learning_tpu.cli obs-report --bench BENCH_r*.json
+    python -m distributed_learning_tpu.cli obs-monitor <aggregate.jsonl>
 
-summarizes a JSONL observability event log (``docs/observability.md``)
-without importing jax or touching any device.
+summarize JSONL observability event logs — single-process, merged
+run-wide (per-agent labels + straggler profile), or the driver's bench
+trajectory — and tail the run-wide aggregate live
+(``docs/observability.md``), all without importing jax or touching any
+device.
 """
 
 from __future__ import annotations
@@ -190,10 +196,15 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "obs-report":
-        # jax-free path: replay + summarize an obs JSONL event log.
+        # jax-free path: replay + summarize obs JSONL event logs.
         from distributed_learning_tpu.obs.report import obs_report_main
 
         return obs_report_main(argv[1:])
+    if argv and argv[0] == "obs-monitor":
+        # jax-free path: tail the run-wide aggregate stream live.
+        from distributed_learning_tpu.obs.report import obs_monitor_main
+
+        return obs_monitor_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.dump_config:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import enum
 import logging
+import time
 from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 import numpy as np
@@ -32,7 +33,11 @@ import numpy as np
 from distributed_learning_tpu.comm.framing import FramedStream, open_framed_connection
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
 from distributed_learning_tpu.comm import protocol as P
-from distributed_learning_tpu.obs import get_registry
+from distributed_learning_tpu.obs import (
+    MetricsRegistry,
+    ObsDeltaSource,
+    get_registry,
+)
 
 __all__ = [
     "ConsensusAgent",
@@ -87,6 +92,7 @@ class ConsensusAgent:
         sparse_wire: bool = False,
         rejoin: bool = False,
         debug: bool = False,
+        obs: Optional[MetricsRegistry] = None,
     ):
         if bf16_wire and int8_wire:
             raise ValueError("bf16_wire and int8_wire are mutually exclusive")
@@ -185,6 +191,21 @@ class ConsensusAgent:
 
             enable_debug_logging()
         self.counters: Dict[str, float] = {}
+        # Run-wide plane (docs/observability.md §Run-wide plane): an
+        # optional PER-AGENT registry.  With several agents in one
+        # process (tests, simulators) the process-wide default registry
+        # mixes their streams; `obs=` keeps this agent's metrics
+        # separable so its deltas attribute cleanly at the master.
+        self._obs = obs
+        # Eager bind for a dedicated registry: its event stream is this
+        # agent's by construction, so deltas should cover it from the
+        # first event (the default registry binds lazily — a process
+        # may host several agents and non-comm producers).
+        self._obs_source: Optional[ObsDeltaSource] = (
+            ObsDeltaSource(obs) if obs is not None else None
+        )
+        self._obs_task: Optional[asyncio.Task] = None
+        self._obs_period = 1.0
 
     # ------------------------------------------------------------------ #
     def _debug(self, msg: str, *args):
@@ -193,9 +214,12 @@ class ConsensusAgent:
 
     def _count(self, name: str, value: float = 1) -> None:
         """Bump a per-agent counter and its ``comm.agent.*`` aggregate
-        in the default registry."""
+        in the default registry (and the per-agent ``obs=`` registry
+        when one is attached)."""
         self.counters[name] = self.counters.get(name, 0) + value
         get_registry().inc(f"comm.agent.{name}", value)
+        if self._obs is not None and self._obs is not get_registry():
+            self._obs.inc(f"comm.agent.{name}", value)
 
     def wire_stats(self) -> Dict[str, int]:
         """Whole-frame byte/frame totals over this agent's live streams
@@ -863,6 +887,11 @@ class ConsensusAgent:
             raise RuntimeError(f"agent not ready (status={self.status})")
         self._require_neighbors()
         self.status = AgentStatus.IN_ROUND
+        # Round latency: duration on the monotonic clock (graftlint
+        # wallclock-duration), start anchored to the wall clock so the
+        # span merges onto the run-wide timeline.
+        wall_t0 = time.time()
+        t0 = time.perf_counter()
         try:
             await self._master.send(P.NewRoundRequest(weight=float(weight)))
             while True:
@@ -892,6 +921,7 @@ class ConsensusAgent:
                 y_new = await self._gossip_iteration(y)
                 if y_new is None:  # Done broadcast mid-iteration
                     self._count("rounds_run")
+                    self._observe_round(time.perf_counter() - t0, wall_t0)
                     return y
                 # Two-sided residual (the reference's one-sided check at
                 # consensus_asyncio.py:297 is a recorded defect).
@@ -904,16 +934,73 @@ class ConsensusAgent:
                     status(round_id=self._round_id, iteration=self._iteration)
                 )
             self._count("rounds_run")
+            self._observe_round(time.perf_counter() - t0, wall_t0)
             return y
         finally:
             self._in_master_round = False
             if self.status is not AgentStatus.SHUTDOWN:
                 self.status = AgentStatus.READY
 
+    def _observe_round(self, dur_s: float, wall_t0: float) -> None:
+        """Per-round latency into the registries: a ``round_s`` series
+        point keyed by the master's round id and a wall-anchored span
+        (one track per agent in the merged run trace)."""
+        regs = [get_registry()]
+        if self._obs is not None and self._obs is not regs[0]:
+            regs.append(self._obs)
+        for reg in regs:
+            reg.observe("comm.agent.round_s", dur_s, step=self._round_id)
+            reg.record_span("comm.agent.round", dur_s, t0=wall_t0)
+
     async def send_telemetry(self, payload: Dict[str, Any]) -> None:
         """Parity: ``send_telemetry``, agent.py:214-218."""
         self._count("telemetry_sent")
         await self._master.send(P.Telemetry(token=self.token, payload=payload))
+
+    # ------------------------------------------------------------------ #
+    # Run-wide observability plane (docs/observability.md)               #
+    # ------------------------------------------------------------------ #
+    def _ensure_obs_source(self) -> ObsDeltaSource:
+        if self._obs_source is None:
+            self._obs_source = ObsDeltaSource(
+                self._obs if self._obs is not None else get_registry()
+            )
+        return self._obs_source
+
+    def obs_delta(self) -> Dict[str, Any]:
+        """Pack this agent's registry growth since the last pack into an
+        ``obs.delta`` Telemetry payload (``protocol.OBS_PAYLOAD_KIND``).
+        Uses the per-agent ``obs=`` registry when one was attached, else
+        the process-wide default (the right source for one-agent-per-
+        process deployments)."""
+        return self._ensure_obs_source().pack()
+
+    async def send_obs_delta(self) -> None:
+        """Ship one registry delta to the master's RunAggregator over
+        the existing Telemetry message — no new wire message, no new
+        connection."""
+        self._count("obs_deltas_sent")
+        await self.send_telemetry(self.obs_delta())
+
+    def start_obs_stream(self, period_s: float = 1.0) -> None:
+        """Start the periodic delta stream (an asyncio task; frame sends
+        interleave safely with round traffic — FramedStream serializes
+        writers).  Idempotent; stopped by :meth:`close`."""
+        if self._obs_task is not None:
+            return
+        self._obs_period = float(period_s)
+        self._ensure_obs_source()  # events from here on are buffered
+        self._obs_task = asyncio.ensure_future(self._obs_stream_loop())
+
+    async def _obs_stream_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self._obs_period)
+                await self.send_obs_delta()
+        except (asyncio.CancelledError, ConnectionError, OSError):
+            # Stream teardown/cancel ends the telemetry stream quietly:
+            # observability must never take an agent down.
+            pass
 
     def _require_neighbors(self) -> None:
         """A collective op with missing neighbor streams would silently
@@ -953,6 +1040,13 @@ class ConsensusAgent:
         skips the grace period (used for tests that simulate dying
         agents).
         """
+        if self._obs_task is not None:
+            # Stop the periodic delta stream first: a send racing the
+            # teardown below would observe half-closed streams.
+            self._obs_task.cancel()
+            self._obs_task = None
+        if self._obs_source is not None:
+            self._obs_source.close()
         deadline = asyncio.get_event_loop().time() + drain
         # Once the master stream yields anything during close — a message
         # we no longer care about, or EOF from a master that exited first

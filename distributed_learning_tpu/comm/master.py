@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -36,7 +37,7 @@ import numpy as np
 from distributed_learning_tpu.comm.framing import FramedStream
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
 from distributed_learning_tpu.comm import protocol as P
-from distributed_learning_tpu.obs import get_registry
+from distributed_learning_tpu.obs import FlightRecorder, RunAggregator, get_registry
 from distributed_learning_tpu.parallel.fast_averaging import solve_fastest_mixing
 from distributed_learning_tpu.parallel.topology import Topology
 from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
@@ -58,6 +59,9 @@ class ConsensusMaster:
         telemetry: Optional[TelemetryProcessor] = None,
         elastic: bool = False,
         debug: bool = False,
+        aggregator: Optional[RunAggregator] = None,
+        flight: Optional[FlightRecorder] = None,
+        round_deadline_s: Optional[float] = None,
     ):
         self.topology = (
             topology
@@ -93,6 +97,28 @@ class ConsensusMaster:
         self._round_id = 0
         self._round_weights: Dict[str, float] = {}
         self._converged: Dict[str, bool] = {}
+
+        # Run-wide observability plane (docs/observability.md §Run-wide
+        # plane): the aggregator merges per-agent obs.delta Telemetry
+        # payloads; the flight recorder keeps per-agent event rings and
+        # dumps a JSONL black box on abort / death / deadline expiry /
+        # shutdown-with-reason.  round_deadline_s only OBSERVES (counts
+        # + dumps when a round overstays) — deadline-based round
+        # *termination* is the async runtime's job, not the plane's.
+        self.aggregator = aggregator
+        self.flight = flight
+        if (aggregator is not None and flight is not None
+                and aggregator.flight is None):
+            aggregator.flight = flight  # merged events feed the rings
+        self.round_deadline_s = (
+            None if round_deadline_s is None else float(round_deadline_s)
+        )
+        self._deadline_handle: Optional[asyncio.TimerHandle] = None
+        # Wall-clock arrival time of each agent's round request: the
+        # straggler-attribution signal (the last arrival set the pace).
+        self._round_arrivals: Dict[str, float] = {}
+        self._round_t0 = 0.0
+        self._round_wall_t0 = 0.0
 
         # Elastic recovery (beyond parity: the reference's only failure
         # handling is the shutdown broadcast, SURVEY.md §5).  With
@@ -188,6 +214,11 @@ class ConsensusMaster:
         self._control[token] = stream
         self._listen_addr[token] = (msg.host, msg.port)
         self._count("registrations")
+        if self.flight is not None:
+            self.flight.note(
+                "<master>", "rejoined" if rejoining else "registered",
+                token=token,
+            )
         self._debug("registered %s @ %s:%s", token, msg.host, msg.port)
         await stream.send(P.Ok(info="rejoined" if rejoining else "registered"))
         # Into the mux immediately: deaths are then observable in every
@@ -278,9 +309,12 @@ class ConsensusMaster:
                             dead.close()
                         self._down.add(token)
                         self._round_weights.pop(token, None)
+                        aborted_round = None
                         if self._round_running:
                             self._round_running = False
+                            self._cancel_deadline()
                             self._count("rounds_aborted")
+                            aborted_round = self._round_id
                             await self._broadcast(
                                 P.Done(round_id=self._round_id, aborted=True)
                             )
@@ -289,6 +323,20 @@ class ConsensusMaster:
                                 self._round_id, token,
                             )
                         self._count("agents_down")
+                        if self.flight is not None:
+                            # One black box per fault: the abort dump
+                            # subsumes the death that caused it.
+                            self.flight.note(
+                                "<master>", "agent_down", token=token,
+                                round_id=aborted_round,
+                            )
+                            if aborted_round is not None:
+                                self._flight_dump(
+                                    "round_aborted",
+                                    round_id=aborted_round, token=token,
+                                )
+                            else:
+                                self._flight_dump("agent_down", token=token)
                         self._debug("agent %s down; awaiting rejoin", token)
                         continue
                     # Control connection lost.  No recovery protocol exists
@@ -302,6 +350,13 @@ class ConsensusMaster:
                     await self._on_status(token, msg)
                 elif isinstance(msg, P.Telemetry):
                     self._count("telemetry_payloads")
+                    if self.aggregator is not None:
+                        # The run-wide plane: obs.delta payloads merge
+                        # into the run registry (+ flight rings); other
+                        # payloads are recorded as plain telemetry.
+                        self.aggregator.process(
+                            msg.token or token, msg.payload
+                        )
                     if self.telemetry is not None:
                         self.telemetry.process(msg.token or token, msg.payload)
                 elif isinstance(msg, P.ErrorException):
@@ -314,9 +369,44 @@ class ConsensusMaster:
             pass
         except Exception as e:  # parity: shutdown broadcast on master error
             self._debug("error: %r; broadcasting shutdown", e)
+            if self.flight is not None:
+                self._flight_dump("master_error", error=repr(e))
             await self._broadcast(P.Shutdown(reason=repr(e)))
         finally:
             self._stopped.set()
+
+    def _flight_dump(self, reason: str, **context) -> None:
+        """Trigger a flight-recorder dump (counted, never fatal — the
+        black box must not be able to crash the plane it records)."""
+        if self.flight is None:
+            return
+        try:
+            path = self.flight.trigger(reason, **context)
+            self._count("flight_dumps")
+            self._debug("flight recorder dumped %s (%s)", path, reason)
+        except OSError as exc:  # pragma: no cover - disk-full etc.
+            self._debug("flight dump failed: %s", exc)
+
+    def _cancel_deadline(self) -> None:
+        if self._deadline_handle is not None:
+            self._deadline_handle.cancel()
+            self._deadline_handle = None
+
+    def _on_round_deadline(self, round_id: int) -> None:
+        """call_later callback: the round overstayed round_deadline_s.
+        Observe-and-record only — the lock-step protocol keeps waiting
+        (dropping the straggler is the async runtime's move); the count
+        and the dump make the stall diagnosable instead of silent."""
+        self._deadline_handle = None
+        if self._round_running and self._round_id == round_id:
+            self._count("round_deadlines_expired")
+            missing = sorted(
+                t for t, ok in self._converged.items() if not ok
+            )
+            self._flight_dump(
+                "round_deadline", round_id=round_id,
+                deadline_s=self.round_deadline_s, waiting_on=missing,
+            )
 
     async def _on_round_request(self, token: str, msg: P.NewRoundRequest):
         if self._round_running:
@@ -327,6 +417,10 @@ class ConsensusMaster:
             )
             return
         self._round_weights[token] = msg.weight
+        # Straggler signal: who kept the round waiting.  Wall clock on
+        # purpose — arrivals are compared against agent-side wall
+        # anchors on the merged timeline.
+        self._round_arrivals[token] = time.time()
         if len(self._round_weights) == len(self._tokens):
             self._round_id += 1
             self._round_running = True
@@ -334,6 +428,21 @@ class ConsensusMaster:
             mean_w = float(np.mean(list(self._round_weights.values())))
             self._round_weights.clear()
             self._count("rounds_started")
+            self._round_wall_t0 = time.time()
+            self._round_t0 = time.perf_counter()
+            if self.aggregator is not None:
+                self.aggregator.note_round_arrivals(
+                    self._round_id, dict(self._round_arrivals)
+                )
+            self._round_arrivals.clear()
+            if self.round_deadline_s:
+                self._cancel_deadline()
+                self._deadline_handle = (
+                    asyncio.get_event_loop().call_later(
+                        self.round_deadline_s,
+                        self._on_round_deadline, self._round_id,
+                    )
+                )
             await self._broadcast(
                 P.NewRoundNotification(round_id=self._round_id, mean_weight=mean_w)
             )
@@ -345,7 +454,14 @@ class ConsensusMaster:
         self._converged[token] = isinstance(msg, P.Converged)
         if all(self._converged.values()):
             self._round_running = False
+            self._cancel_deadline()
             self._count("rounds_done")
+            if self.aggregator is not None:
+                self.aggregator.note_round_done(
+                    self._round_id,
+                    time.perf_counter() - self._round_t0,
+                    wall_t0=self._round_wall_t0,
+                )
             await self._broadcast(P.Done(round_id=self._round_id))
             self._debug("round %s done", self._round_id)
 
@@ -358,7 +474,12 @@ class ConsensusMaster:
 
     # ------------------------------------------------------------------ #
     async def shutdown(self, reason: str = "") -> None:
-        """Broadcast shutdown and stop (parity: master.py:48-61)."""
+        """Broadcast shutdown and stop (parity: master.py:48-61).  A
+        shutdown WITH a reason is a fault path — it ships its black
+        box."""
+        self._cancel_deadline()
+        if reason:
+            self._flight_dump("shutdown", detail=reason)
         await self._broadcast(P.Shutdown(reason=reason))
         if self._serve_task is not None:
             self._serve_task.cancel()

@@ -23,6 +23,18 @@ from typing import Any, ClassVar, Dict, List, Optional, Tuple, Type
 import numpy as np
 
 from distributed_learning_tpu.comm.tensor_codec import decode_tensor, encode_tensor
+# The run-wide observability plane's structured Telemetry payload: a
+# per-agent registry delta, marked by payload["kind"] ==
+# OBS_PAYLOAD_KIND and versioned by payload["v"] == OBS_PAYLOAD_VERSION.
+# The schema lives with its producer/consumer (obs/aggregate.py:
+# ObsDeltaSource.pack / RunAggregator.process) and is re-exported here
+# because it IS wire surface: any payload claiming the kind must follow
+# the versioned layout, exactly like a message's binary fields.
+from distributed_learning_tpu.obs.aggregate import (  # noqa: F401
+    OBS_PAYLOAD_KIND,
+    OBS_PAYLOAD_VERSION,
+    is_obs_payload,
+)
 
 __all__ = [
     "Message",
@@ -44,6 +56,9 @@ __all__ = [
     "ValueResponseFusedSparse",
     "pack_message",
     "unpack_message",
+    "OBS_PAYLOAD_KIND",
+    "OBS_PAYLOAD_VERSION",
+    "is_obs_payload",
 ]
 
 

@@ -11,7 +11,13 @@ One import surface for the four pieces:
   ``jax.profiler`` integration) — `spans.py`;
 * :func:`instrument_step` — transparent call wrapping for compiled step
   functions — `instrument.py`;
-* run-report rendering + the ``obs-report`` CLI — `report.py`.
+* run-report rendering + the ``obs-report`` / ``obs-monitor`` CLIs —
+  `report.py`;
+* the **run-wide plane** — `aggregate.py` (:class:`ObsDeltaSource`
+  agent-side registry deltas, :class:`RunAggregator` master-side merge
+  with per-agent labels, straggler profiles, merged Perfetto traces)
+  and `flight.py` (:class:`FlightRecorder` — per-agent event rings
+  dumped to a JSONL black box on abort/death/deadline/shutdown).
 
 Library code counts into the process-wide default registry/tracer
 (`get_registry()` / `get_tracer()`); tests and multi-run drivers scope
@@ -30,6 +36,15 @@ from distributed_learning_tpu.obs.registry import (
     set_registry,
     use_registry,
 )
+from distributed_learning_tpu.obs.aggregate import (
+    OBS_PAYLOAD_KIND,
+    OBS_PAYLOAD_VERSION,
+    ObsDeltaSource,
+    RunAggregator,
+    is_obs_payload,
+    straggler_profile_from_registry,
+)
+from distributed_learning_tpu.obs.flight import FlightRecorder
 from distributed_learning_tpu.obs.report import format_run_report, obs_report_main
 from distributed_learning_tpu.obs.spans import (
     Span,
@@ -59,4 +74,11 @@ __all__ = [
     "instrument_step",
     "format_run_report",
     "obs_report_main",
+    "OBS_PAYLOAD_KIND",
+    "OBS_PAYLOAD_VERSION",
+    "ObsDeltaSource",
+    "RunAggregator",
+    "FlightRecorder",
+    "is_obs_payload",
+    "straggler_profile_from_registry",
 ]

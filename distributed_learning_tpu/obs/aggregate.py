@@ -1,0 +1,527 @@
+"""Run-wide observability plane: merge per-agent metric streams into one
+run registry, straggler profiles, and merged cross-agent traces.
+
+PR 2 gave every *process* a :class:`MetricsRegistry`; this module gives
+the *run* one.  Each agent periodically packs a delta of its registry —
+counter totals, gauges, and the events recorded since the last pack
+(series points, wall-anchored spans, free-form events) — into the
+existing ``Telemetry`` wire message as a structured payload
+(:data:`OBS_PAYLOAD_KIND`, versioned; re-exported by
+``comm/protocol.py`` as part of the wire surface).  The master hands
+every payload to a :class:`RunAggregator`, which
+
+* merges the streams into ONE registry with per-agent label dimensions
+  (``comm.agent.rounds_run/a`` per agent + the run-wide
+  ``comm.agent.rounds_run`` sum — the same ``name/label`` convention the
+  trainer uses for ``train.loss/node``);
+* computes **straggler profiles** (:func:`straggler_profile_from_registry`):
+  per-agent round-latency percentiles + histograms, per-round
+  slowest-agent attribution from the master's arrival lags, round skew,
+  and the staleness picture from the existing
+  ``stale_requests_dropped`` / ``requests_deferred`` counters — exactly
+  the signals stale-weighted mixing and deadline rounds
+  (arxiv.org/pdf/2002.01119) and adaptive synchronization
+  (arxiv.org/pdf/1910.13598) need as input;
+* feeds every merged event into the
+  :class:`~distributed_learning_tpu.obs.flight.FlightRecorder` ring, so
+  a fault dump carries each agent's recent history;
+* exports a **merged Chrome/Perfetto trace**: one track (pid) per
+  agent, span starts wall-clock-anchored (``SpanTracer.wall0``), so N
+  processes' spans land on one shared timeline.
+
+Everything is host-side and jax-free (the ``obs-report`` /
+``obs-monitor`` CLIs replay these structures offline); nothing here may
+touch a jitted program — the plane observes training, it never joins
+it.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from distributed_learning_tpu.obs.flight import FlightRecorder
+from distributed_learning_tpu.obs.registry import MetricsRegistry
+from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
+
+__all__ = [
+    "OBS_PAYLOAD_KIND",
+    "OBS_PAYLOAD_VERSION",
+    "is_obs_payload",
+    "ObsDeltaSource",
+    "RunAggregator",
+    "straggler_profile_from_registry",
+]
+
+#: ``payload["kind"]`` marking a Telemetry payload as a registry delta
+#: (any other payload is opaque user telemetry, recorded as-is).
+OBS_PAYLOAD_KIND = "obs.delta"
+#: Schema version inside the payload (``payload["v"]``).  Bump on
+#: incompatible layout changes; the aggregator records-but-skips
+#: payloads from the future instead of crashing a running master.
+OBS_PAYLOAD_VERSION = 1
+
+#: Round-latency histogram bucket upper bounds (seconds; last is +inf).
+LATENCY_BUCKETS_S = (
+    0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, math.inf,
+)
+
+
+def is_obs_payload(payload: Any) -> bool:
+    """Whether a Telemetry payload is a structured registry delta."""
+    return (
+        isinstance(payload, Mapping)
+        and payload.get("kind") == OBS_PAYLOAD_KIND
+    )
+
+
+# ---------------------------------------------------------------------- #
+# Agent side: incremental registry deltas                                #
+# ---------------------------------------------------------------------- #
+class ObsDeltaSource:
+    """Packs a registry's growth since the last pack into an
+    ``obs.delta`` payload.
+
+    Counters/gauges travel as *absolute totals* (idempotent: a lost or
+    repeated delta cannot double-count — the aggregator diffs against
+    the last totals it saw); series points, spans, and events travel as
+    the buffered event stream (a sink registered on the registry, so
+    packing is O(new events), never a rescan).  ``seq`` increments per
+    pack; gaps tell the aggregator how many deltas a flaky wire lost.
+    """
+
+    def __init__(self, registry: MetricsRegistry, *,
+                 max_buffer: int = 4096, backfill: bool = True):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._buffer: collections.deque = collections.deque(
+            maxlen=int(max_buffer)
+        )
+        self._dropped = 0
+        self._seq = 0
+        self._closed = False
+        if backfill:
+            # A late-attached source still ships the registry's retained
+            # history in its first delta (events recorded before the
+            # sink existed would otherwise be invisible to the run).
+            self._buffer.extend(
+                dict(ev) for ev in registry.recent_events()
+            )
+        registry.add_sink(self._sink)
+
+    def _sink(self, event: Mapping[str, Any]) -> None:
+        with self._lock:
+            if (self._buffer.maxlen is not None
+                    and len(self._buffer) >= self._buffer.maxlen):
+                self._dropped += 1
+            self._buffer.append(dict(event))
+
+    def pack(self) -> dict:
+        """One delta payload; drains the event buffer."""
+        with self._lock:
+            events = list(self._buffer)
+            self._buffer.clear()
+            dropped, self._dropped = self._dropped, 0
+            self._seq += 1
+            seq = self._seq
+        snap = self._registry.snapshot()
+        payload = {
+            "kind": OBS_PAYLOAD_KIND,
+            "v": OBS_PAYLOAD_VERSION,
+            "seq": seq,
+            "wall": time.time(),
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+            "events": events,
+        }
+        if dropped:
+            payload["events_dropped"] = dropped
+        return payload
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._registry.remove_sink(self._sink)
+
+
+# ---------------------------------------------------------------------- #
+# Master side: the run aggregator                                        #
+# ---------------------------------------------------------------------- #
+class _AgentView:
+    """Per-agent merge state inside the aggregator."""
+
+    __slots__ = ("last_seq", "counters", "spans", "last_wall")
+
+    def __init__(self, max_spans: int):
+        self.last_seq = 0
+        self.counters: Dict[str, float] = {}
+        # (name, wall_t0, dur_s, depth) for the merged trace.
+        self.spans: collections.deque = collections.deque(maxlen=max_spans)
+        self.last_wall: Optional[float] = None
+
+
+class RunAggregator(TelemetryProcessor):
+    """Merge per-agent ``obs.delta`` payloads into one run registry.
+
+    Implements the ``TelemetryProcessor`` interface, so it plugs
+    straight into the master's existing telemetry dispatch
+    (``ConsensusMaster(aggregator=...)`` wires it; a user telemetry
+    processor still runs beside it).  Non-delta payloads are recorded
+    as plain ``telemetry`` events with their token — the plane subsumes
+    the old path, it does not break it.
+    """
+
+    def __init__(self, *, registry: Optional[MetricsRegistry] = None,
+                 flight: Optional[FlightRecorder] = None,
+                 max_spans_per_agent: int = 4096):
+        #: The merged run registry (per-agent labels + run-wide sums).
+        self.registry = (
+            registry if registry is not None
+            else MetricsRegistry(max_points=1 << 14, max_events=1 << 16)
+        )
+        self.flight = flight
+        self._lock = threading.Lock()
+        self._max_spans = int(max_spans_per_agent)
+        self._views: Dict[str, _AgentView] = {}
+
+    # ------------------------------------------------------------------ #
+    def agents(self) -> List[str]:
+        with self._lock:
+            return sorted(self._views)
+
+    def _view(self, token: str) -> _AgentView:
+        with self._lock:
+            view = self._views.get(token)
+            if view is None:
+                view = self._views[token] = _AgentView(self._max_spans)
+            return view
+
+    # ------------------------------------------------------------------ #
+    def process(self, token: Any, payload: Any) -> None:
+        """TelemetryProcessor entry point: merge one payload."""
+        token = str(token)
+        if not is_obs_payload(payload):
+            self.registry.event("telemetry", token=token, payload=payload)
+            if self.flight is not None:
+                self.flight.note(token, "telemetry", payload=payload)
+            return
+        if int(payload.get("v", 0)) > OBS_PAYLOAD_VERSION:
+            # A newer agent talking to an older master: visible, not
+            # fatal — the rest of the plane keeps running.
+            self.registry.inc("obs.unknown_version")
+            return
+        view = self._view(token)
+        seq = int(payload.get("seq", view.last_seq + 1))
+        if seq <= view.last_seq:
+            self.registry.inc("obs.stale_deltas")
+            return
+        if seq > view.last_seq + 1:
+            self.registry.inc("obs.deltas_lost", seq - view.last_seq - 1)
+        view.last_seq = seq
+        view.last_wall = payload.get("wall")
+
+        self._merge_counters(token, view, payload.get("counters") or {})
+        for name, value in (payload.get("gauges") or {}).items():
+            self.registry.gauge(f"{name}/{token}", float(value))
+            self.registry.gauge(name, float(value))
+        for ev in payload.get("events") or ():
+            self._merge_event(token, view, ev)
+        if payload.get("events_dropped"):
+            self.registry.inc(
+                f"obs.delta_events_dropped/{token}",
+                payload["events_dropped"],
+            )
+        # Self-contained stream marker: carries this agent's absolute
+        # counter totals, so a JsonlSink'd aggregate file replays into
+        # a live dashboard (obs-monitor) with counters intact.
+        self.registry.event(
+            "obs.delta", token=token, seq=seq,
+            wall=view.last_wall, counters=dict(view.counters),
+        )
+        self.registry.inc("obs.deltas_merged")
+
+    def _merge_counters(self, token: str, view: _AgentView,
+                        counters: Mapping[str, Any]) -> None:
+        for name, total in counters.items():
+            total = float(total)
+            prev = view.counters.get(name, 0.0)
+            diff = total - prev
+            if diff < 0:
+                # The token restarted with fresh counters (elastic
+                # rejoin): its new life counts from zero.
+                self.registry.inc("obs.counter_resets")
+                diff = total
+            if diff:
+                self.registry.inc(f"{name}/{token}", diff)
+                self.registry.inc(name, diff)
+            view.counters[name] = total
+
+    def _merge_event(self, token: str, view: _AgentView,
+                     ev: Mapping[str, Any]) -> None:
+        kind = ev.get("kind")
+        name = ev.get("name", "")
+        if kind == "series":
+            self.registry.observe(
+                f"{name}/{token}", float(ev.get("value", 0.0)),
+                step=ev.get("step"),
+            )
+        elif kind == "span":
+            dur = float(ev.get("value", 0.0))
+            t0 = ev.get("t0")
+            self.registry.record_span(
+                f"{name}/{token}", dur,
+                depth=int(ev.get("depth", 0)), t0=t0,
+            )
+            if t0 is not None:
+                view.spans.append(
+                    (name, float(t0), dur, int(ev.get("depth", 0)))
+                )
+        elif kind == "event":
+            fields = {
+                k: v for k, v in ev.items()
+                if k not in ("kind", "name", "ts")
+            }
+            self.registry.event(name, token=token,
+                                agent_ts=ev.get("ts"), **fields)
+        elif kind in ("counter", "gauge"):
+            # Snapshot lines from a replayed dump file: totals already
+            # merged through the counters/gauges maps — skip, or the
+            # offline merge would double-count.
+            return
+        if self.flight is not None:
+            self.flight.record(token, ev)
+
+    # ------------------------------------------------------------------ #
+    def merge_registry(self, token: str,
+                       registry: MetricsRegistry) -> None:
+        """Offline merge of a whole per-agent registry (the
+        ``obs-report --merge`` path over per-agent JSONL files): one
+        synthetic delta carrying the registry's totals and full event
+        log."""
+        self.process(str(token), {
+            "kind": OBS_PAYLOAD_KIND,
+            "v": OBS_PAYLOAD_VERSION,
+            "seq": self._view(str(token)).last_seq + 1,
+            "counters": dict(registry.counters),
+            "gauges": dict(registry.gauges),
+            "events": list(registry.events),
+        })
+
+    # ------------------------------------------------------------------ #
+    # Master-side round accounting (control-plane signals the agents    #
+    # cannot see about themselves).                                      #
+    # ------------------------------------------------------------------ #
+    def note_round_arrivals(self, round_id: int,
+                            arrivals: Mapping[str, float]) -> None:
+        """Per-round straggler attribution from the master's view: the
+        wall-clock arrival time of each agent's round request.  The
+        LAST arrival is the straggler — it set the round's start time
+        for everyone (lock-step rounds run at the pace of the slowest
+        agent, which is exactly what the async runtime will relax)."""
+        if not arrivals:
+            return
+        t_first = min(arrivals.values())
+        t_last = max(arrivals.values())
+        for token, t in arrivals.items():
+            self.registry.observe(
+                f"straggler.lag_s/{token}", t - t_first, step=round_id
+            )
+        self.registry.observe(
+            "straggler.skew_s", t_last - t_first, step=round_id
+        )
+        slowest = max(arrivals, key=lambda t: arrivals[t])
+        self.registry.inc(f"straggler.slowest/{slowest}")
+        if self.flight is not None:
+            self.flight.note(
+                "<master>", "round_arrivals", round_id=int(round_id),
+                skew_s=t_last - t_first, slowest=slowest,
+            )
+
+    def note_round_done(self, round_id: int, dur_s: float,
+                        wall_t0: Optional[float] = None) -> None:
+        """Master-side whole-round wall time (request-complete to
+        all-converged)."""
+        self.registry.inc("comm.master.rounds_done")
+        self.registry.observe(
+            "comm.master.round_s", float(dur_s), step=round_id
+        )
+        self.registry.record_span(
+            "comm.master.round", float(dur_s), t0=wall_t0
+        )
+        if wall_t0 is not None:
+            self._view("<master>").spans.append(
+                ("comm.master.round", float(wall_t0), float(dur_s), 0)
+            )
+
+    # ------------------------------------------------------------------ #
+    def straggler_profile(self) -> dict:
+        """See :func:`straggler_profile_from_registry`."""
+        return straggler_profile_from_registry(self.registry)
+
+    # ------------------------------------------------------------------ #
+    def to_chrome_trace(self) -> dict:
+        """Merged Chrome/Perfetto trace: one track (pid) per agent,
+        wall-clock-anchored span starts normalized to the earliest span
+        (the shared timeline), ``process_name`` metadata naming each
+        track after its agent."""
+        with self._lock:
+            per_agent = {
+                token: list(view.spans)
+                for token, view in sorted(self._views.items())
+                if view.spans
+            }
+        events: List[dict] = []
+        all_t0 = [t0 for spans in per_agent.values()
+                  for (_n, t0, _d, _dep) in spans]
+        base = min(all_t0) if all_t0 else 0.0
+        for pid, (token, spans) in enumerate(per_agent.items(), start=1):
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"agent {token}"},
+            })
+            for name, t0, dur, depth in spans:
+                events.append({
+                    "name": name,
+                    "ph": "X",
+                    "ts": round((t0 - base) * 1e6, 3),
+                    "dur": round(dur * 1e6, 3),
+                    "pid": pid,
+                    "tid": 1,
+                    "args": {"agent": token, "depth": depth},
+                })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {"wall0": base},
+        }
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write :meth:`to_chrome_trace` to ``path``; returns the span
+        event count (metadata rows excluded)."""
+        import json
+
+        trace = self.to_chrome_trace()
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(trace, fh)
+        return sum(1 for e in trace["traceEvents"] if e["ph"] == "X")
+
+
+# ---------------------------------------------------------------------- #
+# Straggler profile                                                      #
+# ---------------------------------------------------------------------- #
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(1, math.ceil(q * len(sorted_vals)))
+    return sorted_vals[rank - 1]
+
+
+def _hist(vals: List[float]) -> List[List[float]]:
+    """``[upper_bound_s, count]`` rows over LATENCY_BUCKETS_S."""
+    counts = [0] * len(LATENCY_BUCKETS_S)
+    for v in vals:
+        for i, ub in enumerate(LATENCY_BUCKETS_S):
+            if v <= ub:
+                counts[i] += 1
+                break
+    return [
+        [ub, c] for ub, c in zip(LATENCY_BUCKETS_S, counts) if c
+    ]
+
+
+def _series_by_token(registry: MetricsRegistry,
+                     prefix: str) -> Dict[str, list]:
+    out = {}
+    for name, pts in registry.series.items():
+        if name.startswith(prefix):
+            out[name[len(prefix):]] = list(pts)
+    return out
+
+
+def straggler_profile_from_registry(
+        registry: MetricsRegistry, *,
+        counters: Optional[Mapping[str, float]] = None) -> dict:
+    """Who is slow, how slow, and how often — from a merged run
+    registry.
+
+    Latency source, in preference order: the master's per-round arrival
+    lags (``straggler.lag_s/<token>`` — how long each agent kept the
+    round waiting; authoritative attribution) or, when no master-side
+    data exists, the agents' own round wall times
+    (``comm.agent.round_s/<token>`` — in lock-step rounds these include
+    waiting on peers, so attribution from them is weak; the profile
+    names its ``source`` so a reader knows which it got).  Staleness
+    comes from the per-agent ``stale_requests_dropped`` /
+    ``requests_deferred`` counters; ``counters`` overrides the
+    registry's own totals for callers that reconstructed them from a
+    replayed stream (``obs-monitor``, where counter totals travel as
+    delta markers, not events).
+    """
+    if counters is None:
+        counters = registry.counters
+    lag = _series_by_token(registry, "straggler.lag_s/")
+    source = "master-arrival-lag"
+    if not lag:
+        lag = _series_by_token(registry, "comm.agent.round_s/")
+        source = "agent-round-wall"
+    # Per-round grouping for attribution (step == round id).
+    rounds: Dict[Any, List[Tuple[str, float]]] = {}
+    for token, pts in lag.items():
+        for step, val in pts:
+            if step is not None:
+                rounds.setdefault(step, []).append((token, val))
+    slowest_counts: Dict[str, int] = {}
+    for entries in rounds.values():
+        if len(entries) >= 2:
+            tok = max(entries, key=lambda tv: tv[1])[0]
+            slowest_counts[tok] = slowest_counts.get(tok, 0) + 1
+    # Master-side attribution counters win when present (they cover
+    # rounds whose lag series may have been ring-evicted).
+    master_counts = {
+        name[len("straggler.slowest/"):]: int(total)
+        for name, total in counters.items()
+        if name.startswith("straggler.slowest/")
+    }
+    if master_counts:
+        slowest_counts = master_counts
+
+    per_agent = {}
+    for token in sorted(lag):
+        vals = sorted(v for _, v in lag[token])
+        per_agent[token] = {
+            "count": len(vals),
+            "p50_s": _pct(vals, 0.50),
+            "p95_s": _pct(vals, 0.95),
+            "max_s": vals[-1] if vals else 0.0,
+            "hist": _hist(vals),
+            "slowest_rounds": slowest_counts.get(token, 0),
+            "stale_dropped": counters.get(
+                f"comm.agent.stale_requests_dropped/{token}", 0
+            ),
+            "deferred": counters.get(
+                f"comm.agent.requests_deferred/{token}", 0
+            ),
+        }
+    skew_pts = sorted(
+        v for _, v in registry.series.get("straggler.skew_s", ())
+    )
+    skew = {
+        "p50_s": _pct(skew_pts, 0.50),
+        "p95_s": _pct(skew_pts, 0.95),
+        "max_s": skew_pts[-1] if skew_pts else 0.0,
+    }
+    slowest_agent = (
+        max(slowest_counts, key=lambda t: slowest_counts[t])
+        if slowest_counts else None
+    )
+    return {
+        "source": source,
+        "rounds": len(rounds),
+        "per_agent": per_agent,
+        "skew": skew,
+        "slowest_agent": slowest_agent,
+    }

@@ -32,6 +32,7 @@ the asyncio comm backend all write to one registry.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import threading
@@ -55,24 +56,40 @@ __all__ = [
 class MetricsRegistry:
     """One run's metrics: counters / gauges / series plus the event log.
 
-    ``max_events`` bounds the in-memory log for long runs (aggregates —
-    counters, gauges, series summaries — are exact regardless); attach a
-    :class:`JsonlSink` to stream the full log to disk instead.
+    ``max_events`` bounds the in-memory log as a ring (the *last* N
+    events are retained — the flight-recorder semantics a post-mortem
+    needs); ``max_points`` does the same per series.  Aggregates —
+    counters, gauges, series summaries, span stats — are exact
+    regardless of either cap, evictions are counted (visible in
+    :meth:`snapshot` / :meth:`run_report`), and a :class:`JsonlSink`
+    streams the *full* log to disk when nothing may be lost.
+    ``max_points=None`` keeps the pre-ring unbounded-list behaviour
+    (explicit opt-in for short-lived test registries); the process-wide
+    default registry — the one the comm layer counts into — is
+    constructed bounded.
     """
 
     def __init__(self, *, clock: Callable[[], float] = time.time,
-                 max_events: int = 1 << 20):
+                 max_events: int = 1 << 20,
+                 max_points: Optional[int] = None):
         self._lock = threading.Lock()
         self._clock = clock
         self._max_events = int(max_events)
+        self._max_points = None if max_points is None else int(max_points)
         self._dropped_events = 0
+        self.points_dropped: Dict[str, int] = {}
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
-        # name -> list of (step, value); step may be None (arrival order).
-        self.series: Dict[str, List[tuple]] = {}
+        # name -> sequence of (step, value); step may be None (arrival
+        # order).  A deque ring when max_points is set, a plain list
+        # otherwise (so unbounded registries keep list semantics).
+        self.series: Dict[str, Any] = {}
         # name -> [count, total_s, max_s] span aggregates.
         self.span_stats: Dict[str, List[float]] = {}
-        self.events: List[dict] = []
+        self.events: Any = (
+            collections.deque(maxlen=self._max_events)
+            if self._max_events else []
+        )
         self._sinks: List[Callable[[dict], None]] = []
 
     # ------------------------------------------------------------------ #
@@ -83,12 +100,33 @@ class MetricsRegistry:
         with self._lock:
             self._sinks.append(sink)
 
+    def remove_sink(self, sink: Callable[[dict], None]) -> None:
+        """Detach a sink added with :meth:`add_sink` (no-op if absent)."""
+        with self._lock:
+            try:
+                self._sinks.remove(sink)
+            except ValueError:
+                pass
+
+    def recent_events(self) -> List[dict]:
+        """A consistent copy of the retained event log (oldest first) —
+        what a late-attached consumer (delta source, flight ring) backfills
+        from."""
+        with self._lock:
+            return list(self.events)
+
+    def _new_series(self):
+        if self._max_points is None:
+            return []
+        return collections.deque(maxlen=self._max_points)
+
     def _record(self, event: dict) -> None:
-        # Caller holds the lock.
-        if len(self.events) < self._max_events:
-            self.events.append(event)
-        else:
+        # Caller holds the lock.  The event log is a ring: at capacity
+        # the OLDEST event is evicted (and counted), so a post-mortem
+        # reads the run's tail, not its first hour.
+        if (self._max_events and len(self.events) >= self._max_events):
             self._dropped_events += 1
+        self.events.append(event)
         for sink in self._sinks:
             sink(event)
 
@@ -112,9 +150,19 @@ class MetricsRegistry:
 
     def observe(self, name: str, value: float,
                 step: Optional[int] = None) -> None:
-        """Append one time-series observation."""
+        """Append one time-series observation (ring-evicting the oldest
+        point, counted in ``points_dropped``, when ``max_points`` is
+        set)."""
         with self._lock:
-            self.series.setdefault(name, []).append(
+            pts = self.series.get(name)
+            if pts is None:
+                pts = self.series[name] = self._new_series()
+            if (self._max_points is not None
+                    and len(pts) >= self._max_points):
+                self.points_dropped[name] = (
+                    self.points_dropped.get(name, 0) + 1
+                )
+            pts.append(
                 (None if step is None else int(step), float(value))
             )
             ev = {
@@ -129,7 +177,10 @@ class MetricsRegistry:
                     t0: Optional[float] = None) -> None:
         """Aggregate + log one completed wall-clock span (the
         :class:`~distributed_learning_tpu.obs.spans.SpanTracer` calls
-        this; spans are events too, so the JSONL log replays them)."""
+        this; spans are events too, so the JSONL log replays them).
+        ``t0``, when known, is the span's wall-clock (unix-epoch) start
+        — the anchor that lets per-agent logs merge onto one
+        timeline."""
         with self._lock:
             agg = self.span_stats.setdefault(name, [0, 0.0, 0.0])
             agg[0] += 1
@@ -152,13 +203,18 @@ class MetricsRegistry:
 
     # ------------------------------------------------------------------ #
     def snapshot(self) -> dict:
-        """Current aggregate state (counters, gauges, series lengths)."""
+        """Current aggregate state (counters, gauges, series lengths);
+        ``dropped`` makes ring truncation visible."""
         with self._lock:
             return {
                 "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
                 "series": {k: len(v) for k, v in self.series.items()},
                 "spans": {k: int(v[0]) for k, v in self.span_stats.items()},
+                "dropped": {
+                    "events": self._dropped_events,
+                    "series_points": sum(self.points_dropped.values()),
+                },
             }
 
     def run_report(self) -> dict:
@@ -179,6 +235,10 @@ class MetricsRegistry:
                     "last": vals[-1],
                     "last_step": last_step,
                 }
+                # Ring eviction is visible: stats cover the retained
+                # window, "dropped" says how much history it lost.
+                if self.points_dropped.get(name):
+                    series[name]["dropped"] = self.points_dropped[name]
             spans = {
                 name: {
                     "count": int(c),
@@ -195,6 +255,8 @@ class MetricsRegistry:
                 "spans": spans,
                 "events": len(self.events) + self._dropped_events,
             }
+            if self._dropped_events:
+                report["events_dropped"] = self._dropped_events
             if self.events:
                 report["wall_s"] = (
                     self.events[-1]["ts"] - self.events[0]["ts"]
@@ -228,8 +290,15 @@ class MetricsRegistry:
         """Rebuild a registry by replaying a JSONL event log (the
         round-trip inverse of :meth:`dump_jsonl`; timestamps are
         preserved from the file, not re-stamped)."""
+        return cls.from_events(read_jsonl(path))
+
+    @classmethod
+    def from_events(cls, events) -> "MetricsRegistry":
+        """Rebuild a registry by replaying an iterable of event dicts
+        (what :meth:`from_jsonl` and the tolerant mid-write reader of
+        ``obs-monitor`` share)."""
         reg = cls()
-        for ev in read_jsonl(path):
+        for ev in events:
             kind = ev.get("kind")
             name = ev.get("name", "")
             if kind == "counter":
@@ -322,7 +391,13 @@ class JsonlTelemetry(TelemetryProcessor):
 # ---------------------------------------------------------------------- #
 # Default (process-wide) registry                                        #
 # ---------------------------------------------------------------------- #
-_DEFAULT = MetricsRegistry()
+# Bounded by default: the comm/prefetch layers count into this registry
+# for the life of the process, and an unbounded series (one residual
+# observation per gossip round, forever) is a slow memory leak on a
+# long-lived agent.  The rings keep the last 16Ki points per series /
+# 64Ki events; evictions stay visible via ``points_dropped`` /
+# ``events_dropped``.
+_DEFAULT = MetricsRegistry(max_points=1 << 14, max_events=1 << 16)
 _DEFAULT_LOCK = threading.Lock()
 
 

@@ -1,11 +1,25 @@
-"""Run-report rendering + the ``obs-report`` CLI subcommand.
+"""Run-report rendering + the ``obs-report`` / ``obs-monitor`` CLIs.
 
 ``python -m distributed_learning_tpu.cli obs-report <run.jsonl>``
-replays a JSONL event log (written by
-``MetricsRegistry.dump_jsonl`` or streamed by a ``JsonlSink`` /
-``JsonlTelemetry``) and prints the aggregated run summary: counter
-totals, last gauges, time-series stats, and span timings — "where did
-this run's time and bandwidth go" without TensorBoard.
+replays a JSONL event log (written by ``MetricsRegistry.dump_jsonl`` or
+streamed by a ``JsonlSink`` / ``JsonlTelemetry``) and prints the
+aggregated run summary: counter totals, last gauges, time-series stats,
+and span timings — "where did this run's time and bandwidth go" without
+TensorBoard.
+
+The run-wide plane adds three modes (all jax-free):
+
+* ``obs-report --merge a.jsonl b.jsonl ...`` — merge per-agent event
+  logs into ONE run report with per-agent labels plus the straggler
+  profile (each file's stem names its agent; ``--trace out.json``
+  additionally writes the merged Perfetto trace);
+* ``obs-report --bench BENCH_r*.json`` — the driver's benchmark
+  trajectory as one table of headline samples/sec per round with
+  regression flagging;
+* ``obs-monitor <aggregate.jsonl>`` — live text dashboard over the
+  aggregate stream a master-side ``RunAggregator`` + ``JsonlSink``
+  writes (round rate, per-agent latency bars, consensus residual, wire
+  bytes); ``--once`` renders a single frame.
 """
 
 from __future__ import annotations
@@ -13,11 +27,22 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Optional, Sequence
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
+from distributed_learning_tpu.obs.aggregate import (
+    RunAggregator,
+    straggler_profile_from_registry,
+)
 from distributed_learning_tpu.obs.registry import MetricsRegistry
 
-__all__ = ["format_run_report", "obs_report_main"]
+__all__ = [
+    "format_run_report",
+    "format_straggler_profile",
+    "format_bench_trajectory",
+    "obs_report_main",
+    "obs_monitor_main",
+]
 
 
 def _fmt(v: float) -> str:
@@ -70,31 +95,378 @@ def format_run_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------- #
+# Straggler profile                                                      #
+# ---------------------------------------------------------------------- #
+def _bar(value: float, top: float, width: int = 24) -> str:
+    if top <= 0:
+        return ""
+    return "#" * max(0, min(width, round(width * value / top)))
+
+
+def format_straggler_profile(profile: dict) -> str:
+    """Render :func:`straggler_profile_from_registry` output."""
+    lines = [
+        f"straggler profile — {profile['rounds']} rounds, "
+        f"source: {profile['source']}"
+    ]
+    skew = profile.get("skew") or {}
+    if profile["rounds"]:
+        lines.append(
+            f"  round skew  p50 {skew.get('p50_s', 0.0):.4f}s  "
+            f"p95 {skew.get('p95_s', 0.0):.4f}s  "
+            f"max {skew.get('max_s', 0.0):.4f}s"
+        )
+    per_agent = profile.get("per_agent") or {}
+    if per_agent:
+        top = max(a["p95_s"] for a in per_agent.values())
+        lines.append(
+            f"  {'agent':10s} {'n':>5} {'p50 s':>9} {'p95 s':>9} "
+            f"{'max s':>9} {'slowest':>8} {'stale':>6} {'defer':>6}  p95"
+        )
+        for token in sorted(per_agent):
+            a = per_agent[token]
+            lines.append(
+                f"  {token:10s} {a['count']:5d} {a['p50_s']:9.4f} "
+                f"{a['p95_s']:9.4f} {a['max_s']:9.4f} "
+                f"{a['slowest_rounds']:8d} {_fmt(a['stale_dropped']):>6} "
+                f"{_fmt(a['deferred']):>6}  {_bar(a['p95_s'], top)}"
+            )
+    if profile.get("slowest_agent") is not None:
+        lines.append(f"  slowest agent: {profile['slowest_agent']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------- #
+# Offline merge (obs-report --merge)                                     #
+# ---------------------------------------------------------------------- #
+def _token_from_path(path: str) -> str:
+    stem = path.replace("\\", "/").rsplit("/", 1)[-1]
+    if stem.endswith(".jsonl"):
+        stem = stem[: -len(".jsonl")]
+    return stem
+
+
+def merge_agent_logs(paths: Sequence[str]) -> RunAggregator:
+    """Merge per-agent JSONL event logs (file stem == agent token) into
+    one :class:`RunAggregator`.  The merged registry re-stamps nothing:
+    its clock is pinned to 0 because offline-merge timestamps are the
+    agents' own (carried inside the replayed events), and a
+    deterministic clock keeps merged reports reproducible."""
+    agg = RunAggregator(
+        registry=MetricsRegistry(clock=lambda: 0.0)
+    )
+    for path in paths:
+        agg.merge_registry(
+            _token_from_path(path), MetricsRegistry.from_jsonl(path)
+        )
+    return agg
+
+
+# ---------------------------------------------------------------------- #
+# Bench trajectory (obs-report --bench)                                  #
+# ---------------------------------------------------------------------- #
+#: A round counts as a regression when its headline drops below this
+#: fraction of the best healthy value seen in earlier rounds.
+BENCH_REGRESSION_FRACTION = 0.9
+
+
+def read_bench_records(paths: Sequence[str]) -> List[dict]:
+    """Parse the driver's ``BENCH_r*.json`` round files, sorted by
+    round number.  Each row: round ``n``, ``rc``, and the parsed record
+    (or None when the round produced no measurement)."""
+    rows = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            rec = json.load(fh)
+        rows.append({
+            "path": path,
+            "n": int(rec.get("n", 0)),
+            "rc": rec.get("rc"),
+            "parsed": rec.get("parsed"),
+        })
+    rows.sort(key=lambda r: r["n"])
+    return rows
+
+
+def format_bench_trajectory(rows: List[dict]) -> str:
+    """One table of headline samples/sec per round, regressions
+    flagged.  Provisional and tunnel-wedged CPU-sanity records are
+    labeled and excluded from the regression baseline (they measure a
+    different configuration)."""
+    lines = [
+        f"bench trajectory — {len(rows)} rounds",
+        f"  {'round':>5} {'rc':>3} {'value':>10} {'unit':>12} "
+        f"{'vs_base':>8}  status",
+    ]
+    best: Optional[float] = None
+    best_round: Optional[int] = None
+    for row in rows:
+        parsed = row["parsed"]
+        if not parsed:
+            lines.append(
+                f"  r{row['n']:04d} {row['rc']!s:>3} {'—':>10} {'—':>12} "
+                f"{'—':>8}  no record (driver rc={row['rc']})"
+            )
+            continue
+        value = float(parsed.get("value", 0.0))
+        unit = parsed.get("unit", "")
+        vs = parsed.get("vs_baseline")
+        healthy = not (
+            parsed.get("provisional") or parsed.get("tunnel_wedged")
+        )
+        status = "ok"
+        if parsed.get("tunnel_wedged"):
+            status = "cpu-sanity (tunnel wedged)"
+        elif parsed.get("provisional"):
+            status = "provisional"
+        elif best is not None and value < BENCH_REGRESSION_FRACTION * best:
+            status = (
+                f"REGRESSION -{(1 - value / best) * 100:.0f}% "
+                f"vs r{best_round:02d}"
+            )
+        lines.append(
+            f"  r{row['n']:04d} {row['rc']!s:>3} {value:10.2f} {unit:>12} "
+            f"{('%.3f' % vs) if vs is not None else '—':>8}  {status}"
+        )
+        if healthy and (best is None or value > best):
+            best, best_round = value, row["n"]
+    if best is not None:
+        lines.append(f"  best healthy headline: {best:.2f} (r{best_round:02d})")
+    else:
+        lines.append(
+            "  no healthy headline yet — every round missed its "
+            "measurement window"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------- #
+# obs-report CLI                                                         #
+# ---------------------------------------------------------------------- #
 def obs_report_main(argv: Optional[Sequence[str]] = None) -> int:
     """Entry point for ``cli.py obs-report``."""
     ap = argparse.ArgumentParser(
         prog="python -m distributed_learning_tpu.cli obs-report",
-        description="summarize a JSONL observability event log",
+        description="summarize JSONL observability event logs",
     )
-    ap.add_argument("path", help="JSONL event log (dump_jsonl/JsonlSink)")
+    ap.add_argument("paths", nargs="+",
+                    help="JSONL event log(s) (dump_jsonl/JsonlSink), or "
+                         "BENCH_r*.json files with --bench")
     ap.add_argument("--json", action="store_true",
-                    help="emit the raw run_report dict as JSON")
+                    help="emit the raw report dict as JSON")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge per-agent logs (file stem == agent "
+                         "token) into one run report + straggler "
+                         "profile")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="with --merge: also write the merged "
+                         "Chrome/Perfetto trace here")
+    ap.add_argument("--bench", action="store_true",
+                    help="read BENCH_r*.json driver round files: "
+                         "headline samples/sec per round with "
+                         "regression flagging")
     args = ap.parse_args(argv)
     try:
-        report = MetricsRegistry.from_jsonl(args.path).run_report()
-    except FileNotFoundError:
+        if args.bench:
+            rows = read_bench_records(args.paths)
+            text = (
+                json.dumps(rows, indent=2, sort_keys=True)
+                if args.json else format_bench_trajectory(rows)
+            )
+        elif args.merge:
+            agg = merge_agent_logs(args.paths)
+            if args.trace:
+                agg.export_chrome_trace(args.trace)
+            report = agg.registry.run_report()
+            profile = agg.straggler_profile()
+            text = (
+                json.dumps(
+                    {"report": report, "straggler": profile},
+                    indent=2, sort_keys=True,
+                )
+                if args.json else (
+                    format_run_report(report)
+                    + "\n\n"
+                    + format_straggler_profile(profile)
+                )
+            )
+        else:
+            if len(args.paths) != 1:
+                # graftlint: disable=no-print-in-library -- CLI error reporting to stderr (argparse convention)
+                print("obs-report: pass one log, or --merge/--bench for "
+                      "several", file=sys.stderr)
+                return 2
+            report = MetricsRegistry.from_jsonl(args.paths[0]).run_report()
+            text = (
+                json.dumps(report, indent=2, sort_keys=True)
+                if args.json else format_run_report(report)
+            )
+    except FileNotFoundError as exc:
         # graftlint: disable=no-print-in-library -- CLI error reporting to stderr (argparse convention)
-        print(f"obs-report: no such file: {args.path}", file=sys.stderr)
+        print(f"obs-report: no such file: {exc.filename}", file=sys.stderr)
         return 2
     except (json.JSONDecodeError, ValueError) as exc:
         # graftlint: disable=no-print-in-library -- CLI error reporting to stderr (argparse convention)
-        print(f"obs-report: {args.path} is not a JSONL event log: {exc}",
+        print(f"obs-report: input is not a JSONL event log: {exc}",
               file=sys.stderr)
         return 2
-    text = (
-        json.dumps(report, indent=2, sort_keys=True)
-        if args.json else format_run_report(report)
-    )
     # graftlint: disable=no-print-in-library -- obs-report's stdout IS its interface (the CLI subcommand's one output)
     print(text)
     return 0
+
+
+# ---------------------------------------------------------------------- #
+# obs-monitor: live dashboard over the aggregate stream                  #
+# ---------------------------------------------------------------------- #
+def _iter_jsonl_tolerant(path: str) -> Iterator[dict]:
+    """Yield parseable lines, silently skipping a torn tail — the
+    monitor reads a file the master is still appending to."""
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue
+
+
+def _sum_labeled(counters: Dict[str, float], name: str) -> float:
+    """Run-wide total for ``name``: the bare counter when present, else
+    the sum over its ``name/label`` dimensions."""
+    if name in counters:
+        return counters[name]
+    return sum(
+        v for k, v in counters.items() if k.startswith(name + "/")
+    )
+
+
+def _stream_counters(registry: MetricsRegistry,
+                     events: List[dict]) -> Dict[str, float]:
+    """Counters over a replayed aggregate STREAM: counter totals don't
+    stream as events, but every merged delta leaves an ``obs.delta``
+    marker carrying its agent's absolute totals — the last marker per
+    agent reconstructs them.  Replayed snapshot lines (a dumped file)
+    land in ``registry.counters`` and win."""
+    latest: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        if (ev.get("kind") == "event" and ev.get("name") == "obs.delta"
+                and isinstance(ev.get("counters"), dict)):
+            latest[str(ev.get("token"))] = ev["counters"]
+    counters: Dict[str, float] = {}
+    sums: Dict[str, float] = {}
+    for token, per_agent in latest.items():
+        for name, total in per_agent.items():
+            counters[f"{name}/{token}"] = float(total)
+            sums[name] = sums.get(name, 0.0) + float(total)
+    counters.update(sums)
+    counters.update(registry.counters)
+    return counters
+
+
+def render_dashboard(registry: MetricsRegistry, *,
+                     window_s: float = 30.0,
+                     now: Optional[float] = None,
+                     title: str = "") -> str:
+    """One text-dashboard frame over a (replayed) aggregate registry."""
+    events = registry.recent_events()
+    counters = _stream_counters(registry, events)
+    ts = [e["ts"] for e in events if "ts" in e]
+    # Cross-process ages compare wall-clock timestamps, the one clock
+    # every process shares; this is reporting, not a measured duration.
+    # graftlint: disable=wallclock-duration -- cross-process staleness: event ts are wall-clock stamps from other processes, monotonic clocks cannot compare across them
+    age = (time.time() if now is None else now) - max(ts) if ts else None
+    lines = [
+        "obs-monitor"
+        + (f" — {title}" if title else "")
+        + f" · {len(events)} events"
+        + (f" · last update {age:.1f}s ago" if age is not None else "")
+    ]
+    # Round rate over the trailing window.  The done count falls back
+    # to the master's per-round series (one point per completed round)
+    # when no counter reached the stream.
+    done = int(
+        _sum_labeled(counters, "comm.master.rounds_done")
+        or len(registry.series.get("comm.master.round_s", ()))
+    )
+    cutoff = (max(ts) if ts else 0.0) - window_s
+    recent = [
+        e for e in events
+        if e.get("kind") == "series"
+        and e.get("name") == "comm.master.round_s"
+        and e.get("ts", 0.0) >= cutoff
+    ]
+    rate = len(recent) / window_s if recent else 0.0
+    lines.append(
+        f"rounds: {done} done · rate {rate:.2f}/s "
+        f"(last {window_s:.0f}s)"
+    )
+    profile = straggler_profile_from_registry(registry, counters=counters)
+    if profile["per_agent"]:
+        lines.append(format_straggler_profile(profile))
+    residuals = {
+        name: pts for name, pts in registry.series.items()
+        if "consensus.residual" in name
+    }
+    if residuals:
+        last = {
+            name: list(pts)[-1][1] for name, pts in residuals.items()
+        }
+        worst = max(last.values())
+        lines.append(f"consensus residual (worst last): {worst:.3g}")
+    out_b = _sum_labeled(counters, "comm.bytes_framed_out")
+    in_b = _sum_labeled(counters, "comm.bytes_framed_in")
+    if out_b or in_b:
+        lines.append(
+            f"wire: {out_b / 1024.0:.1f} KiB out · "
+            f"{in_b / 1024.0:.1f} KiB in · "
+            f"{int(_sum_labeled(counters, 'comm.frames_out'))} frames out"
+        )
+    lost = counters.get("obs.deltas_lost", 0)
+    if lost:
+        lines.append(f"obs: {int(lost)} telemetry deltas lost")
+    return "\n".join(lines)
+
+
+def obs_monitor_main(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for ``cli.py obs-monitor``: tail an aggregate JSONL
+    stream (a master-side ``RunAggregator`` registry with a
+    ``JsonlSink``) and re-render the dashboard every ``--interval``
+    seconds; ``--once`` prints a single frame (scripts, tests)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_learning_tpu.cli obs-monitor",
+        description="live text dashboard over an aggregate obs stream",
+    )
+    ap.add_argument("path", help="aggregate JSONL stream (JsonlSink on "
+                                 "the RunAggregator registry)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--window", type=float, default=30.0,
+                    help="trailing seconds for the round-rate estimate")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            reg = MetricsRegistry.from_events(
+                _iter_jsonl_tolerant(args.path)
+            )
+        except FileNotFoundError:
+            # graftlint: disable=no-print-in-library -- CLI error reporting to stderr (argparse convention)
+            print(f"obs-monitor: no such file: {args.path}",
+                  file=sys.stderr)
+            return 2
+        frame = render_dashboard(
+            reg, window_s=args.window, title=args.path
+        )
+        # graftlint: disable=no-print-in-library -- obs-monitor's stdout IS its interface (the live dashboard)
+        print(frame, flush=True)
+        if args.once:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        # graftlint: disable=no-print-in-library -- obs-monitor's stdout IS its interface (frame separator)
+        print("", flush=True)

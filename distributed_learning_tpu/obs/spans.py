@@ -64,6 +64,14 @@ class SpanTracer:
         Bound on the retained per-span detail (aggregates in the
         registry stay exact past the cap; the Chrome export covers the
         first ``max_spans`` spans).
+
+    Span times are read on the monotonic ``clock`` (durations must
+    never come from ``time.time()`` deltas — graftlint
+    ``wallclock-duration``), but ``perf_counter`` origins are
+    process-local, so every tracer also records ``wall0``: the
+    wall-clock epoch of its monotonic zero.  Exports anchor span starts
+    to ``wall0``, which is what lets N processes' traces merge onto ONE
+    timeline (``RunAggregator.to_chrome_trace``).
     """
 
     def __init__(self, *, registry: Optional[MetricsRegistry] = None,
@@ -76,6 +84,11 @@ class SpanTracer:
         self._lock = threading.Lock()
         self._local = threading.local()
         self._epoch = self._clock()
+        # Wall-clock anchor: the absolute time of monotonic zero
+        # (_epoch).  The two reads are adjacent, so the anchor is good
+        # to well under a millisecond — plenty for cross-process trace
+        # alignment (gossip rounds are >= milliseconds).
+        self.wall0 = time.time()
         self.spans: List[Span] = []
         self.dropped = 0
 
@@ -120,7 +133,13 @@ class SpanTracer:
                 else self.registry
             )
             if reg is not None:
-                reg.record_span(name, dur, depth=depth, t0=t0 - self._epoch)
+                # Wall-anchored start: registry/JSONL span events carry
+                # an absolute t0, so per-agent logs merge onto one
+                # timeline without knowing each tracer's epoch.
+                reg.record_span(
+                    name, dur, depth=depth,
+                    t0=self.wall0 + (t0 - self._epoch),
+                )
 
     # ------------------------------------------------------------------ #
     def aggregate(self) -> Dict[str, dict]:
@@ -139,16 +158,23 @@ class SpanTracer:
             agg["mean_s"] = agg["total_s"] / agg["count"]
         return out
 
-    def to_chrome_trace(self) -> dict:
+    def to_chrome_trace(self, *, wall_clock: bool = True) -> dict:
         """Chrome trace-event JSON (complete 'X' events, microseconds);
-        load the exported file in ``chrome://tracing`` or Perfetto."""
+        load the exported file in ``chrome://tracing`` or Perfetto.
+
+        ``wall_clock=True`` (default) anchors ``ts`` to the tracer's
+        ``wall0`` — absolute unix-epoch microseconds — so traces
+        exported by N processes land on ONE shared timeline when merged
+        (the run-wide plane's per-agent tracks); ``wall_clock=False``
+        keeps the tracer-relative origin."""
         with self._lock:
             spans = list(self.spans)
+        base = self.wall0 if wall_clock else 0.0
         events = [
             {
                 "name": s.name,
                 "ph": "X",
-                "ts": round(s.t0 * 1e6, 3),
+                "ts": round((base + s.t0) * 1e6, 3),
                 "dur": round(s.dur * 1e6, 3),
                 "pid": 0,
                 "tid": s.tid,
@@ -171,6 +197,7 @@ class SpanTracer:
             self.spans.clear()
             self.dropped = 0
             self._epoch = self._clock()
+            self.wall0 = time.time()  # re-anchor with the new epoch
 
 
 # ---------------------------------------------------------------------- #

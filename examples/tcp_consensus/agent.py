@@ -22,6 +22,7 @@ import asyncio
 import numpy as np
 
 from distributed_learning_tpu.comm import ConsensusAgent
+from distributed_learning_tpu.obs import MetricsRegistry
 
 
 async def main():
@@ -37,13 +38,20 @@ async def main():
     ap.add_argument("--rejoin", action="store_true",
                     help="replace a dead agent with this token "
                          "(master must run with --elastic)")
+    ap.add_argument("--obs-period", type=float, default=0.0,
+                    help="stream registry deltas to the master's "
+                         "RunAggregator every N seconds (0 = off; pair "
+                         "with master.py --obs-dir)")
     args = ap.parse_args()
 
     agent = ConsensusAgent(
         args.token, args.master_host, args.master_port,
         bf16_wire=args.bf16_wire, rejoin=args.rejoin,
+        obs=MetricsRegistry() if args.obs_period > 0 else None,
     )
     await agent.start(timeout=300)
+    if args.obs_period > 0:
+        agent.start_obs_stream(period_s=args.obs_period)
     print(f"agent {agent.token}: neighbors {agent.neighbor_tokens}, "
           f"eps {agent.convergence_eps}", flush=True)
 
@@ -55,6 +63,8 @@ async def main():
         print(f"agent {agent.token} round {r}: {np.round(x, 4).tolist()}",
               flush=True)
         await agent.send_telemetry({"round": r, "norm": float(np.linalg.norm(x))})
+    if args.obs_period > 0:
+        await agent.send_obs_delta()  # ship the tail before closing
     await agent.close()  # drains straggler neighbor requests, then exits
 
 

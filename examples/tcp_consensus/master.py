@@ -5,6 +5,14 @@ test.ipynb`` (master on :9000, topology 1-2, 2-3): run this in one
 terminal, then one ``agent.py TOKEN`` per agent in others.
 
     python examples/tcp_consensus/master.py --port 9000
+
+With ``--obs-dir`` the master hosts the run-wide observability plane
+(docs/observability.md §Run-wide plane): agents' ``obs.delta``
+telemetry merges into one run registry streamed to
+``<obs-dir>/aggregate.jsonl`` (tail it live with
+``python -m distributed_learning_tpu.cli obs-monitor``), faults dump
+flight-recorder black boxes beside it, and shutdown writes the merged
+per-agent Perfetto trace plus a straggler profile to stdout.
 """
 
 from __future__ import annotations
@@ -19,6 +27,12 @@ import argparse
 import asyncio
 
 from distributed_learning_tpu.comm import ConsensusMaster
+from distributed_learning_tpu.obs import (
+    FlightRecorder,
+    JsonlSink,
+    RunAggregator,
+)
+from distributed_learning_tpu.obs.report import format_straggler_profile
 from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
 
 
@@ -36,13 +50,29 @@ async def main():
     ap.add_argument("--eps", type=float, default=1e-6)
     ap.add_argument("--elastic", action="store_true",
                     help="survive agent death; allow token rejoin")
+    ap.add_argument("--obs-dir", default=None,
+                    help="host the run-wide observability plane: "
+                         "aggregate.jsonl stream, flight-recorder dumps, "
+                         "and a merged trace.json land here")
+    ap.add_argument("--round-deadline", type=float, default=None,
+                    help="seconds before an overstaying round is counted "
+                         "and flight-dumped (observe-only)")
     args = ap.parse_args()
+
+    aggregator = flight = sink = None
+    if args.obs_dir:
+        os.makedirs(args.obs_dir, exist_ok=True)
+        flight = FlightRecorder(args.obs_dir)
+        aggregator = RunAggregator(flight=flight)
+        sink = JsonlSink(os.path.join(args.obs_dir, "aggregate.jsonl"))
+        aggregator.registry.add_sink(sink)
 
     edges = [tuple(e.split("-")) for e in args.edges.split(",")]
     master = ConsensusMaster(
         edges, port=args.port, weight_mode=args.weights,
         convergence_eps=args.eps, telemetry=PrintTelemetry(),
-        elastic=args.elastic,
+        elastic=args.elastic, aggregator=aggregator, flight=flight,
+        round_deadline_s=args.round_deadline,
     )
     host, port = await master.start()
     print(f"master listening on {host}:{port}; topology {edges}", flush=True)
@@ -54,6 +84,14 @@ async def main():
         pass
     finally:
         await master.shutdown("master exiting")
+        if aggregator is not None:
+            trace = os.path.join(args.obs_dir, "trace.json")
+            n = aggregator.export_chrome_trace(trace)
+            print(f"merged trace: {trace} ({n} spans, one track per agent)",
+                  flush=True)
+            print(format_straggler_profile(aggregator.straggler_profile()),
+                  flush=True)
+            sink.close()
 
 
 if __name__ == "__main__":

@@ -9,6 +9,7 @@ The reference's notebooks were its examples AND its integration tests
 via CLI flags / env knobs to stay test-suite fast.
 """
 
+import json
 import os
 import re
 import signal
@@ -132,13 +133,18 @@ def test_cifar_gossip_masternode_example():
     assert 0.05 <= acc <= 1.0, out
 
 
-def test_tcp_consensus_example_pair():
+def test_tcp_consensus_example_pair(tmp_path):
     """The master/agent scripts agree on the weighted mean: agents 1..3
     feed 10*e_{i-1} with weights 1, 2, 3 over the path 1-2, 2-3, so every
-    agent must print [10/6, 20/6, 30/6] after its rounds."""
+    agent must print [10/6, 20/6, 30/6] after its rounds.  The run hosts
+    the run-wide observability plane (--obs-dir / --obs-period): the
+    aggregate stream, merged trace, and straggler profile must come out
+    the other end."""
     env = _env()
+    obs_dir = str(tmp_path / "obs")
     master = subprocess.Popen(
-        [sys.executable, "examples/tcp_consensus/master.py", "--port", "0"],
+        [sys.executable, "examples/tcp_consensus/master.py", "--port", "0",
+         "--obs-dir", obs_dir],
         cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True,
     )
@@ -169,7 +175,8 @@ def test_tcp_consensus_example_pair():
             agents.append(
                 subprocess.Popen(
                     [sys.executable, "examples/tcp_consensus/agent.py", tok,
-                     "--master-port", port, "--rounds", "2"],
+                     "--master-port", port, "--rounds", "2",
+                     "--obs-period", "0.2"],
                     cwd=REPO, env=env, stdout=subprocess.PIPE,
                     stderr=subprocess.STDOUT, text=True,
                 )
@@ -191,6 +198,28 @@ def test_tcp_consensus_example_pair():
             master.wait(timeout=30)
         except subprocess.TimeoutExpired:
             master.kill()
+    # The run-wide plane came out the other end: the aggregate stream
+    # holds per-agent labeled counters, the merged trace has one track
+    # per agent, and the master printed a straggler profile.
+    rest = []
+    while not lines.empty():
+        rest.append(lines.get_nowait())
+    master_out = "".join(rest)
+    assert "straggler profile" in master_out, master_out
+    assert "merged trace" in master_out, master_out
+    with open(os.path.join(obs_dir, "aggregate.jsonl")) as fh:
+        stream = [json.loads(l) for l in fh if l.strip()]
+    merged = [
+        e for e in stream
+        if e.get("kind") == "event" and e.get("name") == "obs.delta"
+    ]
+    assert {e["token"] for e in merged} == {"1", "2", "3"}, master_out
+    with open(os.path.join(obs_dir, "trace.json")) as fh:
+        trace = json.load(fh)
+    tracks = {
+        e["args"]["name"] for e in trace["traceEvents"] if e["ph"] == "M"
+    }
+    assert {"agent 1", "agent 2", "agent 3"} <= tracks, tracks
 
 
 def test_lm_gossip_example():

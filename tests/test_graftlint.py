@@ -289,6 +289,70 @@ def test_no_print_reasoned_suppression_accepted(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# wallclock-duration                                                    #
+# --------------------------------------------------------------------- #
+def test_wallclock_duration_fires_on_direct_delta(tmp_path):
+    code = """
+    import time
+    def f():
+        t0 = time.time()
+        work()
+        return time.time() - t0
+    """
+    fs = _lint(tmp_path, code, rules=["wallclock-duration"])
+    assert _rules_of(fs) == ["wallclock-duration"]
+    assert "perf_counter" in fs[0].message
+
+
+def test_wallclock_duration_tracks_assigned_names_and_aliases(tmp_path):
+    code = """
+    from time import time as now
+    def g(last_ts):
+        a = now()
+        return a - last_ts
+    """
+    fs = _lint(tmp_path, code, rules=["wallclock-duration"])
+    assert len(fs) == 1, fs
+
+
+def test_wallclock_duration_ignores_monotonic_clocks(tmp_path):
+    code = """
+    import time
+    def h():
+        t0 = time.perf_counter()
+        work()
+        return time.perf_counter() - t0
+    def m():
+        t0 = time.monotonic()
+        return time.monotonic() - t0
+    def stamps(ev0, ev1):
+        return ev1["ts"] - ev0["ts"]  # stored stamps, not clock calls
+    """
+    assert _lint(tmp_path, code, rules=["wallclock-duration"]) == []
+
+
+def test_wallclock_duration_bare_suppression_rejected(tmp_path):
+    code = """
+    import time
+    def f():
+        t0 = time.time()
+        return time.time() - t0  # graftlint: disable=wallclock-duration
+    """
+    fs = _lint(tmp_path, code, rules=["wallclock-duration"])
+    assert len(fs) == 1 and "needs a reason" in fs[0].message
+
+
+def test_wallclock_duration_reasoned_anchor_accepted(tmp_path):
+    code = """
+    import time
+    def anchor():
+        # graftlint: disable=wallclock-duration -- epoch anchor: the absolute wall time of monotonic zero, not a duration
+        return time.time() - time.perf_counter()
+    """
+    assert _lint(tmp_path, code, rules=["wallclock-duration"]) == []
+
+
+# --------------------------------------------------------------------- #
 # reference-citation                                                    #
 # --------------------------------------------------------------------- #
 @pytest.fixture

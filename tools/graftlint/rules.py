@@ -28,6 +28,14 @@ invariants (CLAUDE.md "Conventions that bite", SURVEY.md §2):
   benchmarks/examples (exempt trees).  A legitimate library print (a
   CLI subcommand's output, a matplotlib-free fallback) carries a
   reasoned suppression.
+* ``wallclock-duration`` — durations/latencies must be measured on a
+  monotonic clock (``time.perf_counter`` / ``time.monotonic``), never
+  as ``time.time()`` deltas: the wall clock steps under NTP slew and
+  leap adjustments, which turns a latency histogram into noise exactly
+  on the long-lived agents the straggler profiles watch.  Wall-clock
+  *anchors* (``SpanTracer.wall0``-style epoch offsets, cross-process
+  staleness against event timestamps) are the legitimate exceptions
+  and carry reasoned suppressions.
 * ``reference-citation`` — docstring/comment ``file:line`` citations
   must resolve (into ``/root/reference`` when present, else against the
   repo itself) so provenance pointers cannot rot.
@@ -445,6 +453,92 @@ class NoPrintInLibrary(Rule):
                     "output), suppress with a reason",
                 )
             )
+        return out
+
+
+@register
+class WallclockDuration(Rule):
+    """Durations via ``perf_counter``/``monotonic``, never ``time.time()``
+    deltas.
+
+    Flags a subtraction when either side involves the wall clock: a
+    direct ``time.time()`` call (also seen through ``from time import
+    time`` aliases) or a local name the enclosing function assigned
+    from one (the classic ``t0 = time.time(); ...; dur = time.time() -
+    t0``).  Wall-clock anchor arithmetic — epoch offsets, cross-process
+    staleness — is what suppressions with reasons are for
+    (``requires_reason``): the reason must say why monotonic clocks
+    cannot serve that site.
+    """
+
+    name = "wallclock-duration"
+    requires_reason = True
+
+    def _walltime_aliases(self, ctx: FileContext) -> Set[str]:
+        """Names that call the wall clock directly: ``time.time`` plus
+        any ``from time import time [as t]`` alias."""
+        aliases = {"time.time"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        aliases.add(a.asname or a.name)
+        return aliases
+
+    @staticmethod
+    def _is_call_to(node: ast.AST, aliases: Set[str]) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and (dotted_name(node.func) or "") in aliases
+        )
+
+    def _contains_wall_call(self, node: ast.AST,
+                            aliases: Set[str]) -> bool:
+        return any(
+            self._is_call_to(sub, aliases) for sub in ast.walk(node)
+        )
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        aliases = self._walltime_aliases(ctx)
+        # Names assigned from a wall-clock call anywhere in the file
+        # (file-scope taint: simple, and a shared name like ``t0``
+        # being wall in one function and monotonic in another is
+        # exactly the confusion this rule exists to keep out).
+        tainted: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign)
+                    and self._is_call_to(node.value, aliases)):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            operands = (node.left, node.right)
+            direct = any(
+                self._contains_wall_call(op, aliases) for op in operands
+            )
+            via_name = any(
+                isinstance(op, ast.Name) and op.id in tainted
+                for op in operands
+            )
+            if direct or via_name:
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.relpath,
+                        node.lineno,
+                        "duration measured as a time.time() delta: "
+                        "the wall clock steps (NTP slew/leap), "
+                        "poisoning latency stats — use "
+                        "time.perf_counter()/time.monotonic(); a "
+                        "legitimate wall-clock anchor needs "
+                        f"'# graftlint: disable={self.name} -- "
+                        "<why monotonic cannot serve here>'",
+                    )
+                )
         return out
 
 
