@@ -56,7 +56,12 @@ def smoke() -> bool:
 
 
 def emit(record: Dict[str, Any]) -> Dict[str, Any]:
-    """Print one JSON metric line (and append to $BENCH_OUT if set)."""
+    """Print one JSON metric line (and append to $BENCH_OUT if set).
+
+    Every emitted metric also lands in the persistent perf ledger
+    (``PERF_LEDGER.jsonl`` / ``$DLT_PERF_LEDGER``, ``obs/cost.py``) so
+    ``obs-report --ledger`` renders the cross-session trend; the append
+    is best-effort and cannot fail the benchmark."""
     record = dict(record)
     record.setdefault("platform", platform())
     line = json.dumps(record)
@@ -65,6 +70,13 @@ def emit(record: Dict[str, Any]) -> Dict[str, Any]:
     if out:
         with open(out, "a") as f:
             f.write(line + "\n")
+    from distributed_learning_tpu.obs.cost import ledger_append
+
+    ledger_append({
+        "source": "benchmarks",
+        "env": {"platform": record.get("platform")},
+        **record,
+    })
     return record
 
 

@@ -1,0 +1,137 @@
+"""Accelerator liveness probe + the ``TPU_HEALTH.jsonl`` health ledger.
+
+The tunneled TPU wedges for hours at a time, and until now each wedge
+window survived only as folklore ("r02–r05 hit the tunnel").  This
+module makes every probe outcome a dated JSONL record so wedge windows
+are queryable after the fact:
+
+* :func:`record_health` — append one ``{"ts", "event": "probe",
+  "outcome": ...}`` line to ``TPU_HEALTH.jsonl`` (``$DLT_TPU_HEALTH``
+  overrides the path; appends are best-effort and never fail the
+  caller).  Outcomes: ``healthy`` (first device op completed, with
+  ``probe_s``), ``wedged`` (watchdog expired with no completed op),
+  ``timeout`` (this CLI's own deadline passed), ``error`` (the op
+  raised).
+* ``python -m benchmarks.probe [--timeout S]`` — the session scripts'
+  stage-0 probe (``benchmarks/tpu_session2.sh``): run a seconds-cheap
+  matmul with a host-copy sync, record the outcome, exit 0 when alive /
+  3 when not (the session aborts on 3).  The probe self-times: a
+  wedged tunnel is *recorded* as such, not just killed silently by an
+  outer ``timeout``.
+* ``bench.py`` records through the same :func:`record_health`, so the
+  driver's rounds and the manual sessions share one health history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+__all__ = ["HEALTH_ENV", "DEFAULT_HEALTH", "health_path", "record_health",
+           "probe_device", "main"]
+
+HEALTH_ENV = "DLT_TPU_HEALTH"
+DEFAULT_HEALTH = "TPU_HEALTH.jsonl"
+
+
+def health_path(path: Optional[str] = None) -> str:
+    """Explicit arg > $DLT_TPU_HEALTH > ``TPU_HEALTH.jsonl`` next to the
+    repo root (where the driver's BENCH_r*.json artifacts live)."""
+    if path:
+        return path
+    env = os.environ.get(HEALTH_ENV)
+    if env:
+        return env
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(repo_root, DEFAULT_HEALTH)
+
+
+def record_health(outcome: str, path: Optional[str] = None,
+                  **fields) -> bool:
+    """Append one probe-outcome record; best-effort (a read-only
+    checkout or full disk must never fail the measurement run that is
+    reporting its health).  Returns whether the line landed."""
+    rec = {"ts": time.time(), "event": "probe", "outcome": str(outcome)}
+    rec.update(fields)
+    try:
+        with open(health_path(path), "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        return True
+    except OSError:
+        return False
+
+
+def probe_device() -> dict:
+    """One seconds-cheap matmul with a host-copy sync (the only sync the
+    tunneled backend honors — bench.py's probe, shared).  Returns
+    ``{"probe_s", "platform"}``; raises on device failure.  May hang on
+    a wedged tunnel: callers own the timeout (see :func:`main`)."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    a = jnp.ones((512, 512), jnp.bfloat16)
+    # float() forces the host copy that proves execution completed.
+    value = float((a @ a)[0, 0])
+    return {
+        "probe_s": round(time.perf_counter() - t0, 3),
+        "platform": jax.devices()[0].platform,
+        "sum": value,
+    }
+
+
+def main(argv=None) -> int:
+    """CLI: probe with a self-timeout, record the outcome, exit 0/3."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m benchmarks.probe",
+        description="accelerator liveness probe; appends its outcome "
+                    "to the TPU_HEALTH.jsonl ledger",
+    )
+    ap.add_argument("--timeout", type=float, default=55.0,
+                    help="seconds before the probe is declared wedged")
+    ap.add_argument("--ledger", default=None,
+                    help="health ledger path (default: $DLT_TPU_HEALTH "
+                         "or TPU_HEALTH.jsonl at the repo root)")
+    args = ap.parse_args(argv)
+
+    result: dict = {}
+    error: list = []
+
+    def run():
+        try:
+            result.update(probe_device())
+        except BaseException as exc:  # recorded, then re-raised as rc 3
+            error.append(repr(exc))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(args.timeout)
+    if t.is_alive():
+        record_health("timeout", args.ledger, timeout_s=args.timeout,
+                      source="benchmarks.probe")
+        print(f"probe: no completed device op within {args.timeout:.0f}s "
+              "— tunnel wedged", file=sys.stderr, flush=True)
+        # The jax call may never return; a normal exit would block on
+        # runtime teardown behind the wedged op.
+        os._exit(3)
+    if error:
+        record_health("error", args.ledger, error=error[0][:500],
+                      source="benchmarks.probe")
+        print(f"probe: device op failed: {error[0]}", file=sys.stderr,
+              flush=True)
+        return 3
+    record_health("healthy", args.ledger, source="benchmarks.probe",
+                  **{k: v for k, v in result.items() if k != "sum"})
+    print(f"probe: alive — first op in {result['probe_s']:.1f}s on "
+          f"{result['platform']}", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

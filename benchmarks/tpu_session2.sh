@@ -40,8 +40,11 @@ EOF
 }
 
 echo "== stage 0: liveness probe" >&2
-if ! timeout 60 python -u -c \
-  "import jax, jax.numpy as j; jax.jit(lambda a: a.sum())(j.ones((8,8))).block_until_ready(); print('alive')"; then
+# Same probe bench.py runs (benchmarks/probe.py): seconds-cheap matmul
+# with a host-copy sync, outcome appended to TPU_HEALTH.jsonl either
+# way — wedge windows are dated in the ledger, not folklore.  The probe
+# self-times; the outer timeout is only the belt to its suspenders.
+if ! timeout 90 python -u -m benchmarks.probe --timeout 60; then
   echo "tunnel not alive; aborting session2" >&2
   exit 3
 fi

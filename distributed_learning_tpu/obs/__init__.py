@@ -17,7 +17,13 @@ One import surface for the four pieces:
   agent-side registry deltas, :class:`RunAggregator` master-side merge
   with per-agent labels, straggler profiles, merged Perfetto traces)
   and `flight.py` (:class:`FlightRecorder` — per-agent event rings
-  dumped to a JSONL black box on abort/death/deadline/shutdown).
+  dumped to a JSONL black box on abort/death/deadline/shutdown);
+* the **device-cost observatory** — `cost.py` (:class:`CostProfile`
+  extracted from any compiled entry point: FLOPs, bytes, peak HBM,
+  donation, collective inventory; :class:`SampledDispatchTimer`
+  1-in-N chunk-boundary step timing with MFU/bytes-per-sec gauges;
+  the persistent `PERF_LEDGER.jsonl` perf ledger behind
+  ``obs-report --ledger``).
 
 Library code counts into the process-wide default registry/tracer
 (`get_registry()` / `get_tracer()`); tests and multi-run drivers scope
@@ -25,6 +31,18 @@ them with `use_registry` / `set_tracer`.
 """
 
 from distributed_learning_tpu.obs.carry import flush_chunk, global_norm
+from distributed_learning_tpu.obs.cost import (
+    CostProfile,
+    SampledDispatchTimer,
+    all_profiles,
+    clear_profiles,
+    device_peak_flops,
+    get_profile,
+    ledger_append,
+    profile_fn,
+    read_ledger,
+    register_profile,
+)
 from distributed_learning_tpu.obs.instrument import InstrumentedStep, instrument_step
 from distributed_learning_tpu.obs.registry import (
     JsonlSink,
@@ -72,6 +90,16 @@ __all__ = [
     "span",
     "InstrumentedStep",
     "instrument_step",
+    "CostProfile",
+    "SampledDispatchTimer",
+    "profile_fn",
+    "register_profile",
+    "get_profile",
+    "all_profiles",
+    "clear_profiles",
+    "device_peak_flops",
+    "ledger_append",
+    "read_ledger",
     "format_run_report",
     "obs_report_main",
     "OBS_PAYLOAD_KIND",

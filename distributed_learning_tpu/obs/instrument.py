@@ -41,6 +41,31 @@ class InstrumentedStep:
     def __getattr__(self, attr: str) -> Any:
         return getattr(self.__wrapped__, attr)
 
+    # The AOT stages surface is delegated EXPLICITLY (not only via
+    # __getattr__) so the profilable contract is part of this class's
+    # API: the audit and cost paths (tools/graftlint, obs/cost.py)
+    # call ``step.lower(...)`` / ``step.compile(...)`` on instrumented
+    # steps and must never need to unwrap.
+    def lower(self, *args: Any, **kwargs: Any) -> Any:
+        """Delegate to the wrapped jit object's ``lower`` (the lowered
+        program is the wrapped function's — instrumentation is
+        host-side only, so audit pins and cost profiles are of the real
+        program)."""
+        return self.__wrapped__.lower(*args, **kwargs)
+
+    def compile(self, *args: Any, **kwargs: Any) -> Any:
+        """AOT-compile the wrapped program at these argument shapes.
+
+        Delegates ``compile`` when the wrapped object has one; jitted
+        callables (which expose only ``lower``) get the standard
+        two-step ``lower(*args).compile()`` — either way the caller
+        holds a ``jax.stages.Compiled`` whose ``cost_analysis()`` /
+        ``memory_analysis()`` feed :mod:`distributed_learning_tpu.obs.cost`."""
+        inner = getattr(self.__wrapped__, "compile", None)
+        if inner is not None:
+            return inner(*args, **kwargs)
+        return self.__wrapped__.lower(*args, **kwargs).compile()
+
     def __repr__(self) -> str:
         return f"InstrumentedStep({self._name}, {self.__wrapped__!r})"
 

@@ -16,6 +16,11 @@ The run-wide plane adds three modes (all jax-free):
 * ``obs-report --bench BENCH_r*.json`` — the driver's benchmark
   trajectory as one table of headline samples/sec per round with
   regression flagging;
+* ``obs-report --ledger PERF_LEDGER.jsonl`` — the persistent perf
+  ledger (every ``bench.py`` / ``benchmarks/`` run appends a
+  ``{profile, measured, env-health}`` record; ``obs/cost.py``) as a
+  trend table with per-metric healthy-best regression flagging, so the
+  trajectory survives sessions the tunnel wedged away;
 * ``obs-monitor <aggregate.jsonl>`` — live text dashboard over the
   aggregate stream a master-side ``RunAggregator`` + ``JsonlSink``
   writes (round rate, per-agent latency bars, consensus residual, wire
@@ -266,9 +271,26 @@ def obs_report_main(argv: Optional[Sequence[str]] = None) -> int:
                     help="read BENCH_r*.json driver round files: "
                          "headline samples/sec per round with "
                          "regression flagging")
+    ap.add_argument("--ledger", action="store_true",
+                    help="read PERF_LEDGER.jsonl perf-ledger file(s): "
+                         "the {profile, measured, env-health} trend "
+                         "with healthy-best regression flagging")
     args = ap.parse_args(argv)
     try:
-        if args.bench:
+        if args.ledger:
+            from distributed_learning_tpu.obs.cost import (
+                format_ledger_trend,
+                read_ledger,
+            )
+
+            records: List[dict] = []
+            for path in args.paths:
+                records.extend(read_ledger(path))
+            text = (
+                json.dumps(records, indent=2, sort_keys=True)
+                if args.json else format_ledger_trend(records)
+            )
+        elif args.bench:
             rows = read_bench_records(args.paths)
             text = (
                 json.dumps(rows, indent=2, sort_keys=True)
@@ -294,8 +316,8 @@ def obs_report_main(argv: Optional[Sequence[str]] = None) -> int:
         else:
             if len(args.paths) != 1:
                 # graftlint: disable=no-print-in-library -- CLI error reporting to stderr (argparse convention)
-                print("obs-report: pass one log, or --merge/--bench for "
-                      "several", file=sys.stderr)
+                print("obs-report: pass one log, or --merge/--bench/"
+                      "--ledger for several", file=sys.stderr)
                 return 2
             report = MetricsRegistry.from_jsonl(args.paths[0]).run_report()
             text = (
@@ -416,6 +438,26 @@ def render_dashboard(registry: MetricsRegistry, *,
         }
         worst = max(last.values())
         lines.append(f"consensus residual (worst last): {worst:.3g}")
+    # Device-cost gauges (obs/cost.py): the sampled dispatch timer's
+    # MFU / bytes-per-sec, per program name.
+    mfus = {
+        name.split("/", 1)[1] if "/" in name else "step": value
+        for name, value in sorted(registry.gauges.items())
+        if name.startswith("cost.mfu")
+    }
+    if mfus:
+        bps = {
+            name.split("/", 1)[1] if "/" in name else "step": value
+            for name, value in registry.gauges.items()
+            if name.startswith("cost.bytes_per_sec")
+        }
+        parts = []
+        for prog, value in mfus.items():
+            part = f"{prog} {value * 100:.1f}%"
+            if prog in bps:
+                part += f" ({bps[prog] / 2**30:.2f} GiB/s)"
+            parts.append(part)
+        lines.append("mfu: " + " · ".join(parts))
     out_b = _sum_labeled(counters, "comm.bytes_framed_out")
     in_b = _sum_labeled(counters, "comm.bytes_framed_in")
     if out_b or in_b:

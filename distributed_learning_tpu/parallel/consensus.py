@@ -971,6 +971,21 @@ class ConsensusEngine:
             local, mesh=mesh, in_specs=(P(ax),), out_specs=P()
         )
 
+    def cost_profile(self, stacked: Pytree, *, times: int = 1,
+                     name: str = "consensus.mix"):
+        """:class:`~distributed_learning_tpu.obs.cost.CostProfile` of
+        this engine's compiled ``times``-round mix program at
+        ``stacked``'s shapes, registered process-wide under ``name`` —
+        the static FLOPs/bytes/collectives side of "is the bottleneck
+        compute or gossip?".  AOT ``lower().compile()`` only: nothing
+        executes, and the engine's own jitted entry-point caches are
+        untouched."""
+        from distributed_learning_tpu.obs.cost import profile_fn
+
+        return profile_fn(
+            jax.jit(self.mix_program(int(times))), stacked, name=name
+        )
+
     # ------------------------------------------------------------------ #
     # Jit plumbing                                                       #
     # ------------------------------------------------------------------ #

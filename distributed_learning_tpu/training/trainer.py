@@ -30,6 +30,7 @@ destructive under permutation symmetry).
 from __future__ import annotations
 
 import dataclasses
+import time
 import warnings
 from typing import Any, Callable, Dict, Hashable, List, Mapping, Optional, Sequence, Tuple
 
@@ -307,6 +308,8 @@ class GossipTrainer:
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         obs: Any = None,
+        profile_costs: bool = False,
+        timer_every_n: int = 0,
         seed: int = 0,
         dropout: bool = True,
         augment: bool = False,
@@ -360,6 +363,28 @@ class GossipTrainer:
             raise ValueError(
                 "obs must be None/False (off), True (default registry), "
                 f"or a MetricsRegistry; got {obs!r}"
+            )
+        # Device-cost observatory (obs/cost.py).  ``profile_costs=True``
+        # registers the compiled epoch/superstep programs' CostProfiles
+        # on first use (AOT lower+compile of the SAME program the train
+        # path runs — extraction only, the training dispatch is
+        # untouched).  ``timer_every_n=N`` (off at 0, the default)
+        # samples one chunk dispatch in N with an explicit
+        # block_until_ready at the chunk boundary — the declared 1-in-N
+        # sync of the sampled step timer; neither knob changes the
+        # compiled program (the obs on/off bit-identity oracle covers
+        # both).
+        self.profile_costs = bool(profile_costs)
+        self._cost_profiled: set = set()
+        self._cost_timer = None
+        if int(timer_every_n) > 0:
+            from distributed_learning_tpu.obs.cost import (
+                SampledDispatchTimer,
+            )
+
+            self._cost_timer = SampledDispatchTimer(
+                int(timer_every_n), name="trainer.epoch",
+                registry=self._obs_registry,
             )
         self.stat_step = int(stat_step)
         self.num_epochs = int(epoch)
@@ -903,6 +928,46 @@ class GossipTrainer:
             return contextlib.nullcontext()
         return self._obs_tracer.span(name)
 
+    def cost_profile(self, k: Optional[int] = None):
+        """:class:`~distributed_learning_tpu.obs.cost.CostProfile` of
+        the compiled epoch program (``k`` None/1) or the ``k``-epoch
+        superstep, registered process-wide as ``trainer.epoch`` /
+        ``trainer.superstep<k>`` (gauges land in the metrics registry,
+        so profiles ride run reports and obs deltas).
+
+        Extraction is the AOT ``lower().compile()`` of the SAME traced
+        program the train path dispatches — it never executes anything
+        and never changes what a later train call compiles."""
+        from distributed_learning_tpu.obs.cost import profile_fn
+
+        if self._state is None:
+            self.initialize_nodes()
+        registry = self._obs_registry
+        if k is None or int(k) <= 1:
+            return profile_fn(
+                self._jit_epoch, self._state, self._Xs, self._ys,
+                self._epoch_indices(self._epochs_done),
+                name="trainer.epoch", registry=registry,
+            )
+        k = int(k)
+        modes = jnp.asarray(
+            [self._epoch_mode(self._epochs_done + j) for j in range(k)],
+            dtype=jnp.int32,
+        )
+        return profile_fn(
+            self._build_superstep(k), self._state, self._Xs, self._ys,
+            self._superstep_indices(self._epochs_done, k), modes,
+            name=f"trainer.superstep{k}", registry=registry,
+        )
+
+    def _maybe_profile_costs(self, k: Optional[int] = None) -> None:
+        """Register this program's cost profile once (``profile_costs``)."""
+        key = "epoch" if k is None or int(k) <= 1 else f"superstep{k}"
+        if not self.profile_costs or key in self._cost_profiled:
+            return
+        self._cost_profiled.add(key)
+        self.cost_profile(k)
+
     def train_epoch(self) -> Dict[str, Any]:
         """One epoch: local SGD on every node, then (maybe) gossip."""
         with self._span("trainer.epoch"):
@@ -920,10 +985,17 @@ class GossipTrainer:
     def _train_epoch(self) -> Dict[str, Any]:
         if self._state is None:
             self.initialize_nodes()
+        self._maybe_profile_costs()
         epoch_idx = self._epochs_done
         idx = self._epoch_indices(epoch_idx)
         mixed = False
         rounds: Any = 0
+        # Sampled dispatch timer (obs/cost.py): tick is two host integer
+        # ops; a sampled chunk closes with ONE block_until_ready at the
+        # boundary the carry flush already syncs at.
+        timer = self._cost_timer
+        sampled = timer.tick() if timer is not None else False
+        t0 = time.perf_counter() if sampled else 0.0
         try:
             with self._span("trainer.chunk"):
                 self._state, losses, accs, gnorms = self._jit_epoch(
@@ -960,6 +1032,18 @@ class GossipTrainer:
                 accs = arrs["acc"]
                 gnorms = arrs["grad_norm"]
                 mix_rounds = int(np.asarray(rounds))
+                if sampled:
+                    # The declared 1-in-N chunk-boundary sample: drain
+                    # the (possibly still in-flight) state and record
+                    # step time + MFU/bytes-per-sec off the registered
+                    # trainer.epoch profile.  loop_steps: XLA counts the
+                    # per-step scan body once; the epoch runs it
+                    # epoch_len times.
+                    timer.measure(
+                        self._state, t0, name="trainer.epoch",
+                        loop_steps=self.epoch_len,
+                        step=self._global_step,
+                    )
         except BaseException:
             # BaseException: KeyboardInterrupt mid-epoch must also drop the
             # state, or the next call crashes on deleted arrays.
@@ -1021,6 +1105,20 @@ class GossipTrainer:
             # chunk), so long runs stream metrics; the abstract
             # TelemetryProcessor interface is unchanged — the payload
             # only gained keys (grad_norm, mix_rounds).
+            # Sampled step-time/MFU gauges ride the payloads only when
+            # the timer is configured (keys appear, never change the
+            # base schema; None on unsampled chunks).
+            cost_keys = (
+                {}
+                if self._cost_timer is None
+                else {
+                    "step_time_s": (
+                        self._cost_timer.last_step_time_s if sampled
+                        else None
+                    ),
+                    "mfu": self._cost_timer.last_mfu if sampled else None,
+                }
+            )
             with self._span("trainer.telemetry"):
                 for a, name in enumerate(self.node_names):
                     self.telemetry.process(
@@ -1035,6 +1133,7 @@ class GossipTrainer:
                             else float(test_accs[a]),
                             "mix_rounds": mix_rounds,
                             "deviation": payload["deviation"],
+                            **cost_keys,
                         },
                     )
         return payload
@@ -1191,11 +1290,15 @@ class GossipTrainer:
     def _train_superstep(self, k: int) -> List[Dict[str, Any]]:
         if self._state is None:
             self.initialize_nodes()
+        self._maybe_profile_costs(k)
         epoch0 = self._epochs_done
         idx = self._superstep_indices(epoch0, k)  # ONE host->device copy
         modes_host = [self._epoch_mode(epoch0 + j) for j in range(k)]
         modes = jnp.asarray(modes_host, dtype=jnp.int32)
         fn = self._build_superstep(k)
+        timer = self._cost_timer
+        sampled = timer.tick() if timer is not None else False
+        t0 = time.perf_counter() if sampled else 0.0
         try:
             with self._span("trainer.chunk"):
                 (self._state, losses, accs, gnorms, rounds, dev) = fn(
@@ -1217,6 +1320,22 @@ class GossipTrainer:
                 gnorms = arrs["grad_norm"]
                 rounds_host = np.asarray(rounds)  # (k,)
                 deviation = float(np.asarray(dev))
+                if sampled:
+                    from distributed_learning_tpu.obs.cost import (
+                        get_profile,
+                    )
+
+                    # One sample covers the whole K-epoch dispatch (the
+                    # superstep IS the chunk); MFU comes from the
+                    # matching superstep profile when registered.
+                    # loop_steps: the nested epoch-over-step scans run
+                    # the (once-counted) body k * epoch_len times.
+                    timer.measure(
+                        self._state, t0, name="trainer.superstep",
+                        profile=get_profile(f"trainer.superstep{k}"),
+                        loop_steps=k * self.epoch_len,
+                        step=self._global_step,
+                    )
         except BaseException:
             # Same donation discipline as _train_epoch: the donated input
             # buffers may already be gone; drop the dangling reference.
@@ -1279,6 +1398,17 @@ class GossipTrainer:
                     step=self._global_step,
                 )
         if self.telemetry is not None:
+            cost_keys = (
+                {}
+                if self._cost_timer is None
+                else {
+                    "step_time_s": (
+                        self._cost_timer.last_step_time_s if sampled
+                        else None
+                    ),
+                    "mfu": self._cost_timer.last_mfu if sampled else None,
+                }
+            )
             with self._span("trainer.telemetry"):
                 for payload in payloads:
                     for a, name in enumerate(self.node_names):
@@ -1294,6 +1424,7 @@ class GossipTrainer:
                                 else float(payload["test_acc"][a]),
                                 "mix_rounds": payload["mix_rounds"],
                                 "deviation": payload["deviation"],
+                                **cost_keys,
                             },
                         )
         return payloads

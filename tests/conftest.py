@@ -10,8 +10,21 @@ after import — tests must always run on the virtual CPU mesh.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The perf/health ledgers (obs/cost.py, benchmarks/probe.py) default to
+# repo-root files so driver runs accumulate history; tests must not
+# grow those committed-adjacent artifacts — point both at a throwaway
+# dir unless the environment already pinned them.
+_ledger_dir = tempfile.mkdtemp(prefix="dlt_test_ledgers_")
+os.environ.setdefault(
+    "DLT_PERF_LEDGER", os.path.join(_ledger_dir, "PERF_LEDGER.jsonl")
+)
+os.environ.setdefault(
+    "DLT_TPU_HEALTH", os.path.join(_ledger_dir, "TPU_HEALTH.jsonl")
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -252,16 +252,21 @@ def test_noniid_default_outpath_never_clobbers_canonical(tmp_path, monkeypatch):
                 os.remove(os.path.join(results_dir, f))
 
 
-def test_bench_cpu_fallback_on_wedge():
+def test_bench_cpu_fallback_on_wedge(tmp_path):
     """bench.py's watchdog must convert a dead accelerator backend into
     a parseable, honestly-labeled CPU-platform record (one JSON line,
     rc 0, ``tunnel_wedged`` set) instead of exiting empty-handed —
-    driven end to end via the fake-wedge test hook."""
+    driven end to end via the fake-wedge test hook.  The side ledgers
+    must both record the episode: a ``wedged`` probe outcome in the
+    health ledger and one wedge-labeled perf record (with the fallback
+    run's ``cost`` payload) in the perf ledger."""
     import os
     import subprocess
     import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    health = str(tmp_path / "TPU_HEALTH.jsonl")
+    ledger = str(tmp_path / "PERF_LEDGER.jsonl")
     env = dict(os.environ)
     env.update(
         DLT_BENCH_FAKE_WEDGE="1",
@@ -269,6 +274,8 @@ def test_bench_cpu_fallback_on_wedge():
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
         PYTHONPATH=repo,
+        DLT_TPU_HEALTH=health,
+        DLT_PERF_LEDGER=ledger,
     )
     env.pop("BENCH_FULL", None)
     out = subprocess.run(
@@ -283,6 +290,20 @@ def test_bench_cpu_fallback_on_wedge():
     assert rec["metric"].endswith("_cpu")
     assert rec["value"] > 0
     assert "NOT a TPU measurement" in rec["note"]
+    # The fallback subprocess measured for real: its cost payload rides
+    # the record (flops + peak HBM of the actually-compiled program).
+    assert rec["cost"]["flops"] > 0
+    assert rec["cost"]["peak_hbm_bytes"] > 0
+    # Health ledger: the wedge is a dated probe outcome.
+    probes = [json.loads(l) for l in open(health) if l.strip()]
+    assert any(p["outcome"] == "wedged" for p in probes)
+    # Perf ledger: exactly one record (the child skips appending; the
+    # parent appends the labeled one), marked wedged, cost attached.
+    perf = [json.loads(l) for l in open(ledger) if l.strip()]
+    assert len(perf) == 1
+    assert perf[0]["tunnel_wedged"] is True
+    assert perf[0]["cost"]["flops"] > 0
+    assert perf[0]["env"]["probe"] == "wedged"
 
 
 def test_bench_emit_claim_is_atomic(capsys):

@@ -112,6 +112,15 @@ def main(argv=None) -> int:
         results = audit(write=args.audit_write)
         for name, res in sorted(results.items()):
             line = f"audit {name}: {res['status']}"
+            if res.get("cost"):
+                cost = res["cost"]
+                cols = []
+                if cost.get("flops") is not None:
+                    cols.append(f"flops={cost['flops']:.4g}")
+                if cost.get("peak_bytes") is not None:
+                    cols.append(f"peak_bytes={int(cost['peak_bytes']):,}")
+                if cols:
+                    line += " [cost " + " ".join(cols) + "]"
             if res.get("detail"):
                 line += f" — {res['detail']}"
             print(line, file=sys.stderr)

@@ -31,6 +31,15 @@ Entry points whose code needs a jax API the running environment lacks
 ``status="skip"`` instead of failing: the audit pins the program, not
 the environment.  Regenerate pins after an intentional change with
 ``python -m tools.graftlint --audit --audit-write``.
+
+**Cost columns** (optional, per entry point): entries with a
+``cost_build`` additionally pin the compiled program's XLA-counted
+FLOPs and peak bytes (``obs/cost.py`` extraction) under a relative
+tolerance (``rtol``, default ``COST_RTOL``) — a refactor that silently
+doubles an entry point's FLOPs now fails lint exactly like a
+collective-count drift does, and is repinned the same way
+(``--audit-write``).  The tolerance absorbs backend-version jitter in
+XLA's accounting; a real regression clears it by construction.
 """
 
 from __future__ import annotations
@@ -132,13 +141,22 @@ def _features() -> Dict[str, bool]:
     }
 
 
+#: default relative tolerance for the pinned cost columns.
+COST_RTOL = 0.05
+
+
 class EntryPoint:
     def __init__(self, name: str, kind: str, requires: Tuple[str, ...],
-                 build: Callable[[], Counter]):
+                 build: Callable[[], Counter],
+                 cost_build: Optional[Callable[[], dict]] = None):
         self.name = name
         self.kind = kind  # "jaxpr" | "hlo"
         self.requires = requires
         self.build = build
+        #: optional () -> {"flops": float, "peak_bytes": int} from the
+        #: COMPILED entry point (obs/cost.py extraction); shares the
+        #: entry's feature requirements.
+        self.cost_build = cost_build
 
     def missing_features(self) -> List[str]:
         feats = _features()
@@ -156,6 +174,31 @@ def entry(name: str, *, kind: str, requires: Tuple[str, ...] = ()):
     return deco
 
 
+def cost_entry(name: str):
+    """Attach a cost builder to an already-registered entry point."""
+
+    def deco(fn):
+        ENTRY_POINTS[name].cost_build = fn
+        return fn
+
+    return deco
+
+
+def _compiled_cost(compiled) -> dict:
+    """The pinned cost columns of one compiled program — FLOPs and peak
+    bytes via the shared ``obs/cost.py`` extraction (keys whose value
+    the backend does not report are omitted, not pinned as zero)."""
+    from distributed_learning_tpu.obs.cost import CostProfile
+
+    prof = CostProfile.from_compiled("audit", compiled)
+    out: Dict[str, float] = {}
+    if prof.flops is not None:
+        out["flops"] = float(prof.flops)
+    if prof.peak_bytes is not None:
+        out["peak_bytes"] = int(prof.peak_bytes)
+    return out
+
+
 def _mesh(shape, names):
     import jax
     import numpy as np
@@ -167,11 +210,17 @@ def _mesh(shape, names):
     return Mesh(np.array(jax.devices()[:n]).reshape(*shape), names)
 
 
-@entry("tp_train_step", kind="hlo")
-def _tp_train_step() -> Counter:
-    """DP x TP LM step on a (2, 2) mesh: every collective is inserted by
-    the XLA partitioner from the megatron shardings, so the pin is on
-    the compiled HLO (the tests/test_tp.py counting pattern)."""
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _tp_step_compiled():
+    """The DP x TP LM step, AOT-compiled on a (2, 2) mesh — shared by
+    the inventory and cost builders (the ``InstrumentedStep`` wrapper
+    delegates ``lower``/``compile``, so no unwrapping).  Cached: the
+    fixture is a pure function of the source, and audit + cost + the
+    cost-pin tests would otherwise recompile it several times per
+    process."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -191,8 +240,23 @@ def _tp_train_step() -> Counter:
     tx = optax.sgd(0.1)
     opt = tx.init(params)
     step = make_tp_train_step(mesh, model, tx)
-    hlo = step.lower(params, opt, x, y).compile().as_text()
-    return collect_hlo_collectives(hlo)
+    return step.lower(params, opt, x, y).compile()
+
+
+@entry("tp_train_step", kind="hlo")
+def _tp_train_step() -> Counter:
+    """DP x TP LM step on a (2, 2) mesh: every collective is inserted by
+    the XLA partitioner from the megatron shardings, so the pin is on
+    the compiled HLO (the tests/test_tp.py counting pattern)."""
+    return collect_hlo_collectives(_tp_step_compiled().as_text())
+
+
+@cost_entry("tp_train_step")
+def _tp_train_step_cost() -> dict:
+    """Cost columns of the same compiled step: FLOPs and peak bytes —
+    a refactor that keeps the collective inventory but doubles the
+    step's compute (e.g. an accidental extra forward) drifts here."""
+    return _compiled_cost(_tp_step_compiled())
 
 
 @entry("pp_1f1b_head_fn", kind="jaxpr", requires=("shard_map", "pcast"))
@@ -229,20 +293,9 @@ def _pp_1f1b_head_fn() -> Counter:
     return collect_collectives(jx.jaxpr)
 
 
-@entry("consensus_mix_until", kind="jaxpr", requires=("shard_map",))
-def _consensus_mix_until() -> Counter:
-    """The sharded eps-stopping gossip loop (ConsensusEngine.mix_until
-    on a ring(8) mesh engine) over a FOUR-leaf, two-dtype-bucket state.
-
-    This is the fused flat-buffer pin: the while body moves one ppermute
-    per matching per dtype BUCKET (2 matchings x 2 buckets = 4) and the
-    residual is one pmean (psum) per bucket per evaluation (2 buckets x
-    2 evaluations = 4) plus the pmax — independent of the leaf count.
-    The per-leaf program would scale every entry with the 4 leaves
-    (8 ppermutes, 8 psums); a pin drift back to leaf-proportional counts
-    means the fused layout silently stopped engaging.
-    """
-    import jax
+def _mix_until_fixture():
+    """(callable, state) for the sharded eps-stopping gossip loop —
+    shared by the inventory (jaxpr) and cost (compiled) builders."""
     import jax.numpy as jnp
 
     from distributed_learning_tpu.parallel.consensus import ConsensusEngine
@@ -258,10 +311,39 @@ def _consensus_mix_until() -> Counter:
         "s": jnp.zeros((8,), jnp.float32),
         "h": jnp.ones((8, 3), jnp.bfloat16),
     }
-    jx = jax.make_jaxpr(
-        lambda s: engine.mix_until(s, eps=1e-6, max_rounds=32)[0]
-    )(x)
+    return (
+        lambda s: engine.mix_until(s, eps=1e-6, max_rounds=32)[0], x
+    )
+
+
+@entry("consensus_mix_until", kind="jaxpr", requires=("shard_map",))
+def _consensus_mix_until() -> Counter:
+    """The sharded eps-stopping gossip loop (ConsensusEngine.mix_until
+    on a ring(8) mesh engine) over a FOUR-leaf, two-dtype-bucket state.
+
+    This is the fused flat-buffer pin: the while body moves one ppermute
+    per matching per dtype BUCKET (2 matchings x 2 buckets = 4) and the
+    residual is one pmean (psum) per bucket per evaluation (2 buckets x
+    2 evaluations = 4) plus the pmax — independent of the leaf count.
+    The per-leaf program would scale every entry with the 4 leaves
+    (8 ppermutes, 8 psums); a pin drift back to leaf-proportional counts
+    means the fused layout silently stopped engaging.
+    """
+    import jax
+
+    fn, x = _mix_until_fixture()
+    jx = jax.make_jaxpr(fn)(x)
     return collect_collectives(jx.jaxpr)
+
+
+@cost_entry("consensus_mix_until")
+def _consensus_mix_until_cost() -> dict:
+    """Cost columns of the compiled eps-stopping loop (same fixture as
+    the inventory pin)."""
+    import jax
+
+    fn, x = _mix_until_fixture()
+    return _compiled_cost(jax.jit(fn).lower(x).compile())
 
 
 @entry("gossip_superstep", kind="jaxpr", requires=("shard_map",))
@@ -319,6 +401,50 @@ def _gossip_superstep() -> Counter:
     return collect_collectives(jx.jaxpr)
 
 
+@cost_entry("gossip_superstep")
+def _gossip_superstep_cost() -> dict:
+    """Cost columns of the compiled K=3 superstep: the trainer's own
+    ``cost_profile(k)`` extraction on the same fixture the inventory
+    pin traces — a fusion regression that re-dispatches per epoch
+    leaves the collectives flat but moves these numbers."""
+    import numpy as np
+
+    from distributed_learning_tpu.parallel.consensus import make_agent_mesh
+    from distributed_learning_tpu.parallel.topology import Topology
+    from distributed_learning_tpu.training.trainer import GossipTrainer
+
+    n, k = 8, 3
+    rng = np.random.default_rng(0)
+    train = {
+        i: (
+            rng.normal(size=(32, 6)).astype(np.float32),
+            rng.integers(0, 3, size=(32,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+    tr = GossipTrainer(
+        node_names=list(range(n)),
+        model="mlp",
+        model_kwargs={"hidden_dim": 8, "output_dim": 3},
+        weights=Topology.ring(n),
+        train_data=train,
+        batch_size=8,
+        epoch_len=2,
+        mix_times=2,
+        dropout=False,
+        mesh=make_agent_mesh(n),
+        superstep=k,
+    )
+    tr.initialize_nodes()
+    prof = tr.cost_profile(k)
+    out = {}
+    if prof.flops is not None:
+        out["flops"] = float(prof.flops)
+    if prof.peak_bytes is not None:
+        out["peak_bytes"] = int(prof.peak_bytes)
+    return out
+
+
 @entry("choco_run_fused", kind="jaxpr", requires=("shard_map",))
 def _choco_run_fused() -> Counter:
     """A compressed (CHOCO) gossip round on the fused carry, sharded over
@@ -364,6 +490,26 @@ def _choco_run_fused() -> Counter:
     return collect_collectives(jx.jaxpr)
 
 
+def _cost_drift(exp_cost: Optional[dict],
+                obs_cost: Optional[dict]) -> List[str]:
+    """Human-readable drifts of the pinned cost columns beyond their
+    relative tolerance (empty when unpinned, unobserved, or in-tol)."""
+    if not exp_cost or not obs_cost:
+        return []
+    rtol = float(exp_cost.get("rtol", COST_RTOL))
+    out: List[str] = []
+    for key in ("flops", "peak_bytes"):
+        e, o = exp_cost.get(key), obs_cost.get(key)
+        if e is None or o is None:
+            continue
+        if abs(float(o) - float(e)) > rtol * max(abs(float(e)), 1.0):
+            out.append(
+                f"{key} {float(e):g} -> {float(o):g} "
+                f"(beyond the {rtol:.0%} tolerance)"
+            )
+    return out
+
+
 def load_expected(path: str = EXPECTED_PATH) -> Dict[str, dict]:
     with open(path, "r", encoding="utf-8") as fh:
         return json.load(fh)
@@ -405,34 +551,69 @@ def audit(
                 "detail": f"{type(exc).__name__}: {exc}",
             }
             continue
-        exp = expected.get(name, {}).get("inventory")
+        observed_cost: Optional[dict] = None
+        if ep.cost_build is not None:
+            try:
+                observed_cost = ep.cost_build() or None
+            except Exception as exc:
+                results[name] = {
+                    "status": "error",
+                    "detail": (
+                        f"cost columns failed: "
+                        f"{type(exc).__name__}: {exc}"
+                    ),
+                }
+                continue
+        exp_entry = expected.get(name, {})
+        exp = exp_entry.get("inventory")
         if write or exp is None:
             expected[name] = {
                 "kind": ep.kind,
                 "inventory": observed,
                 "verified": True,
             }
+            if observed_cost:
+                expected[name]["cost"] = {
+                    **observed_cost, "rtol": COST_RTOL,
+                }
             results[name] = {
                 "status": "ok" if write else "unpinned",
                 "observed": observed,
             }
+            if observed_cost:
+                results[name]["cost"] = observed_cost
             continue
-        if observed == exp:
+        drift = _cost_drift(exp_entry.get("cost"), observed_cost)
+        if observed == exp and not drift:
             results[name] = {"status": "ok", "observed": observed}
+            if observed_cost:
+                results[name]["cost"] = observed_cost
         else:
             gone = {k: v for k, v in exp.items() if observed.get(k) != v}
             new = {k: v for k, v in observed.items() if exp.get(k) != v}
+            parts = []
+            if observed != exp:
+                parts.append(
+                    f"collective inventory drift in {name}: expected "
+                    f"{gone or '{}'} but observed {new or '{}'}"
+                )
+            if drift:
+                parts.append(
+                    f"cost drift in {name}: " + "; ".join(drift)
+                )
             results[name] = {
                 "status": "mismatch",
                 "observed": observed,
                 "expected": exp,
                 "detail": (
-                    f"collective inventory drift in {name}: expected "
-                    f"{gone or '{}'} but observed {new or '{}'} — if the "
-                    "change is intentional, regenerate the pin with "
-                    "'python -m tools.graftlint --audit --audit-write'"
+                    " — ".join(parts)
+                    + " — if the change is intentional, regenerate the "
+                    "pin with 'python -m tools.graftlint --audit "
+                    "--audit-write'"
                 ),
             }
+            if observed_cost:
+                results[name]["cost"] = observed_cost
     if write:
         with open(expected_path, "w", encoding="utf-8") as fh:
             json.dump(expected, fh, indent=2, sort_keys=True)
