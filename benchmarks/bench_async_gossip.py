@@ -1,0 +1,181 @@
+"""Straggler gate of the asynchronous gossip runtime (ISSUE 8).
+
+Lock-step gossip runs at the pace of the slowest agent: with one of 4
+loopback agents injected 10x slow, every ``run_once`` round costs the
+straggler's compute time on ALL agents.  The async runtime
+(``comm/async_runtime.py``) lets the fast agents mix the straggler's
+last *received* state at staleness-decayed weight (bound tau, deadline-
+bounded waits) and keep their own pace — the straggler costs its own
+progress only.
+
+Measured here on the real TCP loopback wire, compute injected as
+``asyncio.sleep`` (base 5 ms, straggler 50 ms — sleep-dominated, so
+shared-CI scheduling noise stays second order):
+
+* ``lockstep_rounds_per_sec`` — ``run_once`` rounds, all 4 agents in
+  lock step (each round waits for the straggler).
+* ``async_rounds_per_sec`` — async rounds of the FAST agents
+  (tau=2, deadline 10 ms): the straggler is mixed while its staleness
+  is within bound, dropped-and-poked beyond it.
+
+**Gate (acceptance): async >= 2x lock-step.**  Expected ~5-8x — the
+fast agents' round time falls from ~the straggler's 50 ms to ~their own
+5 ms.  The tier-1 rot guard in ``tests/test_benchmarks.py`` gates at
+the same 2x (the margin is several-x, and both sides time the same
+injected sleeps).  Also recorded: the straggler's own completed rounds
+and the staleness counters (``comm.agent.async_stale_mixed`` /
+``async_stale_dropped`` / ``pokes_sent``) — the observability the
+convergence-vs-staleness analysis reads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict
+
+import numpy as np
+
+from benchmarks import common
+from distributed_learning_tpu.comm import (
+    AsyncGossipRunner,
+    ConsensusAgent,
+    ConsensusMaster,
+)
+
+RING4 = [("1", "2"), ("2", "3"), ("3", "4"), ("4", "1")]
+TOKENS = ("1", "2", "3", "4")
+SLOW = "4"
+
+
+async def _deploy():
+    master = ConsensusMaster(RING4, convergence_eps=1e-6)
+    host, port = await master.start()
+    agents = {t: ConsensusAgent(t, host, port) for t in TOKENS}
+    await asyncio.gather(*(a.start() for a in agents.values()))
+    return master, agents
+
+
+async def _teardown(master, agents):
+    await master.shutdown()
+    for a in agents.values():
+        await a.close(drain=0.1)
+
+
+def _values() -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(8)
+    return {t: rng.normal(size=64).astype(np.float32) for t in TOKENS}
+
+
+async def _lockstep(rounds: int, base_s: float, slow_s: float) -> float:
+    master, agents = await _deploy()
+    vals = dict(_values())
+
+    async def one(t):
+        # Injected local compute, then the synchronous exchange: the
+        # per-round barrier IS the lock-step model being measured —
+        # every agent's round completes at the straggler's pace.
+        await asyncio.sleep(slow_s if t == SLOW else base_s)
+        vals[t] = await agents[t].run_once(vals[t])
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        await asyncio.gather(*(one(t) for t in TOKENS))
+    elapsed = time.perf_counter() - t0
+    await _teardown(master, agents)
+    return rounds / elapsed
+
+
+async def _async_mode(
+    rounds: int, base_s: float, slow_s: float,
+    tau: int, deadline_s: float,
+):
+    master, agents = await _deploy()
+    runners = {
+        t: AsyncGossipRunner(
+            agents[t], staleness_bound=tau, deadline_s=deadline_s
+        )
+        for t in TOKENS
+    }
+    vals = _values()
+    stop = asyncio.Event()
+
+    async def fast(t):
+        x = vals[t]
+        for _ in range(rounds):
+            x = await runners[t].run_async_round(
+                x, local=lambda: asyncio.sleep(base_s)
+            )
+        return x
+
+    async def slow(t):
+        x = vals[t]
+        while not stop.is_set():
+            x = await runners[t].run_async_round(
+                x, local=lambda: asyncio.sleep(slow_s)
+            )
+        return x
+
+    t0 = time.perf_counter()
+    slow_task = asyncio.ensure_future(slow(SLOW))
+    await asyncio.gather(*(fast(t) for t in TOKENS if t != SLOW))
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    await slow_task
+    rate = rounds / elapsed
+    counters = {
+        name: sum(a.counters.get(name, 0) for a in agents.values())
+        for name in (
+            "async_stale_mixed", "async_stale_dropped",
+            "async_deadline_drops", "pokes_sent",
+        )
+    }
+    slow_rounds = runners[SLOW].round
+    await _teardown(master, agents)
+    return rate, slow_rounds, counters
+
+
+def run(
+    rounds: int | None = None,
+    base_s: float = 0.005,
+    slow_s: float = 0.05,
+    tau: int = 2,
+    deadline_s: float = 0.01,
+) -> dict:
+    """Lock-step vs async rounds/sec with the 10x straggler; emits one
+    record with the >= 2x gate verdict."""
+    if rounds is None:
+        rounds = 12 if common.smoke() else 40
+
+    async def main():
+        lock = await _lockstep(rounds, base_s, slow_s)
+        rate, slow_rounds, counters = await _async_mode(
+            rounds, base_s, slow_s, tau, deadline_s
+        )
+        return lock, rate, slow_rounds, counters
+
+    lock, rate, slow_rounds, counters = asyncio.run(
+        asyncio.wait_for(main(), 600)
+    )
+    speedup = rate / lock
+    return common.emit(
+        {
+            "bench": "async_gossip_straggler",
+            "lockstep_rounds_per_sec": lock,
+            "async_rounds_per_sec": rate,
+            "async_speedup": speedup,
+            "gate": 2.0,
+            "gate_passed": bool(speedup >= 2.0),
+            "rounds": rounds,
+            "straggler_rounds": slow_rounds,
+            "staleness_bound": tau,
+            "deadline_s": deadline_s,
+            "base_compute_s": base_s,
+            "straggler_compute_s": slow_s,
+            **{f"counters.{k}": v for k, v in counters.items()},
+        }
+    )
+
+
+if __name__ == "__main__":
+    run()

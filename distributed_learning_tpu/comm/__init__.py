@@ -23,6 +23,10 @@ from distributed_learning_tpu.comm.agent import (
     RoundAbortedError,
     ShutdownError,
 )
+from distributed_learning_tpu.comm.async_runtime import (
+    AsyncGossipRunner,
+    AsyncRoundStats,
+)
 from distributed_learning_tpu.comm.framing import FramedStream, FrameError, open_framed_connection
 from distributed_learning_tpu.comm.master import ConsensusMaster
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
@@ -62,6 +66,8 @@ def top_k_compressor(fraction: float):
 
 __all__ = [
     "AgentStatus",
+    "AsyncGossipRunner",
+    "AsyncRoundStats",
     "ConsensusAgent",
     "ConsensusMaster",
     "FramedStream",

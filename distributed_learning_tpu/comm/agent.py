@@ -129,6 +129,22 @@ class ConsensusAgent:
         self._tag_realigned = not self.rejoin
         self._ever_connected: set = set()
         self._in_master_round = False
+        # Membership generation (docs/async_runtime.md): the version of
+        # the (topology, W) epoch this agent's weight table reflects.  A
+        # regenerating elastic master bumps it on every death/(re)join
+        # and broadcasts fresh NeighborhoodData; _apply_neighborhood
+        # realigns the weight/stream sets to it mid-run — the
+        # _require_realigned machinery generalized from a static graph
+        # to a counter.
+        self._generation = 0
+        # Tokens a deadline-enforcing master dropped from the CURRENT
+        # round (NewRoundNotification.dropped): their edges get zero
+        # weight this round, the mass stays on self.
+        self._round_excluded: set = set()
+        # Wire-level resilience (FramedStream): transient socket errors
+        # on send retry with bounded exponential backoff instead of
+        # aborting the round; every retry counts as comm.agent.retries.
+        self._send_retries = 3
         self.debug = debug
         self.status = AgentStatus.NEW
 
@@ -157,6 +173,16 @@ class ConsensusAgent:
         self._iteration = -1
         self._iter_value: Optional[np.ndarray] = None
         self._prev_value: Optional[np.ndarray] = None
+        # Exact wire tags of the two held values.  Answering by TAG
+        # (not by "same op, one iteration back" arithmetic) keeps the
+        # exchange live across an OP boundary too: a neighbor that
+        # finished op k off our deferred answer and entered k+1 may ask
+        # for our op-k value after we also moved on — _prev_value IS
+        # that value, and dropping the request as stale would deadlock
+        # un-barriered masterless sequences (skew is bounded by 1: a
+        # neighbor cannot finish op k+1 before we reach it).
+        self._iter_key: Tuple[int, int] = (-1, -1)
+        self._prev_key: Tuple[int, int] = (-2, -1)
         # Two-slot (array, sparse-beats-dense) memo for _sparse_wins.
         self._sparse_cache: list = [(None, False), (None, False)]
         # Fused tree gossip (run_choco_tree): the TreeSpec of the gossiped
@@ -221,6 +247,23 @@ class ConsensusAgent:
         if self._obs is not None and self._obs is not get_registry():
             self._obs.inc(f"comm.agent.{name}", value)
 
+    def _observe(self, name: str, value: float, step=None) -> None:
+        """Series point into the default registry (and the per-agent
+        ``obs=`` registry) — the staleness histogram channel."""
+        get_registry().observe(name, value, step=step)
+        if self._obs is not None and self._obs is not get_registry():
+            self._obs.observe(name, value, step=step)
+
+    def _on_stream_retry(self) -> None:
+        """FramedStream retry hook: a transient socket error was retried
+        instead of aborting the round."""
+        self._count("retries")
+
+    @property
+    def generation(self) -> int:
+        """Membership generation this agent's weight table reflects."""
+        return self._generation
+
     def wire_stats(self) -> Dict[str, int]:
         """Whole-frame byte/frame totals over this agent's live streams
         (master + neighbors) — the per-process "bytes framed" view of
@@ -250,7 +293,11 @@ class ConsensusAgent:
 
         deadline = asyncio.get_event_loop().time() + timeout
         while True:
-            self._master = await open_framed_connection(*self.master_addr)
+            self._master = await open_framed_connection(
+                *self.master_addr,
+                send_retries=self._send_retries,
+                on_retry=self._on_stream_retry,
+            )
             await self._master.send(
                 P.Register(token=self.token, host=self.host, port=self.port)
             )
@@ -282,43 +329,109 @@ class ConsensusAgent:
             raise ShutdownError(msg.reason)
         if not isinstance(msg, P.NeighborhoodData):
             raise ConnectionError(f"expected NeighborhoodData, got {msg}")
-        self.self_weight = msg.self_weight
-        self.convergence_eps = msg.convergence_eps
-        self._weights = {nb.token: nb.weight for nb in msg.neighbors}
-        self._expected_peers = (
-            set()
-            if self.rejoin
-            else {nb.token for nb in msg.neighbors if nb.token < self.token}
-        )
-        self._nbhd_ready.set()
-
-        # Deterministic peer handshake: the lexicographically smaller token
-        # accepts, the larger connects (the reference uses registration
-        # order for the same purpose, agent.py:137-150).  A rejoiner dials
-        # everyone — its peers' listeners replace their dead streams.
-        for nb in msg.neighbors:
-            if nb.port == 0:
-                # Neighbor is itself down (elastic master marks its stale
-                # address with port 0); its replacement dials us on rejoin.
-                continue
-            if self.rejoin or nb.token > self.token:
-                stream = await open_framed_connection(nb.host, nb.port)
-                await stream.send(
-                    P.Register(token=self.token, host=self.host, port=self.port)
-                )
-                reply = await asyncio.wait_for(stream.recv(), timeout)
-                if not isinstance(reply, P.Ok):
-                    raise ConnectionError(
-                        f"peer {nb.token} rejected handshake: {reply}"
-                    )
-                self._add_neighbor(nb.token, stream)
+        await self._apply_neighborhood(msg, timeout=timeout)
         if self._expected_peers:
             await asyncio.wait_for(self._peers_ready.wait(), timeout)
         self.status = AgentStatus.READY
         self._debug("ready; neighbors=%s", sorted(self._neighbors))
 
+    async def _apply_neighborhood(
+        self, msg: P.NeighborhoodData, *, timeout: float = 30.0
+    ) -> None:
+        """Install a neighborhood: the initial handshake AND mid-run
+        membership-generation broadcasts (a regenerating elastic master
+        re-forms the topology and re-solves W on every death/(re)join).
+
+        Weight table, eps, and generation counter are replaced; streams
+        of removed edges close; NEW edges handshake by the usual rule —
+        the lexicographically smaller token accepts, the larger connects
+        (the reference uses registration order for the same purpose,
+        agent.py:137-150); a rejoiner's initial apply dials everyone.
+        A mid-run generation change also suspends masterless collectives
+        until the next master round re-derives the shared op tag."""
+        initial = not self._nbhd_ready.is_set()
+        old_gen = self._generation
+        self.self_weight = msg.self_weight
+        self.convergence_eps = msg.convergence_eps
+        self._generation = msg.generation
+        new_weights = {nb.token: nb.weight for nb in msg.neighbors}
+        removed = set(self._weights) - set(new_weights)
+        self._weights = new_weights
+        if initial:
+            self._expected_peers = (
+                set()
+                if self.rejoin
+                else {
+                    nb.token for nb in msg.neighbors
+                    if nb.token < self.token
+                }
+            )
+            self._nbhd_ready.set()
+        elif msg.generation != old_gen:
+            self._count("generation_updates")
+            # Op counters across the membership change no longer agree;
+            # the next master round re-derives the tag for everyone.
+            self._tag_realigned = False
+            self._debug(
+                "membership generation %s -> %s; neighbors now %s",
+                old_gen, msg.generation, sorted(new_weights),
+            )
+        for token in removed:
+            dead = self._neighbors.pop(token, None)
+            if dead is not None:
+                self._mux.remove(token)
+                dead.close()
+        for nb in msg.neighbors:
+            if nb.port == 0 or nb.token in self._neighbors:
+                # port 0: the master flags a peer that will dial IN (a
+                # down agent's stale address, or this generation's fresh
+                # (re)joiner) — never dial it.
+                continue
+            dial = (
+                (self.rejoin or nb.token > self.token)
+                if initial
+                else nb.token > self.token
+            )
+            if dial:
+                await self._dial_peer(nb, timeout)
+
+    async def _dial_peer(self, nb: P.Neighbor, timeout: float) -> None:
+        """Open + handshake one peer stream, retrying a bounded number of
+        rejections — a peer reached before ITS copy of the (new)
+        neighborhood arrived legitimately answers "unexpected peer"."""
+        last = None
+        for _ in range(20):
+            stream = await open_framed_connection(
+                nb.host, nb.port,
+                send_retries=self._send_retries,
+                on_retry=self._on_stream_retry,
+            )
+            await stream.send(
+                P.Register(token=self.token, host=self.host, port=self.port)
+            )
+            try:
+                reply = await asyncio.wait_for(stream.recv(), timeout)
+            except (ConnectionError, asyncio.IncompleteReadError) as e:
+                stream.close()
+                last = e
+                await asyncio.sleep(0.05)
+                continue
+            if isinstance(reply, P.Ok):
+                self._add_neighbor(nb.token, stream)
+                return
+            stream.close()
+            last = reply
+            await asyncio.sleep(0.05)
+        raise ConnectionError(
+            f"peer {nb.token} kept rejecting the handshake: {last}"
+        )
+
     async def _handle_peer(self, reader, writer):
-        stream = FramedStream(reader, writer)
+        stream = FramedStream(
+            reader, writer,
+            send_retries=self._send_retries,
+            on_retry=self._on_stream_retry,
+        )
         try:
             msg = await stream.recv()
             # A legitimate neighbor may dial in before OUR copy of the
@@ -366,6 +479,7 @@ class ConsensusAgent:
             # until a master round re-aligns everyone — symmetric to the
             # rejoiner's own guard.
             self._tag_realigned = False
+            self._count("reconnects")
         self._ever_connected.add(token)
         self._neighbors[token] = stream
         self._mux.add(token, stream)
@@ -374,18 +488,21 @@ class ConsensusAgent:
     # Gossip iterations                                                  #
     # ------------------------------------------------------------------ #
     async def _answer(self, token: str, req: P.ValueRequest) -> None:
-        """Answer a neighbor's value request — now if it targets our
-        current iteration, later (deferred) if it's one ahead, never if it
-        is stale (round/iteration tagging, consensus_asyncio.py:276-278)."""
+        """Answer a neighbor's value request — now if it targets one of
+        the two held values (current, or the previous iteration/op the
+        neighbor is still mixing against), later (deferred) if it's
+        ahead, never if it is older than both (round/iteration tagging,
+        consensus_asyncio.py:276-278)."""
         key = (req.round_id, req.iteration)  # wire round_id carries op_id
-        if key == (self._op_id, self._iteration):
+        if key == self._iter_key:
             value = self._iter_value
-        elif key == (self._op_id, self._iteration - 1):
-            # A neighbor one iteration behind (lockstep skew across an edge
-            # within one op is at most 1): answer with the value it is
-            # mixing against.
+        elif key == self._prev_key:
+            # A neighbor one step behind (lockstep skew across an edge —
+            # within an op, or across an op boundary it crossed off our
+            # deferred answer — is at most 1): answer with the value it
+            # is mixing against.
             value = self._prev_value
-        elif key > (self._op_id, self._iteration):
+        elif key > self._iter_key:
             self._count("requests_deferred")
             self._deferred.setdefault(key, []).append(token)
             return
@@ -453,8 +570,11 @@ class ConsensusAgent:
     async def _flush_deferred(self) -> None:
         key = (self._op_id, self._iteration)
         for token in self._deferred.pop(key, []):
+            stream = self._neighbors.get(token)
+            if stream is None:
+                continue  # edge removed by a membership generation
             self._count("responses_sent")
-            await self._neighbors[token].send(
+            await stream.send(
                 self._make_response(
                     self._op_id, self._iteration, self._iter_value
                 )
@@ -463,38 +583,66 @@ class ConsensusAgent:
         for k in [k for k in self._deferred if k < key]:
             del self._deferred[k]
 
+    def _active_tokens(self) -> list:
+        """Neighbors participating in the current exchange: weighted,
+        connected, and not dropped from this round by a deadline-
+        enforcing master.  Sorted — mixing accumulates in this order on
+        every agent, so results are reproducible across runs (and the
+        async runtime's lock-step oracle can be bit-exact)."""
+        return sorted(
+            t for t in self._weights
+            if t in self._neighbors and t not in self._round_excluded
+        )
+
     async def _gossip_iteration(self, y: np.ndarray) -> Optional[np.ndarray]:
         """One symmetric exchange + mix:
         ``y <- (1 - sum_j w_j) y + sum_j w_j y_j`` (parity: run_once's
-        update, agent.py:204-207).  Returns None if Done/Shutdown arrived
+        update, agent.py:204-207), accumulated in sorted-token order.
+        Neighbors a deadline-enforcing master dropped from this round
+        keep their edge weight on OUR value instead (``w_j * y``) — the
+        wire-level mirror of
+        :func:`~distributed_learning_tpu.ops.mixing.presence_weight_matrix`:
+        the row still sums to one.  Returns None if Done/Shutdown arrived
         mid-iteration (round aborted by the master)."""
         self._count("gossip_iterations")
-        values = await self._exchange_values(y)
+        active = self._active_tokens()
+        values = await self._exchange_values(y, active)
         if values is None:
             return None
         total_w = sum(self._weights.values())
         out = (1.0 - total_w) * y
-        for token, v in values.items():
-            out = out + self._weights[token] * v
+        for token in sorted(values):
+            out = out + self._weights[token] * values[token]
+        for token in sorted(set(self._weights) - set(values)):
+            # Dropped-from-round neighbor: its mass renormalizes to self.
+            out = out + self._weights[token] * y
         return out
 
     async def _exchange_values(
-        self, y: np.ndarray
+        self, y: np.ndarray, active: Optional[list] = None
     ) -> Optional[Dict[str, np.ndarray]]:
         """Symmetric per-iteration exchange: publish ``y`` as this
-        iteration's value, collect every neighbor's.  Returns None if a
-        master Done ended the round mid-exchange."""
+        iteration's value, collect every active neighbor's.  Returns None
+        if a master Done ended the round mid-exchange."""
+        if active is None:
+            active = self._active_tokens()
         self._prev_value = self._iter_value
+        self._prev_key = self._iter_key
         self._iter_value = y
+        self._iter_key = (self._op_id, self._iteration)
         await self._flush_deferred()
         req = P.ValueRequest(round_id=self._op_id, iteration=self._iteration)
-        for stream in self._neighbors.values():
-            await stream.send(req)
+        for token in active:
+            await self._neighbors[token].send(req)
 
         values: Dict[str, np.ndarray] = {}
         done_seen = False
-        while len(values) < len(self._neighbors):
+        while len(values) < len(active):
             token, msg, src = await self._recv_any()
+            if msg is None and token not in self._weights:
+                # A stream an old membership generation removed died:
+                # nobody mixes with it any more — old news, keep going.
+                continue
             if msg is None:
                 # Multiplexer sentinel: a neighbor connection died.  It can
                 # be STALE: produced (inside the persistent _recv_any read)
@@ -536,7 +684,7 @@ class ConsensusAgent:
                     P.ValueResponseFusedSparse,
                 ),
             ):
-                if (msg.round_id, msg.iteration) == (
+                if token in active and (msg.round_id, msg.iteration) == (
                     self._op_id,
                     self._iteration,
                 ):
@@ -599,14 +747,40 @@ class ConsensusAgent:
         self._master_task = None
         return msg
 
+    async def _drain_membership_updates(self, timeout: float = 0.0) -> None:
+        """Apply already-delivered master messages between rounds —
+        membership-generation NeighborhoodData broadcasts land here;
+        stale Done/notification frames are dropped.  Bounded by
+        ``timeout`` seconds of waiting for a first/next frame."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while self._master is not None:
+            if self._master_task is None:
+                self._master_task = asyncio.ensure_future(self._master.recv())
+                self._master_task.add_done_callback(self._silence)
+            remaining = deadline - loop.time()
+            done, _ = await asyncio.wait(
+                {self._master_task}, timeout=max(0.0, remaining)
+            )
+            if not done:
+                return
+            task, self._master_task = self._master_task, None
+            msg = task.result()
+            if isinstance(msg, P.NeighborhoodData):
+                await self._apply_neighborhood(msg)
+            elif isinstance(msg, P.Shutdown):
+                self.status = AgentStatus.SHUTDOWN
+                raise ShutdownError(msg.reason)
+            # else: stale Done / notification from a finished round.
+
     # ------------------------------------------------------------------ #
     def _require_realigned(self) -> None:
         if not self._tag_realigned:
             raise RuntimeError(
-                "gossip tags are not aligned (this agent rejoined, or a "
-                "neighbor reconnected with fresh state): one master "
-                "run_round re-aligns every agent; a masterless collective "
-                "now would deadlock"
+                "gossip tags are not aligned (this agent rejoined, a "
+                "neighbor reconnected with fresh state, or the membership "
+                "generation changed): one master run_round re-aligns "
+                "every agent; a masterless collective now would deadlock"
             )
 
     async def run_once(self, value: np.ndarray) -> np.ndarray:
@@ -665,14 +839,21 @@ class ConsensusAgent:
         assert neighbor_qs is not None  # no master Done in masterless mode
         return self._choco_finish(x, q, neighbor_qs, gamma)
 
-    def _choco_begin(self, value: np.ndarray) -> np.ndarray:
+    def _choco_begin(
+        self, value: np.ndarray, *, require_aligned: bool = True
+    ) -> np.ndarray:
         """Shared CHOCO preamble: readiness/realignment/invalidation
         guards, flatten to the f32 wire vector, lazy zero-init of the
-        replicated estimates."""
+        replicated estimates.  ``require_aligned=False`` is the async
+        runtime's entry: its correction streams are per-neighbor FIFOs
+        applied in arrival order, so op-tag alignment is not part of
+        their contract (generation tags on the frames gate membership
+        epochs instead)."""
         if self.status not in (AgentStatus.READY, AgentStatus.IN_ROUND):
             raise RuntimeError(f"agent not ready (status={self.status})")
         self._require_neighbors()
-        self._require_realigned()
+        if require_aligned:
+            self._require_realigned()
         if self._choco_invalidated_by is not None:
             raise RuntimeError(
                 f"CHOCO estimates invalidated: neighbor "
@@ -730,10 +911,13 @@ class ConsensusAgent:
         self, x: np.ndarray, q: np.ndarray, neighbor_qs, gamma: float
     ) -> np.ndarray:
         """Shared CHOCO epilogue: apply the exchanged corrections to the
-        replicated estimates and step the iterate."""
+        replicated estimates and step the iterate — in sorted-token
+        order, so the recurrence is reproducible across runs and the
+        async runtime's tau=0 oracle can be bit-exact."""
         self._choco_hat_self = self._choco_hat_self + q
         out = x.copy()
-        for t, qn in neighbor_qs.items():
+        for t in sorted(neighbor_qs):
+            qn = neighbor_qs[t]
             self._choco_hat_nbrs[t] = self._choco_hat_nbrs[t] + np.asarray(
                 qn, np.float32
             ).ravel()
@@ -885,7 +1069,15 @@ class ConsensusAgent:
         """
         if self.status is not AgentStatus.READY:
             raise RuntimeError(f"agent not ready (status={self.status})")
-        self._require_neighbors()
+        try:
+            self._require_neighbors()
+        except ConnectionError:
+            # The weight table may be ahead of the stream set because a
+            # membership-generation broadcast is still queued on the
+            # master stream (a regenerating master re-formed the
+            # topology): apply what already arrived, then re-check.
+            await self._drain_membership_updates(0.2)
+            self._require_neighbors()
         self.status = AgentStatus.IN_ROUND
         # Round latency: duration on the monotonic clock (graftlint
         # wallclock-duration), start anchored to the wall clock so the
@@ -898,11 +1090,28 @@ class ConsensusAgent:
                 msg = await self._master_recv()
                 if isinstance(msg, P.NewRoundNotification):
                     break
+                if isinstance(msg, P.NeighborhoodData):
+                    # Membership generation broadcast (the master sends
+                    # it BEFORE the round it applies to, on this ordered
+                    # stream): realign, keep waiting for the round.
+                    await self._apply_neighborhood(msg)
+                    continue
                 if isinstance(msg, P.Shutdown):
                     raise ShutdownError(msg.reason)
                 if isinstance(msg, P.ErrorException):
                     raise RuntimeError(f"master: {msg.message}")
                 # Anything else (e.g. a stale Done) is dropped.
+            if msg.generation != self._generation:
+                raise ConnectionError(
+                    f"round {msg.round_id} is for membership generation "
+                    f"{msg.generation}, this agent is at "
+                    f"{self._generation}; retry the round"
+                )
+            self._round_excluded = set(msg.dropped)
+            if msg.dropped:
+                self._count("round_neighbors_dropped", len(
+                    set(msg.dropped) & set(self._weights)
+                ))
             self._round_id = msg.round_id
             # Master rounds re-derive the op tag from the broadcast round
             # id (see _OPS_PER_ROUND): every agent — including one that
@@ -938,6 +1147,7 @@ class ConsensusAgent:
             return y
         finally:
             self._in_master_round = False
+            self._round_excluded = set()
             if self.status is not AgentStatus.SHUTDOWN:
                 self.status = AgentStatus.READY
 
@@ -1018,13 +1228,20 @@ class ConsensusAgent:
         stream — the heal step after a peer death under an elastic master:
         catch the ConnectionError from the failed op, ``await
         agent.wait_neighbors()`` (the rejoined replacement dials back in),
-        then retry the round."""
+        then retry the round.  Under a regenerating master the weight
+        table itself may be about to change: queued membership-generation
+        broadcasts are applied while waiting."""
         deadline = asyncio.get_event_loop().time() + timeout
-        while set(self._neighbors) != set(self._weights):
+        while True:
+            # Drain FIRST: the weight table itself may be about to
+            # change (a queued membership-generation broadcast), and a
+            # rejoiner may be dialing in right now.
+            await self._drain_membership_updates(0.02)
+            if not (set(self._weights) - set(self._neighbors)):
+                return
             if asyncio.get_event_loop().time() > deadline:
                 missing = sorted(set(self._weights) - set(self._neighbors))
                 raise TimeoutError(f"neighbors never rejoined: {missing}")
-            await asyncio.sleep(0.02)
 
     # ------------------------------------------------------------------ #
     async def close(self, *, drain: float = 0.5) -> None:

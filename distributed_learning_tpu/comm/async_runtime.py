@@ -1,0 +1,548 @@
+"""Asynchronous straggler-tolerant gossip runtime over the TCP backend.
+
+Every other backend in this repo runs LOCK-STEP rounds: the protocol the
+reference gestures at in its asyncio backend (``consensus_asyncio.py:
+209-312``) still pairs every request with a response, so the slowest of
+N agents sets the pace of all of them.  This module is the asynchronous
+round engine the ROADMAP names: gossip overlaps local compute, stale
+neighbor state is mixed at decayed weight instead of waited for, and a
+wedged straggler costs its own progress — not the fleet's.
+
+Model (grounded in *Improving Efficiency in Large-Scale Decentralized
+Distributed Training*, arXiv:2002.01119, for stale-tolerant mixing, and
+*Local SGD with Periodic Averaging*, arXiv:1910.13598, for when it is
+safe to communicate less):
+
+* **Push, don't pull.**  Each round an agent PUSHES its current value to
+  every neighbor as an :class:`~distributed_learning_tpu.comm.protocol.
+  AsyncValue` frame (round- and generation-tagged) and mixes against
+  whatever sits in its per-neighbor inbox — the **double buffer**:
+  buffer A is the live value local compute runs on, buffer B is the last
+  *received* neighbor state the wire keeps filling.
+* **Arrival-anchored staleness.**  A neighbor's staleness is how many of
+  MY rounds already mixed its standing value (0 = fresh this round), so
+  round counters never need cross-agent alignment — a rejoiner's frames
+  are immediately usable.  Stale values mix at weight ``w/(1+s)``; the
+  decayed/dropped mass stays on the self edge so the mixing row still
+  sums to one (mirroring
+  :func:`~distributed_learning_tpu.ops.mixing.stale_weight_matrix`, the
+  device-side program of the same model).
+* **Hard staleness bound tau.**  Beyond ``tau`` the contribution is
+  DROPPED (zero weight this round) and the neighbor is POKED — the
+  re-request half of drop-and-re-request.  ``tau=0`` means synchronous:
+  block until every neighbor delivered a value newer than the last round
+  — the runtime degenerates to the lock-step protocol and is
+  bit-identical to ``run_once``/``run_choco_once`` sequences.
+* **Deadline-bounded waits.**  ``deadline_s`` caps any blocking wait; on
+  expiry the missing neighbors are dropped for this round (sticky until
+  their next frame arrives, so a dead peer is paid for once, not every
+  round).
+
+CHOCO-compressed rounds ride the same runtime with one twist: the
+replicated public estimates (``x̂``) ARE the double buffer, and
+corrections are deltas, so they must be applied **exactly once, in
+order** — the inbox keeps a per-neighbor FIFO and a straggler's backlog
+is drained in one catch-up batch (``tau=0`` applies exactly one per
+round: the lock-step recurrence).  A round that got no correction from a
+neighbor simply mixes against the standing estimates, which is why CHOCO
+tolerates asynchrony so naturally.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from distributed_learning_tpu.comm import protocol as P
+from distributed_learning_tpu.comm.agent import (
+    AgentStatus,
+    ConsensusAgent,
+    ShutdownError,
+)
+
+__all__ = ["AsyncGossipRunner", "AsyncRoundStats"]
+
+
+@dataclasses.dataclass
+class AsyncRoundStats:
+    """What one async round actually mixed (``runner.last_stats``)."""
+
+    round: int = 0
+    #: token -> staleness of the contribution mixed (0 = fresh).
+    mixed: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: tokens whose contribution was dropped this round (staleness > tau
+    #: or deadline expiry); their edge weight stayed on self.
+    dropped: List[str] = dataclasses.field(default_factory=list)
+    #: queued frames skipped by latest-wins consumption (tau > 0 only).
+    skipped: int = 0
+    #: corrections applied this round (CHOCO rounds), token -> count.
+    applied: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+class _Inbox:
+    """Per-neighbor receive state: the FIFO of unconsumed frames plus
+    the standing (last mixed) value and its reuse count."""
+
+    __slots__ = ("queue", "last", "times_mixed", "dropped", "choco_lag")
+
+    def __init__(self):
+        self.queue: deque = deque()  # (value, sender_round, staleness)
+        self.last: Optional[np.ndarray] = None
+        self.times_mixed = 0  # rounds `last` was already mixed
+        self.dropped = False  # sticky: dropped until a fresh arrival
+        self.choco_lag = 0  # consecutive rounds without a correction
+
+
+class AsyncGossipRunner:
+    """Drives asynchronous gossip rounds over a started
+    :class:`~distributed_learning_tpu.comm.agent.ConsensusAgent`.
+
+    Parameters
+    ----------
+    agent:
+        A READY agent (handshake complete).  The runner owns the
+        agent's receive path while its rounds run; do not interleave
+        lock-step collectives (``run_once``/``run_round``) with async
+        rounds without a quiescent point in between.
+    staleness_bound:
+        tau.  0 = synchronous (bit-identical to the lock-step path);
+        k >= 1 mixes values up to k rounds old at ``w/(1+s)`` weight and
+        drops older ones.
+    deadline_s:
+        Cap on any blocking wait for a required-fresh neighbor; expiry
+        drops it for this round (sticky) and pokes it.  None = wait
+        forever (pure bounded-staleness mode).
+    """
+
+    def __init__(
+        self,
+        agent: ConsensusAgent,
+        *,
+        staleness_bound: int = 0,
+        deadline_s: Optional[float] = None,
+    ):
+        if staleness_bound < 0:
+            raise ValueError(
+                f"staleness_bound must be >= 0, got {staleness_bound}"
+            )
+        self.agent = agent
+        self.tau = int(staleness_bound)
+        self.deadline_s = (
+            None if deadline_s is None else float(deadline_s)
+        )
+        self._round = 0
+        self._inbox: Dict[str, _Inbox] = {}
+        self._pub_value: Optional[np.ndarray] = None
+        self._pub_round = 0
+        self._poked: set = set()
+        self.last_stats = AsyncRoundStats()
+
+    # ------------------------------------------------------------------ #
+    @property
+    def round(self) -> int:
+        """Completed async rounds."""
+        return self._round
+
+    def _box(self, token: str) -> _Inbox:
+        box = self._inbox.get(token)
+        if box is None:
+            box = self._inbox[token] = _Inbox()
+        return box
+
+    def _active(self) -> List[str]:
+        """Weighted neighbors with a live stream, sorted (mixing
+        accumulates in this order on every agent — deterministic, and
+        the tau=0 oracle against the lock-step path can be bit-exact)."""
+        a = self.agent
+        return sorted(t for t in a._weights if t in a._neighbors)
+
+    # ------------------------------------------------------------------ #
+    # Wire I/O (the dispatch loop; graftlint host-sync-in-hot-path       #
+    # covers these — values stay numpy, no device syncs)                 #
+    # ------------------------------------------------------------------ #
+    async def _push(self, value: np.ndarray, staleness: int = 0) -> None:
+        """Ship the current value to every active neighbor (the
+        unsolicited push half of the runtime)."""
+        a = self.agent
+        kind = P._ASYNC_SPARSE if (
+            a.sparse_wire and a._sparse_wins(value)
+        ) else P._ASYNC_DENSE
+        msg = P.AsyncValue(
+            round_id=self._round, generation=a._generation,
+            staleness=staleness, value=value, kind=kind,
+            bf16_wire=a.bf16_wire, int8_wire=a._int8_active,
+        )
+        a._count("async_pushes")
+        for token in self._active():
+            try:
+                await a._neighbors[token].send(msg)
+            except (ConnectionError, OSError):
+                self._box(token).dropped = True
+
+    async def _answer_poke(self, token: str) -> None:
+        """Re-send the standing published value to a poked-by neighbor
+        (best effort; nothing published yet means nothing to send)."""
+        a = self.agent
+        if self._pub_value is None or token not in a._neighbors:
+            return
+        a._count("pokes_answered")
+        kind = P._ASYNC_SPARSE if (
+            a.sparse_wire and a._sparse_wins(self._pub_value)
+        ) else P._ASYNC_DENSE
+        try:
+            await a._neighbors[token].send(
+                P.AsyncValue(
+                    round_id=self._pub_round, generation=a._generation,
+                    staleness=self._round - self._pub_round,
+                    value=self._pub_value, kind=kind,
+                    bf16_wire=a.bf16_wire, int8_wire=a._int8_active,
+                )
+            )
+        except (ConnectionError, OSError):
+            pass
+
+    async def _poke(self, token: str) -> None:
+        """The re-request half of drop-and-re-request: ask a
+        staleness-bound-exceeded neighbor for a fresh push.  One poke
+        per staleness excursion (cleared when its next frame lands)."""
+        a = self.agent
+        if token in self._poked or token not in a._neighbors:
+            return
+        self._poked.add(token)
+        a._count("pokes_sent")
+        try:
+            await a._neighbors[token].send(
+                P.AsyncPoke(
+                    round_id=self._round, generation=a._generation
+                )
+            )
+        except (ConnectionError, OSError):
+            pass
+
+    async def _recv_step(self, timeout: Optional[float]) -> bool:
+        """Receive + handle ONE message from the master or any neighbor;
+        False on timeout.  The persistent-task discipline of the agent
+        is kept: an in-flight frame read is never cancelled."""
+        a = self.agent
+        if a._master_task is None and a._master is not None:
+            a._master_task = asyncio.ensure_future(a._master.recv())
+            a._master_task.add_done_callback(a._silence)
+        if a._mux_task is None:
+            a._mux_task = asyncio.ensure_future(a._mux.__anext__())
+        tasks = {t for t in (a._master_task, a._mux_task) if t is not None}
+        done, _ = await asyncio.wait(
+            tasks, timeout=timeout, return_when=asyncio.FIRST_COMPLETED
+        )
+        if not done:
+            return False
+        if a._master_task is not None and a._master_task in done:
+            task, a._master_task = a._master_task, None
+            await self._handle_master(task.result())
+            return True
+        token, msg, src = a._mux_task.result()
+        a._mux_task = None
+        self._handle_peer_msg(token, msg, src)
+        return True
+
+    async def _handle_master(self, msg: Any) -> None:
+        a = self.agent
+        if isinstance(msg, P.NeighborhoodData):
+            # Membership generation broadcast: realign weights/streams;
+            # inboxes of removed edges die with their streams.
+            await a._apply_neighborhood(msg)
+            for token in list(self._inbox):
+                if token not in a._weights:
+                    del self._inbox[token]
+        elif isinstance(msg, P.Shutdown):
+            a.status = AgentStatus.SHUTDOWN
+            raise ShutdownError(msg.reason)
+        # else: round-lifecycle traffic of the lock-step protocol —
+        # stale here, dropped.
+
+    def _handle_peer_msg(self, token: str, msg: Any, src: Any) -> None:
+        a = self.agent
+        if msg is None:
+            cur = a._neighbors.get(token)
+            if token not in a._weights or (cur is not None and cur is not src):
+                return  # removed edge or an already-replaced stream
+            # Neighbor died: the async runtime tolerates it — its edge
+            # is dropped (sticky) until a replacement pushes; the
+            # membership generation machinery heals the stream set.
+            a._neighbors.pop(token, None)
+            a._count("async_neighbor_deaths")
+            self._box(token).dropped = True
+            return
+        if isinstance(msg, P.AsyncValue):
+            if msg.generation != a._generation:
+                a._count("async_gen_dropped")
+                return
+            box = self._box(token)
+            box.queue.append(
+                (msg.value, msg.round_id, msg.staleness)
+            )
+            box.dropped = False
+            self._poked.discard(token)
+            a._count("async_values_received")
+        elif isinstance(msg, P.AsyncPoke):
+            a._count("pokes_received")
+            # Answer at this service point (we are inside the dispatch
+            # loop already): schedule the re-push.
+            task = asyncio.ensure_future(self._answer_poke(token))
+            task.add_done_callback(a._silence)
+        # else: lock-step frames (ValueRequest/...) — not part of an
+        # async run; dropped.
+
+    # ------------------------------------------------------------------ #
+    # Plain (uncompressed) async rounds                                  #
+    # ------------------------------------------------------------------ #
+    def _needs_fresh(self, token: str) -> bool:
+        """Whether the round must wait for a new frame from ``token``:
+        nothing usable is queued AND the standing value would exceed the
+        staleness bound (never-arrived counts as infinitely stale), AND
+        it has not already been dropped this excursion."""
+        box = self._box(token)
+        if box.queue or box.dropped:
+            return False
+        return box.last is None or box.times_mixed > self.tau
+
+    async def _collect(self) -> None:
+        """Wait (deadline-bounded) until no active neighbor is required
+        to deliver a fresh frame; expiry drops the stragglers for this
+        round and pokes them."""
+        a = self.agent
+        deadline = (
+            None if self.deadline_s is None
+            else asyncio.get_event_loop().time() + self.deadline_s
+        )
+        while True:
+            required = [t for t in self._active() if self._needs_fresh(t)]
+            if not required:
+                return
+            timeout = None
+            if deadline is not None:
+                timeout = deadline - asyncio.get_event_loop().time()
+                if timeout <= 0:
+                    for t in required:
+                        self._box(t).dropped = True
+                        a._count("async_deadline_drops")
+                        await self._poke(t)
+                    return
+            if not await self._recv_step(timeout):
+                continue  # deadline re-checked at the loop head
+
+    def _consume(self, token: str, stats: AsyncRoundStats) -> _Inbox:
+        """Advance ``token``'s inbox for this round: tau=0 consumes the
+        OLDEST unread frame (lock-step order — exactly one frame per
+        sender round), tau>0 jumps to the latest (mix the newest
+        information, count the skips)."""
+        box = self._box(token)
+        if box.queue:
+            if self.tau == 0:
+                value, _, sent_stale = box.queue.popleft()
+            else:
+                stats.skipped += len(box.queue) - 1
+                value, _, sent_stale = box.queue[-1]
+                box.queue.clear()
+            box.last = value
+            box.times_mixed = 0
+            box.dropped = False
+        return box
+
+    def _mix_plain(self, y: np.ndarray) -> np.ndarray:
+        """The stale-weighted mixing update, accumulated in sorted-token
+        order: fresh neighbors at full weight, stale ones at
+        ``w/(1+s)`` with the difference on self, dropped ones fully on
+        self — the host-side twin of the fused device program
+        (``ops.mixing.stale_weight_matrix``); rows always sum to 1."""
+        a = self.agent
+        stats = self.last_stats
+        total_w = sum(a._weights.values())
+        out = (1.0 - total_w) * y
+        for token in sorted(a._weights):
+            w = a._weights[token]
+            box = self._consume(token, stats)
+            s = box.times_mixed
+            usable = (
+                box.last is not None and not box.dropped and s <= self.tau
+            )
+            if not usable:
+                stats.dropped.append(token)
+                a._count("async_stale_dropped")
+                out = out + w * y  # dropped mass renormalizes to self
+            elif s == 0:
+                stats.mixed[token] = 0
+                out = out + w * box.last
+            else:
+                stats.mixed[token] = s
+                a._count("async_stale_mixed")
+                w_eff = w / (1.0 + s)
+                out = out + w_eff * box.last + (w - w_eff) * y
+            box.times_mixed += 1
+            a._observe(
+                "comm.agent.staleness",
+                float(s if usable else self.tau + 1),
+                step=self._round,
+            )
+        return out
+
+    async def begin_round(self, value: np.ndarray) -> None:
+        """Open an async round: advance the round counter and push the
+        value.  Run local compute between ``begin_round`` and
+        ``finish_round`` — the wire fills the inbox (buffer B) while the
+        device works on buffer A."""
+        a = self.agent
+        if a.status not in (AgentStatus.READY, AgentStatus.IN_ROUND):
+            raise RuntimeError(f"agent not ready (status={a.status})")
+        self._round += 1
+        self.last_stats = AsyncRoundStats(round=self._round)
+        y = np.asarray(value, dtype=np.float32).ravel()
+        self._pub_value, self._pub_round = y, self._round
+        a._count("async_rounds")
+        await self._push(y)
+
+    async def finish_round(self) -> np.ndarray:
+        """Close the round: deadline-bounded collect, then the
+        stale-weighted mix of the published value against the inbox."""
+        a = self.agent
+        t0 = time.perf_counter()
+        await self._collect()
+        out = self._mix_plain(self._pub_value)
+        a._observe(
+            "comm.agent.async_round_s",
+            time.perf_counter() - t0, step=self._round,
+        )
+        return out
+
+    async def run_async_round(
+        self,
+        value: np.ndarray,
+        *,
+        local: Optional[Callable[[], Any]] = None,
+    ) -> np.ndarray:
+        """One full async gossip round; with ``local`` given, the
+        callable runs between push and collect — overlapping local
+        compute with the wire exchange (its result, if awaitable, is
+        awaited and stored on ``self.last_local``)."""
+        await self.begin_round(value)
+        if local is not None:
+            result = local()
+            if asyncio.iscoroutine(result) or isinstance(
+                result, asyncio.Future
+            ):
+                result = await result
+            self.last_local = result
+        return await self.finish_round()
+
+    # ------------------------------------------------------------------ #
+    # CHOCO (compressed) async rounds                                    #
+    # ------------------------------------------------------------------ #
+    def _needs_correction(self, token: str) -> bool:
+        box = self._box(token)
+        if box.queue or box.dropped:
+            return False
+        return box.choco_lag >= self.tau if self.tau > 0 else True
+
+    async def _collect_choco(self) -> None:
+        a = self.agent
+        deadline = (
+            None if self.deadline_s is None
+            else asyncio.get_event_loop().time() + self.deadline_s
+        )
+        while True:
+            required = [
+                t for t in self._active() if self._needs_correction(t)
+            ]
+            if not required:
+                return
+            timeout = None
+            if deadline is not None:
+                timeout = deadline - asyncio.get_event_loop().time()
+                if timeout <= 0:
+                    for t in required:
+                        self._box(t).dropped = True
+                        a._count("async_deadline_drops")
+                        await self._poke(t)
+                    return
+            if not await self._recv_step(timeout):
+                continue
+
+    async def run_async_choco(
+        self,
+        value: np.ndarray,
+        compressor: Callable[[np.ndarray], np.ndarray],
+        *,
+        gamma: float = 0.3,
+    ) -> np.ndarray:
+        """One asynchronous CHOCO-GOSSIP round: push the compressed
+        correction ``q = C(x - x̂_self)``, apply whatever neighbor
+        corrections have arrived (exactly once each, in order — the
+        replicated-estimate contract), and step the iterate against the
+        standing estimates.
+
+        ``tau=0`` blocks for exactly one correction per neighbor per
+        round and is bit-identical to the lock-step
+        :meth:`~distributed_learning_tpu.comm.agent.ConsensusAgent.
+        run_choco_once` sequence; ``tau>0`` lets a straggler's
+        correction stream lag up to tau rounds (its backlog is drained
+        in one batch when it catches up), and a deadline expiry simply
+        proceeds on the standing estimates — a CHOCO round without a
+        fresh correction is still exact.
+        """
+        a = self.agent
+        x = a._choco_begin(value, require_aligned=False)
+        self._round += 1
+        self.last_stats = AsyncRoundStats(round=self._round)
+        a._count("async_choco_rounds")
+        q = np.asarray(
+            compressor(x - a._choco_hat_self), np.float32
+        ).ravel()
+        a._int8_active = a.int8_wire
+        try:
+            q = a._wire_round(q)
+            self._pub_value, self._pub_round = q, self._round
+            await self._push(q)
+        finally:
+            a._int8_active = False
+        a._choco_hat_self = a._choco_hat_self + q
+        for t in a._weights:
+            a._choco_hat_nbrs.setdefault(t, np.zeros_like(x))
+        await self._collect_choco()
+        stats = self.last_stats
+        out = x.copy()
+        for token in sorted(a._weights):
+            box = self._box(token)
+            applied = 0
+            if box.queue:
+                if self.tau == 0:
+                    batch = [box.queue.popleft()]
+                else:
+                    batch = list(box.queue)
+                    box.queue.clear()
+                for qn, _, _ in batch:
+                    a._choco_hat_nbrs[token] = a._choco_hat_nbrs[
+                        token
+                    ] + np.asarray(qn, np.float32).ravel()
+                    applied += 1
+                box.choco_lag = 0
+                box.dropped = False
+            else:
+                box.choco_lag += 1
+                a._count("async_stale_dropped")
+                stats.dropped.append(token)
+            if applied:
+                stats.applied[token] = applied
+                if applied > 1:
+                    a._count("async_choco_catchup", applied - 1)
+            a._observe(
+                "comm.agent.staleness", float(box.choco_lag),
+                step=self._round,
+            )
+            out += gamma * a._weights[token] * (
+                a._choco_hat_nbrs[token] - a._choco_hat_self
+            )
+        return out

@@ -15,22 +15,46 @@ the crc (native codec when available) rejects torn or corrupt frames.
 from __future__ import annotations
 
 import asyncio
+import errno
 import struct
-from typing import Optional
+from typing import Callable, Optional
 
 from distributed_learning_tpu import native
 from distributed_learning_tpu.comm.protocol import Message, pack_message, unpack_message
 from distributed_learning_tpu.obs import get_registry
 
-__all__ = ["FramedStream", "FrameError", "open_framed_connection"]
+__all__ = [
+    "FramedStream",
+    "FrameError",
+    "FrameTimeout",
+    "open_framed_connection",
+]
 
 WIRE_VERSION = 1
 _HEADER = struct.Struct("<IBBH")
 MAX_FRAME = 1 << 31  # 2 GiB: a full WRN-28-10 f32 vector is ~146 MB
 
+#: OS errors a send may legitimately retry: the kernel was momentarily
+#: out of buffer/queue space or the call was interrupted.  Connection
+#: teardown errnos (ECONNRESET, EPIPE, ...) are NOT here on purpose —
+#: retrying a dead socket only delays the death notice the caller's
+#: heal path needs.
+TRANSIENT_ERRNOS = frozenset(
+    {errno.EAGAIN, errno.EWOULDBLOCK, errno.EINTR, errno.ENOBUFS}
+)
+
 
 class FrameError(ConnectionError):
     """Corrupt or protocol-violating frame."""
+
+
+class FrameTimeout(TimeoutError):
+    """``recv(timeout=...)`` expired while waiting for the NEXT frame to
+    begin.  The stream is still healthy: ``readexactly`` consumes its
+    bytes atomically (partial data stays in the reader's buffer), so the
+    read simply resumes on the next ``recv`` call.  Deliberately NOT a
+    ConnectionError — multiplexers/heal paths must not evict a live
+    stream over a quiet period."""
 
 
 class FramedStream:
@@ -42,7 +66,15 @@ class FramedStream:
     the default obs registry (``comm.bytes_framed_out/in``,
     ``comm.frames_out/in``)."""
 
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        *,
+        send_retries: int = 0,
+        retry_base_s: float = 0.02,
+        on_retry: Optional[Callable[[], None]] = None,
+    ):
         self.reader = reader
         self.writer = writer
         self._send_lock = asyncio.Lock()
@@ -50,6 +82,13 @@ class FramedStream:
         self.bytes_received = 0
         self.frames_sent = 0
         self.frames_received = 0
+        # Bounded exponential-backoff retry of transient socket errors on
+        # send (TRANSIENT_ERRNOS): attempt k sleeps retry_base_s * 2**k.
+        # 0 = fail on first error (the pre-async behavior).  on_retry is
+        # the owner's counter hook (ConsensusAgent wires comm.agent.retries).
+        self.send_retries = int(send_retries)
+        self.retry_base_s = float(retry_base_s)
+        self.on_retry = on_retry
 
     @property
     def peername(self):
@@ -63,16 +102,50 @@ class FramedStream:
         header = _HEADER.pack(len(body), WIRE_VERSION, code, 0)
         nbytes = len(header) + len(body) + 4
         async with self._send_lock:
-            self.writer.write(header + body + struct.pack("<I", crc))
-            await self.writer.drain()
+            attempt = 0
+            while True:
+                try:
+                    self.writer.write(header + body + struct.pack("<I", crc))
+                    await self.writer.drain()
+                    break
+                except OSError as e:
+                    transient = (
+                        e.errno in TRANSIENT_ERRNOS
+                        and not isinstance(e, ConnectionError)
+                    )
+                    if not transient or attempt >= self.send_retries:
+                        raise
+                    get_registry().inc("comm.frame_retries")
+                    if self.on_retry is not None:
+                        self.on_retry()
+                    await asyncio.sleep(self.retry_base_s * (2 ** attempt))
+                    attempt += 1
         self.bytes_sent += nbytes
         self.frames_sent += 1
         reg = get_registry()
         reg.inc("comm.bytes_framed_out", nbytes)
         reg.inc("comm.frames_out")
 
-    async def recv(self) -> Message:
-        header = await self.reader.readexactly(_HEADER.size)
+    async def recv(self, timeout: Optional[float] = None) -> Message:
+        if timeout is None:
+            header = await self.reader.readexactly(_HEADER.size)
+        else:
+            # Frame-boundary timeout only: readexactly consumes its bytes
+            # atomically (accumulated data stays buffered on cancel), so
+            # an expiry here leaves the stream intact and retryable —
+            # FrameTimeout, not FrameError.  Once the header is consumed
+            # the frame must complete; a peer that wedges MID-frame is
+            # indistinguishable from corruption and surfaces below as a
+            # ConnectionError from the transport, never a torn decode
+            # (the crc rejects those).
+            try:
+                header = await asyncio.wait_for(
+                    self.reader.readexactly(_HEADER.size), timeout
+                )
+            except asyncio.TimeoutError:
+                raise FrameTimeout(
+                    f"no frame started within {timeout}s"
+                ) from None
         length, version, code, _ = _HEADER.unpack(header)
         if version != WIRE_VERSION:
             raise FrameError(f"wire version {version} != {WIRE_VERSION}")
@@ -103,14 +176,18 @@ class FramedStream:
 
 
 async def open_framed_connection(
-    host: str, port: int, *, retries: int = 20, delay: float = 0.1
+    host: str, port: int, *, retries: int = 20, delay: float = 0.1,
+    send_retries: int = 0, on_retry: Optional[Callable[[], None]] = None,
 ) -> FramedStream:
     """Connect with retry (peers race to start their servers)."""
     last: Optional[Exception] = None
     for _ in range(retries):
         try:
             reader, writer = await asyncio.open_connection(host, port)
-            return FramedStream(reader, writer)
+            return FramedStream(
+                reader, writer,
+                send_retries=send_retries, on_retry=on_retry,
+            )
         except OSError as e:
             last = e
             await asyncio.sleep(delay)

@@ -58,10 +58,12 @@ class ConsensusMaster:
         convergence_eps: float = 1e-4,
         telemetry: Optional[TelemetryProcessor] = None,
         elastic: bool = False,
+        regenerate: bool = False,
         debug: bool = False,
         aggregator: Optional[RunAggregator] = None,
         flight: Optional[FlightRecorder] = None,
         round_deadline_s: Optional[float] = None,
+        enforce_round_deadline: bool = False,
     ):
         self.topology = (
             topology
@@ -72,14 +74,10 @@ class ConsensusMaster:
         self.convergence_eps = float(convergence_eps)
         self.telemetry = telemetry
         self.debug = debug
-        if weight_mode == "metropolis":
-            self.W = self.topology.metropolis_weights()
-        elif weight_mode == "sdp":
-            # Fastest-mixing weights (parity: _solve_fastest_convergence,
-            # master.py:262-266 -> fast_averaging.py:4-32).
-            self.W, _ = solve_fastest_mixing(self.topology)
-        else:
+        self.weight_mode = weight_mode
+        if weight_mode not in ("metropolis", "sdp"):
             raise ValueError(f"unknown weight_mode {weight_mode!r}")
+        self.W = self._solve_weights(self.topology)
 
         self._tokens = [str(t) for t in self.topology.tokens]
         self._index = {t: i for i, t in enumerate(self._tokens)}
@@ -97,6 +95,14 @@ class ConsensusMaster:
         self._round_id = 0
         self._round_weights: Dict[str, float] = {}
         self._converged: Dict[str, bool] = {}
+        # iteration -> tokens that reported Converged AT that iteration.
+        # The round ends on the first iteration EVERY participant
+        # converged at — ANDing latest-arrival statuses instead (the
+        # reference's implied rule) is racy: a transiently-zero
+        # residual (symmetric initial values hit them) can leave every
+        # agent's LATEST status Converged at different iterations and
+        # end the round far from consensus.
+        self._conv_at: Dict[int, set] = {}
 
         # Run-wide observability plane (docs/observability.md §Run-wide
         # plane): the aggregator merges per-agent obs.delta Telemetry
@@ -113,7 +119,22 @@ class ConsensusMaster:
         self.round_deadline_s = (
             None if round_deadline_s is None else float(round_deadline_s)
         )
+        # Deadline ENFORCEMENT (docs/async_runtime.md §Deadline-enforced
+        # rounds): promotes round_deadline_s from observe-only to
+        # drop-rather-than-wait.  Formation phase: a round whose quorum
+        # is still missing agents when the deadline fires starts WITHOUT
+        # them — their edges get zero weight this round (the agents
+        # renormalize on device/host, presence_weight_matrix semantics)
+        # and their queued requests join the next round.  In-round: an
+        # overstaying round is CUT with Done(deadline=True) — agents
+        # return their current (partially converged) values.
+        self.enforce_round_deadline = bool(enforce_round_deadline)
+        if self.enforce_round_deadline and self.round_deadline_s is None:
+            raise ValueError(
+                "enforce_round_deadline=True needs round_deadline_s"
+            )
         self._deadline_handle: Optional[asyncio.TimerHandle] = None
+        self._round_participants: set = set()
         # Wall-clock arrival time of each agent's round request: the
         # straggler-attribution signal (the last arrival set the pace).
         self._round_arrivals: Dict[str, float] = {}
@@ -126,7 +147,31 @@ class ConsensusMaster:
         # its token is marked down, any running round is aborted (Done
         # broadcast — agents keep their current values), and a fresh
         # process may re-register the same token to rejoin.
-        self.elastic = bool(elastic)
+        #
+        # regenerate=True (implies elastic) adds ELASTIC MEMBERSHIP
+        # (docs/async_runtime.md §Membership generations): instead of
+        # freezing the run until the dead token rejoins, the master
+        # re-forms the topology over the LIVE members (induced original
+        # edges, bridged back to connectivity if the death cut the
+        # graph), re-solves the mixing weights, bumps the membership
+        # generation, and broadcasts versioned NeighborhoodData — the
+        # survivors keep making progress at N-1, and (re)joining agents
+        # realign to the current generation.  Unknown tokens may JOIN a
+        # running deployment (register with ConsensusAgent(rejoin=True)
+        # so the joiner initiates every peer connection).
+        self.regenerate = bool(regenerate)
+        self.elastic = bool(elastic) or self.regenerate
+        self._generation = 0
+        # Original edge list over tokens: each generation's topology is
+        # the induced subgraph over live members plus connectivity
+        # bridges (new joiners attach via the bridge chain too).
+        self._base_edges = [
+            (self.topology.tokens[i], self.topology.tokens[j])
+            for i, j in self.topology.edges
+        ]
+        # Tokens that (re)joined in the CURRENT generation: they dial all
+        # their neighbors themselves, so everyone else sees port 0.
+        self._dialing_in: set = set()
         self._down: set = set()
 
         # Observability: named logger + round/telemetry counters (the
@@ -170,6 +215,92 @@ class ConsensusMaster:
         assert self._server is not None, "master not started"
         return self._server.sockets[0].getsockname()[:2]
 
+    @property
+    def generation(self) -> int:
+        """Current membership generation (0 = the seed deployment)."""
+        return self._generation
+
+    # ------------------------------------------------------------------ #
+    # Elastic membership: topology/weight regeneration                   #
+    # ------------------------------------------------------------------ #
+    def _solve_weights(self, topology: Topology) -> np.ndarray:
+        if topology.n_agents == 1:
+            return np.ones((1, 1), dtype=np.float64)
+        if self.weight_mode == "sdp":
+            # Fastest-mixing weights (parity: _solve_fastest_convergence,
+            # master.py:262-266 -> fast_averaging.py:4-32), re-solved for
+            # every membership generation's graph.
+            W, _ = solve_fastest_mixing(topology)
+            return W
+        return topology.metropolis_weights()
+
+    def _form_topology(self, live: List[str]) -> Topology:
+        """This generation's graph: the induced subgraph of the original
+        topology over the live members, bridged back to connectivity.
+
+        A death can cut the graph (a chain loses its middle) and a
+        joiner may have no original edges at all; components are linked
+        by a chain of bridges between their smallest tokens, so every
+        generation's graph is connected and fastest-mixing weights
+        exist."""
+        live_set = set(live)
+        edges = [
+            (u, v) for (u, v) in self._base_edges
+            if u in live_set and v in live_set
+        ]
+        if len(live) == 1:
+            return Topology(n_agents=1, edges=(), tokens=(live[0],))
+        # Union-find over live tokens to find components.
+        parent = {t: t for t in live}
+
+        def find(t):
+            while parent[t] != t:
+                parent[t] = parent[parent[t]]
+                t = parent[t]
+            return t
+
+        for u, v in edges:
+            parent[find(u)] = find(v)
+        reps = sorted({find(t) for t in live})
+        if len(reps) > 1:
+            comps = {r: [] for r in reps}
+            for t in live:
+                comps[find(t)].append(t)
+            anchors = [min(comps[r]) for r in reps]
+            bridges = list(zip(anchors, anchors[1:]))
+            edges.extend(bridges)
+            self._debug("topology bridges added: %s", bridges)
+        return Topology.from_edges(sorted(edges))
+
+    async def _regenerate(self, cause: str, token: str) -> None:
+        """Re-form the topology over the live membership, re-solve W,
+        bump the generation, and broadcast versioned NeighborhoodData to
+        every live agent (docs/async_runtime.md §Membership
+        generations)."""
+        live = sorted(self._control)
+        if not live:
+            return
+        self._generation += 1
+        self._dialing_in = {token} if cause != "death" else set()
+        self.topology = self._form_topology(live)
+        # Generation order follows the regenerated topology's token
+        # order so W rows index consistently.
+        self._tokens = [str(t) for t in self.topology.tokens]
+        self._index = {t: i for i, t in enumerate(self._tokens)}
+        self.W = self._solve_weights(self.topology)
+        self._count("generations")
+        self._debug(
+            "membership generation %s (%s %s): members=%s",
+            self._generation, cause, token, self._tokens,
+        )
+        if self.flight is not None:
+            self.flight.note(
+                "<master>", "generation", generation=self._generation,
+                cause=cause, token=token, members=list(self._tokens),
+            )
+        for t in self._tokens:
+            await self._send_neighborhood(t)
+
     async def start(self) -> Tuple[str, int]:
         """Start listening and serving; returns the bound (host, port)."""
         self._server = await asyncio.start_server(
@@ -190,12 +321,18 @@ class ConsensusMaster:
             stream.close()
             return
         token = msg.token
+        joining = False
         if token not in self._index:
-            await stream.send(
-                P.ErrorException(message=f"unknown agent token {token!r}")
-            )
-            stream.close()
-            return
+            # Elastic membership: an unknown token may JOIN a running
+            # deployment (the next generation's topology attaches it).
+            # Pre-initialization the member set is the constructor's.
+            if not (self.regenerate and self._all_registered.is_set()):
+                await stream.send(
+                    P.ErrorException(message=f"unknown agent token {token!r}")
+                )
+                stream.close()
+                return
+            joining = True
         if token in self._control:
             await stream.send(
                 P.ErrorException(message=f"token {token!r} already registered")
@@ -216,17 +353,36 @@ class ConsensusMaster:
         self._count("registrations")
         if self.flight is not None:
             self.flight.note(
-                "<master>", "rejoined" if rejoining else "registered",
+                "<master>",
+                "joined" if joining else (
+                    "rejoined" if rejoining else "registered"
+                ),
                 token=token,
             )
         self._debug("registered %s @ %s:%s", token, msg.host, msg.port)
-        await stream.send(P.Ok(info="rejoined" if rejoining else "registered"))
+        await stream.send(
+            P.Ok(
+                info="joined" if joining else (
+                    "rejoined" if rejoining else "registered"
+                )
+            )
+        )
         # Into the mux immediately: deaths are then observable in every
         # phase, including the registration window, and the serve loop's
         # parked wait is woken for the new stream (elastic rejoin would
         # otherwise leave its round request unread until unrelated traffic
         # arrived).
         self._mux.add(token, stream)
+        if (joining or rejoining) and self.regenerate:
+            # Elastic membership: the member set changed — re-form the
+            # topology, re-solve W, bump the generation, broadcast the
+            # new epoch to EVERY live agent (the (re)joiner included).
+            await self._regenerate(
+                "join" if joining else "rejoin", token
+            )
+            self._count("rejoins" if rejoining else "joins")
+            await self._maybe_start_round()
+            return
         if rejoining:
             # Resend this agent's neighborhood; the rejoiner initiates all
             # its peer connections itself, so nobody else needs its new
@@ -254,10 +410,14 @@ class ConsensusMaster:
         for j in self.topology.neighbors(i):
             nb_token = self._tokens[j]
             host, port = self._listen_addr[nb_token]
-            if nb_token in self._down:
+            if nb_token in self._down or (
+                nb_token in self._dialing_in and nb_token != token
+            ):
                 # Currently-down neighbor: its recorded address is stale.
                 # port 0 tells a rejoiner not to dial — the neighbor's own
-                # replacement will dial in when it re-registers.
+                # replacement will dial in when it re-registers.  This
+                # generation's fresh (re)joiner is flagged the same way:
+                # it initiates every one of its peer connections itself.
                 host, port = "", 0
             nbs.append(
                 P.Neighbor(
@@ -271,6 +431,7 @@ class ConsensusMaster:
                     self_weight=float(self.W[i, i]),
                     convergence_eps=self.convergence_eps,
                     neighbors=nbs,
+                    generation=self._generation,
                 )
             )
         except (ConnectionError, OSError) as exc:
@@ -309,13 +470,14 @@ class ConsensusMaster:
                             dead.close()
                         self._down.add(token)
                         self._round_weights.pop(token, None)
+                        self._round_arrivals.pop(token, None)
                         aborted_round = None
                         if self._round_running:
                             self._round_running = False
                             self._cancel_deadline()
                             self._count("rounds_aborted")
                             aborted_round = self._round_id
-                            await self._broadcast(
+                            await self._broadcast_round(
                                 P.Done(round_id=self._round_id, aborted=True)
                             )
                             self._debug(
@@ -337,6 +499,12 @@ class ConsensusMaster:
                                 )
                             else:
                                 self._flight_dump("agent_down", token=token)
+                        if self.regenerate and self._all_registered.is_set():
+                            # Elastic membership: survivors keep going at
+                            # N-1 under a fresh (topology, W) generation
+                            # instead of stalling until the token rejoins.
+                            await self._regenerate("death", token)
+                            await self._maybe_start_round()
                         self._debug("agent %s down; awaiting rejoin", token)
                         continue
                     # Control connection lost.  No recovery protocol exists
@@ -394,9 +562,12 @@ class ConsensusMaster:
 
     def _on_round_deadline(self, round_id: int) -> None:
         """call_later callback: the round overstayed round_deadline_s.
-        Observe-and-record only — the lock-step protocol keeps waiting
-        (dropping the straggler is the async runtime's move); the count
-        and the dump make the stall diagnosable instead of silent."""
+
+        Observe-only by default — the lock-step protocol keeps waiting;
+        the count and the dump make the stall diagnosable instead of
+        silent.  With ``enforce_round_deadline`` the round is CUT:
+        Done(deadline=True) goes to the participants, who return their
+        current (partially converged) values — drop rather than wait."""
         self._deadline_handle = None
         if self._round_running and self._round_id == round_id:
             self._count("round_deadlines_expired")
@@ -407,9 +578,62 @@ class ConsensusMaster:
                 "round_deadline", round_id=round_id,
                 deadline_s=self.round_deadline_s, waiting_on=missing,
             )
+            if self.enforce_round_deadline:
+                asyncio.ensure_future(self._deadline_cut(round_id))
+
+    async def _deadline_cut(self, round_id: int) -> None:
+        if not (self._round_running and self._round_id == round_id):
+            return
+        self._round_running = False
+        self._count("rounds_deadline_cut")
+        if self.aggregator is not None:
+            self.aggregator.note_round_done(
+                round_id,
+                time.perf_counter() - self._round_t0,
+                wall_t0=self._round_wall_t0,
+            )
+        await self._broadcast_round(P.Done(round_id=round_id, deadline=True))
+        self._debug("round %s cut at the deadline", round_id)
+        await self._maybe_start_round()
+
+    def _on_formation_deadline(self) -> None:
+        """call_later callback of the drop-rather-than-wait FORMATION
+        deadline: the quorum has been incomplete for round_deadline_s —
+        start the round with whoever showed up; the missing agents' edges
+        get zero weight this round (NewRoundNotification.dropped) and
+        their late requests queue for the next round."""
+        self._deadline_handle = None
+        if self._round_running or not self._round_weights:
+            return
+        asyncio.ensure_future(self._formation_deadline_start())
+
+    async def _formation_deadline_start(self) -> None:
+        if self._round_running:
+            return
+        present = sorted(
+            t for t in self._round_weights
+            if t in self._index and t in self._control
+        )
+        if not present:
+            return
+        self._count("round_formation_deadlines")
+        if self.flight is not None:
+            self.flight.note(
+                "<master>", "formation_deadline",
+                waiting_on=sorted(set(self._tokens) - set(present)),
+            )
+        await self._start_round(present)
 
     async def _on_round_request(self, token: str, msg: P.NewRoundRequest):
         if self._round_running:
+            if self.enforce_round_deadline:
+                # Drop-rather-than-wait: a straggler that missed this
+                # round queues for the next one instead of erroring the
+                # deployment.
+                self._round_weights[token] = msg.weight
+                self._round_arrivals[token] = time.time()
+                self._count("round_requests_deferred")
+                return
             # Parity intent of the "round already running" guard
             # (master.py:140-144), minus the crash.
             await self._control[token].send(
@@ -421,38 +645,90 @@ class ConsensusMaster:
         # purpose — arrivals are compared against agent-side wall
         # anchors on the merged timeline.
         self._round_arrivals[token] = time.time()
+        await self._maybe_start_round()
+
+    async def _maybe_start_round(self) -> None:
+        """Start a round if the pending quorum allows it: complete quorum
+        starts immediately; with deadline enforcement an incomplete one
+        arms the formation deadline."""
+        if self._round_running:
+            return
+        # Requests from members a later generation removed (death, or a
+        # regenerated topology) no longer count toward any quorum.
+        for t in list(self._round_weights):
+            if t not in self._index or t not in self._control:
+                self._round_weights.pop(t, None)
+                self._round_arrivals.pop(t, None)
+        if not self._round_weights:
+            return
         if len(self._round_weights) == len(self._tokens):
-            self._round_id += 1
-            self._round_running = True
-            self._converged = {t: False for t in self._tokens}
-            mean_w = float(np.mean(list(self._round_weights.values())))
-            self._round_weights.clear()
-            self._count("rounds_started")
-            self._round_wall_t0 = time.time()
-            self._round_t0 = time.perf_counter()
-            if self.aggregator is not None:
-                self.aggregator.note_round_arrivals(
-                    self._round_id, dict(self._round_arrivals)
-                )
-            self._round_arrivals.clear()
-            if self.round_deadline_s:
-                self._cancel_deadline()
-                self._deadline_handle = (
-                    asyncio.get_event_loop().call_later(
-                        self.round_deadline_s,
-                        self._on_round_deadline, self._round_id,
-                    )
-                )
-            await self._broadcast(
-                P.NewRoundNotification(round_id=self._round_id, mean_weight=mean_w)
+            self._cancel_deadline()
+            await self._start_round(sorted(self._round_weights))
+        elif (
+            self.enforce_round_deadline and self._deadline_handle is None
+        ):
+            self._deadline_handle = asyncio.get_event_loop().call_later(
+                self.round_deadline_s, self._on_formation_deadline
             )
-            self._debug("round %s started, mean_w=%s", self._round_id, mean_w)
+
+    async def _start_round(self, participants: List[str]) -> None:
+        self._round_id += 1
+        self._round_running = True
+        self._round_participants = set(participants)
+        dropped = sorted(set(self._tokens) - self._round_participants)
+        self._converged = {t: False for t in participants}
+        self._conv_at = {}
+        mean_w = float(
+            np.mean([self._round_weights[t] for t in participants])
+        )
+        arrivals = {
+            t: self._round_arrivals.pop(t)
+            for t in participants if t in self._round_arrivals
+        }
+        for t in participants:
+            self._round_weights.pop(t, None)
+        self._count("rounds_started")
+        if dropped:
+            self._count("round_agents_dropped", len(dropped))
+        self._round_wall_t0 = time.time()
+        self._round_t0 = time.perf_counter()
+        if self.aggregator is not None:
+            self.aggregator.note_round_arrivals(self._round_id, arrivals)
+        if self.round_deadline_s:
+            self._cancel_deadline()
+            self._deadline_handle = (
+                asyncio.get_event_loop().call_later(
+                    self.round_deadline_s,
+                    self._on_round_deadline, self._round_id,
+                )
+            )
+        await self._broadcast_round(
+            P.NewRoundNotification(
+                round_id=self._round_id, mean_weight=mean_w,
+                generation=self._generation, dropped=dropped,
+            )
+        )
+        self._debug(
+            "round %s started, mean_w=%s%s", self._round_id, mean_w,
+            f", dropped={dropped}" if dropped else "",
+        )
 
     async def _on_status(self, token: str, msg):
         if msg.round_id != self._round_id or not self._round_running:
             return  # stale report from a finished round
+        if token not in self._converged:
+            return  # not a participant of this round
+        # Latest-status view: the deadline dump's "waiting_on" picture.
         self._converged[token] = isinstance(msg, P.Converged)
-        if all(self._converged.values()):
+        if isinstance(msg, P.Converged):
+            at = self._conv_at.setdefault(msg.iteration, set())
+            at.add(token)
+        # Done iff some single iteration saw EVERY participant converge
+        # (once truly converged, agents report Converged every
+        # iteration, so the first common iteration always arrives).
+        if isinstance(msg, P.Converged) and (
+            self._conv_at[msg.iteration] >= self._round_participants
+        ):
             self._round_running = False
             self._cancel_deadline()
             self._count("rounds_done")
@@ -462,8 +738,9 @@ class ConsensusMaster:
                     time.perf_counter() - self._round_t0,
                     wall_t0=self._round_wall_t0,
                 )
-            await self._broadcast(P.Done(round_id=self._round_id))
+            await self._broadcast_round(P.Done(round_id=self._round_id))
             self._debug("round %s done", self._round_id)
+            await self._maybe_start_round()
 
     async def _broadcast(self, msg) -> None:
         for token, stream in list(self._control.items()):
@@ -471,6 +748,19 @@ class ConsensusMaster:
                 await stream.send(msg)
             except (ConnectionError, OSError):
                 self._debug("broadcast to %s failed", token)
+
+    async def _broadcast_round(self, msg) -> None:
+        """Round-lifecycle broadcast: participants only — an agent
+        dropped from the round must not mistake its notifications/Done
+        for a round it will join later."""
+        for token in sorted(self._round_participants):
+            stream = self._control.get(token)
+            if stream is None:
+                continue
+            try:
+                await stream.send(msg)
+            except (ConnectionError, OSError):
+                self._debug("round broadcast to %s failed", token)
 
     # ------------------------------------------------------------------ #
     async def shutdown(self, reason: str = "") -> None:

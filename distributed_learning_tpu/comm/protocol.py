@@ -54,6 +54,8 @@ __all__ = [
     "Telemetry",
     "ValueResponseSparse",
     "ValueResponseFusedSparse",
+    "AsyncValue",
+    "AsyncPoke",
     "pack_message",
     "unpack_message",
     "OBS_PAYLOAD_KIND",
@@ -168,18 +170,26 @@ class NeighborhoodData(Message):
     """Master -> agent: neighbor addresses + per-edge mixing weights +
     self-weight + convergence eps (parity: ``ProtoNeighborhoodData``,
     protocol.py:35-39, with the SDP weights the master solves at
-    ``master.py:262-266``)."""
+    ``master.py:262-266``).
+
+    ``generation`` (this framework's addition) versions the membership
+    epoch: an elastic master that re-forms the topology and re-solves W
+    after a death/(re)join broadcasts a fresh NeighborhoodData with the
+    counter bumped, and agents realign their weight tables to it
+    mid-run (docs/async_runtime.md §Membership generations)."""
 
     TYPE_CODE: ClassVar[int] = 4
     self_weight: float = 0.0
     convergence_eps: float = 1e-4
     neighbors: List[Neighbor] = dataclasses.field(default_factory=list)
+    generation: int = 0
 
     def _pack(self) -> bytes:
         out = [struct.pack("<ddH", self.self_weight, self.convergence_eps, len(self.neighbors))]
         for nb in self.neighbors:
             out.append(_pack_str(nb.token) + _pack_str(nb.host))
             out.append(struct.pack("<Id", nb.port, nb.weight))
+        out.append(struct.pack("<q", self.generation))
         return b"".join(out)
 
     @classmethod
@@ -193,7 +203,11 @@ class NeighborhoodData(Message):
             port, weight = struct.unpack_from("<Id", buf, off)
             off += 12
             nbs.append(Neighbor(token=token, host=host, port=port, weight=weight))
-        return cls(self_weight=self_w, convergence_eps=eps, neighbors=nbs)
+        (gen,) = struct.unpack_from("<q", buf, off)
+        return cls(
+            self_weight=self_w, convergence_eps=eps, neighbors=nbs,
+            generation=gen,
+        )
 
 
 @dataclasses.dataclass
@@ -222,14 +236,35 @@ class NewRoundNotification(Message):
     TYPE_CODE: ClassVar[int] = 6
     round_id: int = 0
     mean_weight: float = 1.0
+    #: membership epoch this round runs under (must match the agent's).
+    generation: int = 0
+    #: tokens dropped from this round by a deadline-enforcing master
+    #: (their edges get zero weight, mass renormalized onto self).
+    dropped: List[str] = dataclasses.field(default_factory=list)
 
     def _pack(self) -> bytes:
-        return struct.pack("<qd", self.round_id, self.mean_weight)
+        out = [
+            struct.pack(
+                "<qdqH",
+                self.round_id, self.mean_weight, self.generation,
+                len(self.dropped),
+            )
+        ]
+        for tok in self.dropped:
+            out.append(_pack_str(tok))
+        return b"".join(out)
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "NewRoundNotification":
-        r, w = struct.unpack_from("<qd", buf, 0)
-        return cls(round_id=r, mean_weight=w)
+        r, w, gen, count = struct.unpack_from("<qdqH", buf, 0)
+        off = 26
+        dropped = []
+        for _ in range(count):
+            tok, off = _unpack_str(buf, off)
+            dropped.append(tok)
+        return cls(
+            round_id=r, mean_weight=w, generation=gen, dropped=dropped
+        )
 
 
 @dataclasses.dataclass
@@ -320,14 +355,20 @@ class Done(Message):
     TYPE_CODE: ClassVar[int] = 11
     round_id: int = 0
     aborted: bool = False
+    #: round was cut by an enforced round deadline — agents return their
+    #: current (partially converged) values rather than wait any longer.
+    deadline: bool = False
 
     def _pack(self) -> bytes:
-        return struct.pack("<qB", self.round_id, int(self.aborted))
+        flags = int(self.aborted) | (int(self.deadline) << 1)
+        return struct.pack("<qB", self.round_id, flags)
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "Done":
-        r, a = struct.unpack_from("<qB", buf, 0)
-        return cls(round_id=r, aborted=bool(a))
+        r, flags = struct.unpack_from("<qB", buf, 0)
+        return cls(
+            round_id=r, aborted=bool(flags & 1), deadline=bool(flags & 2)
+        )
 
 
 @dataclasses.dataclass
@@ -448,13 +489,118 @@ class ValueResponseFusedSparse(Message):
         )
 
 
+#: payload encodings of an :class:`AsyncValue` frame.
+_ASYNC_DENSE, _ASYNC_SPARSE, _ASYNC_FUSED = 0, 1, 2
+
+
+@dataclasses.dataclass
+class AsyncValue(Message):
+    """Agent -> neighbor PUSH of the async gossip runtime
+    (``comm/async_runtime.py``): unsolicited "here is my latest state",
+    no request/response pairing.  This framework's addition — the
+    reference has no asynchronous wire at all (its asyncio backend is
+    still lock-step request/response, ``consensus_asyncio.py:209-312``).
+
+    ``round_id`` is the *sender's* async round counter (receivers anchor
+    staleness to their own arrival clock, so counters need no cross-agent
+    alignment); ``generation`` is the membership epoch the value belongs
+    to (frames from another generation are dropped); ``staleness`` stamps
+    how many sender rounds old the payload already was when shipped
+    (0 for a fresh push; >0 when a poke re-sends the standing published
+    buffer).  ``kind`` picks the payload codec: dense
+    (``encode_tensor``), k-sparse (``encode_sparse``), or fused sparse
+    with per-dtype-bucket sections (``encode_fused_sparse``)."""
+
+    TYPE_CODE: ClassVar[int] = 16
+    round_id: int = 0
+    generation: int = 0
+    staleness: int = 0
+    value: Optional[np.ndarray] = None
+    kind: int = _ASYNC_DENSE
+    buckets: Optional[Tuple] = None  # encode-side, fused kind only
+    bf16_wire: bool = False
+    int8_wire: bool = False
+
+    def _pack(self) -> bytes:
+        from distributed_learning_tpu.comm.tensor_codec import (
+            encode_fused_sparse,
+            encode_sparse,
+        )
+
+        v = np.asarray(
+            self.value if self.value is not None else np.zeros(0, np.float32)
+        )
+        if self.kind == _ASYNC_SPARSE:
+            t = encode_sparse(
+                v, bf16_wire=self.bf16_wire, int8_wire=self.int8_wire
+            )
+        elif self.kind == _ASYNC_FUSED:
+            buckets = self.buckets
+            if buckets is None:
+                buckets = (("float32", ((0, int(v.size)),)),)
+            t = encode_fused_sparse(
+                v, buckets,
+                bf16_wire=self.bf16_wire, int8_wire=self.int8_wire,
+            )
+        else:
+            t = encode_tensor(
+                v, bf16_wire=self.bf16_wire, int8_wire=self.int8_wire
+            )
+        return struct.pack(
+            "<qqqBI",
+            self.round_id, self.generation, self.staleness,
+            self.kind, len(t),
+        ) + t
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "AsyncValue":
+        from distributed_learning_tpu.comm.tensor_codec import (
+            decode_fused_sparse,
+            decode_sparse,
+        )
+
+        r, gen, stale, kind, n = struct.unpack_from("<qqqBI", buf, 0)
+        body = buf[29 : 29 + n]
+        if kind == _ASYNC_SPARSE:
+            value = decode_sparse(body)
+        elif kind == _ASYNC_FUSED:
+            value = decode_fused_sparse(body)
+        else:
+            value = decode_tensor(body)
+        return cls(
+            round_id=r, generation=gen, staleness=stale,
+            value=value, kind=kind,
+        )
+
+
+@dataclasses.dataclass
+class AsyncPoke(Message):
+    """Agent -> neighbor of the async runtime: "your last value aged past
+    my staleness bound — push me a fresh one when you can".  The
+    re-request half of drop-and-re-request: the poked agent answers with
+    an :class:`AsyncValue` at its next dispatch-loop service point
+    (best-effort; a peer wedged in compute answers late by design)."""
+
+    TYPE_CODE: ClassVar[int] = 17
+    round_id: int = 0
+    generation: int = 0
+
+    def _pack(self) -> bytes:
+        return struct.pack("<qq", self.round_id, self.generation)
+
+    @classmethod
+    def _unpack(cls, buf: bytes) -> "AsyncPoke":
+        r, gen = struct.unpack_from("<qq", buf, 0)
+        return cls(round_id=r, generation=gen)
+
+
 _REGISTRY: Dict[int, Type[Message]] = {
     cls.TYPE_CODE: cls
     for cls in (
         Register, Ok, ErrorException, NeighborhoodData, NewRoundRequest,
         NewRoundNotification, ValueRequest, ValueResponse, Converged,
         NotConverged, Done, Shutdown, Telemetry, ValueResponseSparse,
-        ValueResponseFusedSparse,
+        ValueResponseFusedSparse, AsyncValue, AsyncPoke,
     )
 }
 

@@ -468,6 +468,11 @@ def straggler_profile_from_registry(
     if not lag:
         lag = _series_by_token(registry, "comm.agent.round_s/")
         source = "agent-round-wall"
+    if not lag:
+        # Pure async runs have no master-gated rounds at all: fall back
+        # to the async runtime's per-round wall times.
+        lag = _series_by_token(registry, "comm.agent.async_round_s/")
+        source = "agent-async-round-wall"
     # Per-round grouping for attribution (step == round id).
     rounds: Dict[Any, List[Tuple[str, float]]] = {}
     for token, pts in lag.items():
@@ -489,10 +494,17 @@ def straggler_profile_from_registry(
     if master_counts:
         slowest_counts = master_counts
 
+    # Staleness-vs-convergence picture (docs/async_runtime.md): the
+    # async runtime's per-mix staleness series and per-agent consensus
+    # residual trends, so the trade-off τ buys is readable from one
+    # merged JSONL.
+    staleness = _series_by_token(registry, "comm.agent.staleness/")
+    residual = _series_by_token(registry, "consensus.residual/")
+
     per_agent = {}
-    for token in sorted(lag):
-        vals = sorted(v for _, v in lag[token])
-        per_agent[token] = {
+    for token in sorted(set(lag) | set(staleness) | set(residual)):
+        vals = sorted(v for _, v in lag.get(token, ()))
+        entry = {
             "count": len(vals),
             "p50_s": _pct(vals, 0.50),
             "p95_s": _pct(vals, 0.95),
@@ -506,6 +518,28 @@ def straggler_profile_from_registry(
                 f"comm.agent.requests_deferred/{token}", 0
             ),
         }
+        spts = [v for _, v in staleness.get(token, ())]
+        if spts:
+            buckets: Dict[int, int] = {}
+            for v in spts:
+                buckets[int(v)] = buckets.get(int(v), 0) + 1
+            entry["staleness"] = {
+                "n": len(spts),
+                "mean": sum(spts) / len(spts),
+                "max": max(spts),
+                "hist": sorted(buckets.items()),
+            }
+            entry["stale_mixed"] = counters.get(
+                f"comm.agent.async_stale_mixed/{token}", 0
+            )
+            entry["stale_dropped_mix"] = counters.get(
+                f"comm.agent.async_stale_dropped/{token}", 0
+            )
+        rpts = [v for _, v in residual.get(token, ())]
+        if rpts:
+            entry["residual_first"] = rpts[0]
+            entry["residual_last"] = rpts[-1]
+        per_agent[token] = entry
     skew_pts = sorted(
         v for _, v in registry.series.get("straggler.skew_s", ())
     )

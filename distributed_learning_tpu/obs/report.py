@@ -137,6 +137,32 @@ def format_straggler_profile(profile: dict) -> str:
                 f"{a['slowest_rounds']:8d} {_fmt(a['stale_dropped']):>6} "
                 f"{_fmt(a['deferred']):>6}  {_bar(a['p95_s'], top)}"
             )
+    # Staleness vs convergence (docs/async_runtime.md): what the async
+    # runtime mixed stale/dropped, next to where each agent's consensus
+    # residual went — the τ trade-off in one table.
+    sv = {
+        t: a for t, a in per_agent.items()
+        if a.get("staleness") or "residual_last" in a
+    }
+    if sv:
+        lines.append("  staleness vs convergence")
+        lines.append(
+            f"  {'agent':10s} {'mixes':>6} {'stale mean':>11} "
+            f"{'stale max':>10} {'dropped':>8} {'resid first':>12} "
+            f"{'resid last':>12}"
+        )
+        for token in sorted(sv):
+            a = sv[token]
+            st = a.get("staleness") or {}
+            rf, rl = a.get("residual_first"), a.get("residual_last")
+            lines.append(
+                f"  {token:10s} {st.get('n', 0):6d} "
+                f"{st.get('mean', 0.0):11.2f} "
+                f"{_fmt(st.get('max', 0)):>10} "
+                f"{_fmt(a.get('stale_dropped_mix', 0)):>8} "
+                f"{(f'{rf:12.3g}' if rf is not None else ' ' * 12)} "
+                f"{(f'{rl:12.3g}' if rl is not None else ' ' * 12)}"
+            )
     if profile.get("slowest_agent") is not None:
         lines.append(f"  slowest agent: {profile['slowest_agent']}")
     return "\n".join(lines)
@@ -438,6 +464,22 @@ def render_dashboard(registry: MetricsRegistry, *,
         }
         worst = max(last.values())
         lines.append(f"consensus residual (worst last): {worst:.3g}")
+    # Async-runtime staleness line (docs/async_runtime.md): how stale
+    # the values being mixed are, and how much was dropped outright.
+    stale_pts = [
+        v for name, pts in registry.series.items()
+        if "comm.agent.staleness" in name
+        for _, v in pts
+    ]
+    if stale_pts:
+        dropped = int(
+            _sum_labeled(counters, "comm.agent.async_stale_dropped")
+        )
+        lines.append(
+            f"staleness: mean {sum(stale_pts) / len(stale_pts):.2f} · "
+            f"max {max(stale_pts):.0f} over {len(stale_pts)} mixes · "
+            f"{dropped} dropped"
+        )
     # Device-cost gauges (obs/cost.py): the sampled dispatch timer's
     # MFU / bytes-per-sec, per program name.
     mfus = {

@@ -39,6 +39,9 @@ __all__ = [
     "unflatten_stacked",
     "fused_dense_mix",
     "fused_max_deviation",
+    "stale_weight_matrix",
+    "presence_weight_matrix",
+    "stale_weighted_mix",
 ]
 
 
@@ -273,6 +276,100 @@ def dense_mix(
         return out.reshape(x.shape).astype(x.dtype)
 
     return jax.tree.map(mix_leaf, stacked)
+
+
+# --------------------------------------------------------------------- #
+# Stale-weighted mixing (the async gossip runtime's device program)      #
+# --------------------------------------------------------------------- #
+def stale_weight_matrix(
+    W: jax.Array, age: jax.Array, *, tau
+) -> jax.Array:
+    """Effective mixing matrix under per-agent publication staleness.
+
+    ``age[j]`` counts rounds since agent ``j`` last published its
+    parameters (the async runtime's double-buffer model: local compute
+    runs on buffer A while neighbors mix against the last *published*
+    buffer B).  Stale contributions are down-weighted by ``1/(1+age)``
+    (the stale-tolerant mixing of arXiv:2002.01119 §3) and DROPPED
+    beyond the hard staleness bound ``tau``; the dropped/decayed mass
+    of each row moves onto the self edge, so every row still sums to
+    exactly what it did before — row-stochasticity is restored on
+    device, no host round-trip.
+
+    Self edges never decay (an agent is never stale to itself).  With
+    ``age == 0`` everywhere the scale is exactly 1.0 and the result is
+    bitwise ``W`` — the async-with-neutral-knobs oracle rides on this.
+    """
+    W = jnp.asarray(W, jnp.float32)
+    n = W.shape[0]
+    agef = jnp.asarray(age).astype(jnp.float32)
+    scale = jnp.where(agef <= jnp.float32(tau), 1.0 / (1.0 + agef), 0.0)
+    eye = jnp.eye(n, dtype=bool)
+    off = jnp.where(eye, 0.0, W)
+    off_eff = jnp.where(eye, 0.0, W * scale[None, :])
+    dropped = jnp.sum(off - off_eff, axis=1)
+    # where-placement (not addition) keeps surviving off-diagonal
+    # entries bitwise untouched.
+    return jnp.where(
+        eye, (jnp.diagonal(W) + dropped)[:, None], off_eff
+    )
+
+
+def presence_weight_matrix(W: jax.Array, present: jax.Array) -> jax.Array:
+    """Effective mixing matrix when some agents sit a round out.
+
+    ``present[j]`` is 1.0/True for agents participating in this round
+    (deadline-enforced rounds drop rather than wait: a straggler that
+    missed the round deadline contributes nothing).  Edges to absent
+    agents get zero weight with the mass moved to the self edge (row
+    sums preserved on device); an absent agent's own row becomes the
+    identity — it keeps its value and re-joins next round.  With
+    everyone present the result is bitwise ``W``.
+    """
+    W = jnp.asarray(W, jnp.float32)
+    n = W.shape[0]
+    p = jnp.asarray(present).astype(jnp.float32)
+    eye = jnp.eye(n, dtype=bool)
+    off = jnp.where(eye, 0.0, W)
+    off_eff = jnp.where(eye, 0.0, W * p[None, :])
+    dropped = jnp.sum(off - off_eff, axis=1)
+    W_eff = jnp.where(eye, (jnp.diagonal(W) + dropped)[:, None], off_eff)
+    return jnp.where(
+        p[:, None] > 0.0, W_eff, jnp.eye(n, dtype=jnp.float32)
+    )
+
+
+def stale_weighted_mix(
+    stacked: Pytree,
+    published: Pytree,
+    W_eff: jax.Array,
+    *,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+) -> Pytree:
+    """One stale-weighted gossip round on double-buffered state:
+    ``x_i <- W_eff[i, i] * x_i + sum_{j != i} W_eff[i, j] * pub_j``.
+
+    Neighbor contributions come from the *published* buffer (the last
+    state each agent shipped), the self term from the live buffer (an
+    agent always has its own fresh value).  Computed as one GEMM per
+    leaf/bucket plus a rank-local diagonal correction,
+    ``W_eff @ pub + diag(W_eff) * (x - pub)`` — when ``pub`` carries
+    the same bits as ``x`` (every agent just published) the correction
+    is exactly zero and the round is bitwise :func:`dense_mix` under
+    ``W_eff``.
+    """
+    d = jnp.diagonal(jnp.asarray(W_eff, jnp.float32))
+
+    def leaf(xv: jax.Array, pv: jax.Array) -> jax.Array:
+        xf = xv.reshape(xv.shape[0], -1).astype(jnp.float32)
+        pf = pv.reshape(pv.shape[0], -1).astype(jnp.float32)
+        out = jnp.matmul(
+            jnp.asarray(W_eff, jnp.float32), pf, precision=precision
+        )
+        out = out + d[:, None] * (xf - pf)
+        return out.reshape(xv.shape).astype(xv.dtype)
+
+    return jax.tree.map(leaf, stacked, published)
 
 
 def _sq_dev_from_mean(stacked: Pytree) -> jax.Array:

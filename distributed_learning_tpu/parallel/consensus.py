@@ -31,7 +31,16 @@ per-method parity notes.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Hashable, Mapping, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Dict,
+    Hashable,
+    Mapping,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import jax
 import jax.numpy as jnp
@@ -49,10 +58,27 @@ Pytree = Any
 __all__ = [
     "ConsensusEngine",
     "Mixer",
+    "AsyncGossipState",
     "make_agent_mesh",
     "ring_offset_weights",
     "local_ring_mix",
 ]
+
+
+class AsyncGossipState(NamedTuple):
+    """Device-side carry of the simulated asynchronous gossip runtime
+    (docs/async_runtime.md): the double-buffer model on one chip.
+
+    ``pub`` is buffer B — the last state each agent *published* (what
+    neighbors mix against); the live params are buffer A.  ``age[j]``
+    counts gossip rounds since agent ``j`` last published; ``rnd`` is
+    the global async round counter (drives the per-agent publish
+    periods).  A pytree, so the whole carry threads through jit.
+    """
+
+    pub: Pytree
+    age: jax.Array  # (n,) int32
+    rnd: jax.Array  # () int32
 
 
 def make_agent_mesh(n: int, *, axis_name: str = "agents") -> Mesh:
@@ -970,6 +996,201 @@ class ConsensusEngine:
         return jax.shard_map(
             local, mesh=mesh, in_specs=(P(ax),), out_specs=P()
         )
+
+    # ------------------------------------------------------------------ #
+    # Asynchronous (stale-weighted) gossip: the device-side simulation   #
+    # of the comm-layer async runtime (docs/async_runtime.md)            #
+    # ------------------------------------------------------------------ #
+    def _normalize_periods(self, periods) -> Tuple[int, ...]:
+        """Static per-agent publish periods: agent ``j`` publishes its
+        params every ``periods[j]``-th async round (1 = every round; a
+        ``k``-slow straggler is ``periods[j] = k``)."""
+        if np.isscalar(periods):
+            periods = (int(periods),) * self.n
+        periods = tuple(int(p) for p in periods)
+        if len(periods) != self.n:
+            raise ValueError(
+                f"periods must have length {self.n}, got {len(periods)}"
+            )
+        if any(p < 1 for p in periods):
+            raise ValueError(f"publish periods must be >= 1, got {periods}")
+        return periods
+
+    def init_async_state(self, stacked: Pytree) -> AsyncGossipState:
+        """Fresh double-buffer carry: every agent publishes on the first
+        round (round 0 is a multiple of every period), so the initial
+        ``pub`` contents never survive a mix."""
+        return AsyncGossipState(
+            pub=jax.tree.map(jnp.asarray, stacked),
+            age=jnp.zeros((self.n,), jnp.int32),
+            rnd=jnp.int32(0),
+        )
+
+    def _async_round_body(self, tau: int, periods_dev: jax.Array):
+        """One async gossip round on (x, pub, age, rnd) — layout-agnostic
+        (serves the stacked tree and the fused buffer dict alike).
+
+        publish -> age -> stale-weighted mix: agents whose period divides
+        the round copy buffer A into buffer B (their age resets), every
+        agent then mixes its live value with the *published* neighbor
+        buffers under :func:`ops.mixing.stale_weight_matrix` — stale
+        neighbors decay as 1/(1+age) and drop beyond ``tau``, with the
+        lost mass renormalized onto the self edge on device.
+        """
+        W_dev, precision = self._W_dev, self.precision
+        tau = int(tau)
+
+        def round_once(x, pub, age, rnd):
+            publish = (rnd % periods_dev) == 0  # (n,) bool
+
+            def select(xv, pv):
+                m = publish.reshape((-1,) + (1,) * (xv.ndim - 1))
+                return jnp.where(m, xv, pv)
+
+            pub = jax.tree.map(select, x, pub)
+            age = jnp.where(publish, jnp.int32(0), age + jnp.int32(1))
+            W_eff = ops.stale_weight_matrix(W_dev, age, tau=tau)
+            x = ops.stale_weighted_mix(x, pub, W_eff, precision=precision)
+            return x, pub, age, rnd + jnp.int32(1)
+
+        return round_once
+
+    def _fuse_async_fn(self, run):
+        """Fused-layout wrapper for the double-buffered programs: both
+        the live state and the published buffer ravel with the SAME
+        layout (one flatten each at entry, one unflatten at exit), so
+        every async round moves O(dtype-buckets) GEMMs."""
+        if not self.fused:
+            return run
+
+        def wrapped(x, pub, *rest):
+            bx, layout = ops.flatten_stacked(x)
+            bp, _ = ops.flatten_stacked(pub, layout)
+            out = run(bx, bp, *rest)
+            return (
+                ops.unflatten_stacked(out[0], layout),
+                ops.unflatten_stacked(out[1], layout),
+            ) + tuple(out[2:])
+
+        return wrapped
+
+    def async_gossip_program(self, *, tau: int, periods, times: int = 1):
+        """Traceable ``(stacked, AsyncGossipState) -> (stacked, state)``
+        body of :meth:`mix_async` for a static round count — the program
+        the trainer's async knob embeds and the ``async_stale_mix``
+        graftlint audit entry pins.
+
+        With ``tau=0`` and ``periods`` all 1 every round publishes
+        (``pub`` carries the live bits), every age is 0, and
+        ``stale_weight_matrix`` returns ``W`` bitwise — the rounds are
+        bit-identical to :meth:`mix_program`'s: the lock-step path IS
+        the neutral point of this program, not a separate oracle.
+        """
+        periods = self._normalize_periods(periods)
+        times = int(times)
+        periods_dev = jnp.asarray(periods, jnp.int32)
+
+        if self.mesh is None:
+            round_once = self._async_round_body(tau, periods_dev)
+
+            def run(x, pub, age, rnd):
+                def body(_, carry):
+                    return round_once(*carry)
+
+                return lax.fori_loop(0, times, body, (x, pub, age, rnd))
+
+            fused = self._fuse_async_fn(run)
+
+            def program(x, st: AsyncGossipState):
+                x, pub, age, rnd = fused(x, st.pub, st.age, st.rnd)
+                return x, AsyncGossipState(pub, age, rnd)
+
+            return program
+
+        mesh, ax, n = self.mesh, self.axis_name, self.n
+        W_dev, precision = self._W_dev, self.precision
+        tau_i = int(tau)
+
+        def local_round(x, pub, age, rnd):
+            publish = (rnd % periods_dev) == 0
+            i = lax.axis_index(ax)
+            mine = publish[i]
+            pub = jax.tree.map(
+                lambda xv, pv: jnp.where(mine, xv, pv), x, pub
+            )
+            age = jnp.where(publish, jnp.int32(0), age + jnp.int32(1))
+            W_eff = ops.stale_weight_matrix(W_dev, age, tau=tau_i)
+            W_row = lax.dynamic_index_in_dim(W_eff, i, keepdims=False)
+            d = W_row[i]
+
+            def leaf(xv, pv):
+                ag = lax.all_gather(pv, ax, axis=0, tiled=True)
+                pf = ag.astype(jnp.float32).reshape(n, -1)
+                out = jnp.matmul(
+                    W_row.astype(jnp.float32), pf, precision=precision
+                )
+                xf = xv.reshape(xv.shape[0], -1).astype(jnp.float32)
+                lpf = pv.reshape(pv.shape[0], -1).astype(jnp.float32)
+                out = out[None] + d * (xf - lpf)
+                return out.reshape(xv.shape).astype(xv.dtype)
+
+            x = jax.tree.map(leaf, x, pub)
+            return x, pub, age, rnd + jnp.int32(1)
+
+        def local(x, pub, age, rnd):
+            def body(_, carry):
+                return local_round(*carry)
+
+            return lax.fori_loop(0, times, body, (x, pub, age, rnd))
+
+        inner = jax.shard_map(
+            self._fuse_async_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(ax), P(), P()),
+            out_specs=(P(ax), P(ax), P(), P()),
+        )
+
+        def program(x, st: AsyncGossipState):
+            x, pub, age, rnd = inner(x, st.pub, st.age, st.rnd)
+            return x, AsyncGossipState(pub, age, rnd)
+
+        return program
+
+    def mix_async(
+        self,
+        stacked: Pytree,
+        state: Optional[AsyncGossipState] = None,
+        *,
+        tau: int,
+        periods,
+        times: int = 1,
+    ) -> Tuple[Pytree, AsyncGossipState]:
+        """Run ``times`` asynchronous (stale-weighted, double-buffered)
+        gossip rounds; returns ``(mixed, carry)`` — thread the carry into
+        the next call so publish ages and the round counter persist
+        across epochs.  ``state=None`` starts a fresh carry.
+
+        This is the device-side simulation of the comm runtime's
+        straggler model (``comm/async_runtime.py``): ``periods[j] = k``
+        models an agent whose updates reach the fabric every k-th round,
+        ``tau`` bounds how stale a contribution may be before it is
+        dropped (weight renormalized on device).  ``tau=0`` with all
+        periods 1 is bit-identical to :meth:`mix`.
+        """
+        periods = self._normalize_periods(periods)
+        key = ("mix_async", int(tau), periods, int(times))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                self.async_gossip_program(
+                    tau=tau, periods=periods, times=times
+                )
+            )
+        if state is None:
+            state = self.init_async_state(stacked)
+        self._count_rounds(times)
+        self._note_layout(stacked, rounds=times)
+        with get_tracer().span("consensus.mix_async"):
+            return self._jit_cache[key](stacked, state)
 
     def cost_profile(self, stacked: Pytree, *, times: int = 1,
                      name: str = "consensus.mix"):

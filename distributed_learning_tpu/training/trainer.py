@@ -305,6 +305,7 @@ class GossipTrainer:
         compression_budget: str = "per-leaf",
         fused_consensus: bool = True,
         superstep: int = 1,
+        async_gossip: Any = None,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         obs: Any = None,
@@ -439,6 +440,47 @@ class GossipTrainer:
             raise ValueError(
                 "empty compression spec; use None or 'none' to disable"
             )
+        # Async gossip simulation (docs/async_runtime.md): the device-
+        # side model of the straggler-tolerant runtime — stale-weighted
+        # double-buffered mixing via ConsensusEngine.mix_async, carry
+        # threaded across epochs.  Accepts a mapping with
+        # `staleness_bound` (tau, default 0) and `publish_period` (int
+        # or per-agent sequence, default 1).  Neutral knobs (tau=0,
+        # periods all 1) are bit-identical to the plain-mix path.
+        self._async_sim = None
+        if async_gossip is not None and async_gossip is not False:
+            if not isinstance(async_gossip, Mapping):
+                raise ValueError(
+                    "async_gossip must be a mapping with keys "
+                    "'staleness_bound' and/or 'publish_period', got "
+                    f"{async_gossip!r}"
+                )
+            unknown = set(async_gossip) - {
+                "staleness_bound", "publish_period"
+            }
+            if unknown:
+                raise ValueError(
+                    f"unknown async_gossip keys: {sorted(unknown)}"
+                )
+            if (
+                self.chebyshev
+                or mix_eps is not None
+                or topology_schedule is not None
+                or mix_times_schedule is not None
+                or global_avg_every is not None
+                or compression is not None
+            ):
+                raise ValueError(
+                    "async_gossip applies to the plain-mix config only; "
+                    "it is mutually exclusive with chebyshev, mix_eps, "
+                    "topology_schedule, mix_times_schedule, "
+                    "global_avg_every, and compression"
+                )
+            self._async_sim = {
+                "tau": int(async_gossip.get("staleness_bound", 0)),
+                "periods": async_gossip.get("publish_period", 1),
+            }
+        self._async_state = None
         if compression is not None:
             if self.chebyshev or topology_schedule is not None or mix_eps is not None:
                 raise ValueError(
@@ -787,6 +829,7 @@ class GossipTrainer:
             jax.random.key(self.seed + 1),
         )
         self._choco_xhat = None  # fresh run: CHOCO estimates restart at 0
+        self._async_state = None  # fresh run: async publish buffer restarts
         return self
 
     # ------------------------------------------------------------------ #
@@ -850,6 +893,20 @@ class GossipTrainer:
                 )
         rounds = mix_times
         consensus_epochs = epoch_idx + 1 - self.epoch_cons_num
+        if self._async_sim is not None:
+            # Asynchronous stale-weighted gossip (docs/async_runtime.md):
+            # the double-buffer carry (published params, publish ages,
+            # round counter) threads across epochs so a straggler's
+            # publish cadence is continuous over the whole run.  With
+            # neutral knobs this is bit-identical to engine.mix.
+            params, self._async_state = self.engine.mix_async(
+                params,
+                self._async_state,
+                tau=self._async_sim["tau"],
+                periods=self._async_sim["periods"],
+                times=mix_times,
+            )
+            return params, rounds
         if (
             self.global_avg_every is not None
             and consensus_epochs % self.global_avg_every
@@ -1160,15 +1217,16 @@ class GossipTrainer:
 
     def _superstep_supported(self) -> bool:
         """Whether this config's gossip compiles into the superstep.
-        ``mix_times_schedule`` / ``topology_schedule`` / compression run
-        host logic between epochs (per-epoch python schedules, CHOCO's
-        cross-epoch estimate bookkeeping) — inherently chunk-hostile, so
-        they keep the per-epoch path rather than silently changing
-        semantics."""
+        ``mix_times_schedule`` / ``topology_schedule`` / compression /
+        async gossip run host logic between epochs (per-epoch python
+        schedules, CHOCO's and the async carry's cross-epoch
+        bookkeeping) — inherently chunk-hostile, so they keep the
+        per-epoch path rather than silently changing semantics."""
         return (
             self.mix_times_schedule is None
             and self.topology_schedule is None
             and self._choco is None
+            and self._async_sim is None
         )
 
     def _make_superstep_fn(self, k: int):
@@ -1272,8 +1330,9 @@ class GossipTrainer:
                 self._superstep_warned = True
                 warnings.warn(
                     "superstep: mix_times_schedule/topology_schedule/"
-                    "compression configs run per-epoch host logic between "
-                    "epochs and cannot be fused into one dispatch; "
+                    "compression/async_gossip configs run per-epoch host "
+                    "logic between epochs and cannot be fused into one "
+                    "dispatch; "
                     "falling back to K=1 (the per-epoch path, unchanged "
                     "semantics)",
                     stacklevel=2,

@@ -1,0 +1,169 @@
+"""Straggler demo of the asynchronous gossip runtime.
+
+Four loopback TCP agents on a ring, one injected 10x slow.  The same
+deployment runs twice:
+
+* **lock-step** — ``run_once`` rounds with a per-round barrier: every
+  agent's round completes at the straggler's pace (the protocol every
+  backend ran before ISSUE 8);
+* **async** — ``AsyncGossipRunner`` rounds (staleness bound tau=2,
+  10 ms deadline): fast agents mix the straggler's last received state
+  at decayed weight and keep their own pace; beyond tau the straggler
+  is dropped for the round and poked.
+
+Throughput and the staleness picture are printed FROM THE OBS REGISTRY
+(``comm.agent.*`` counters + the ``comm.agent.staleness`` series), the
+same channel the run-wide observability plane aggregates.
+
+    python -m examples.async_gossip [--rounds 20] [--slowdown 10]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import argparse
+import asyncio
+import time
+
+import numpy as np
+
+from distributed_learning_tpu.comm import (
+    AsyncGossipRunner,
+    ConsensusAgent,
+    ConsensusMaster,
+)
+from distributed_learning_tpu.obs import MetricsRegistry, use_registry
+
+RING4 = [("1", "2"), ("2", "3"), ("3", "4"), ("4", "1")]
+TOKENS = ("1", "2", "3", "4")
+SLOW = "4"
+
+
+async def _deploy():
+    master = ConsensusMaster(RING4, convergence_eps=1e-6)
+    host, port = await master.start()
+    agents = {t: ConsensusAgent(t, host, port) for t in TOKENS}
+    await asyncio.gather(*(a.start() for a in agents.values()))
+    return master, agents
+
+
+async def _teardown(master, agents):
+    await master.shutdown()
+    for a in agents.values():
+        await a.close(drain=0.1)
+
+
+async def run_lockstep(rounds, base_s, slow_s):
+    master, agents = await _deploy()
+    rng = np.random.default_rng(0)
+    vals = {t: rng.normal(size=64).astype(np.float32) for t in TOKENS}
+
+    async def one(t):
+        await asyncio.sleep(slow_s if t == SLOW else base_s)
+        vals[t] = await agents[t].run_once(vals[t])
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        await asyncio.gather(*(one(t) for t in TOKENS))
+    elapsed = time.perf_counter() - t0
+    spread = float(
+        np.max(np.std(np.stack([vals[t] for t in TOKENS]), axis=0))
+    )
+    await _teardown(master, agents)
+    return rounds / elapsed, spread
+
+
+async def run_async(rounds, base_s, slow_s, tau, deadline_s):
+    master, agents = await _deploy()
+    runners = {
+        t: AsyncGossipRunner(
+            agents[t], staleness_bound=tau, deadline_s=deadline_s
+        )
+        for t in TOKENS
+    }
+    rng = np.random.default_rng(0)
+    vals = {t: rng.normal(size=64).astype(np.float32) for t in TOKENS}
+    stop = asyncio.Event()
+
+    async def fast(t):
+        for _ in range(rounds):
+            vals[t] = await runners[t].run_async_round(
+                vals[t], local=lambda: asyncio.sleep(base_s)
+            )
+
+    async def slow(t):
+        while not stop.is_set():
+            vals[t] = await runners[t].run_async_round(
+                vals[t], local=lambda: asyncio.sleep(slow_s)
+            )
+
+    t0 = time.perf_counter()
+    slow_task = asyncio.ensure_future(slow(SLOW))
+    await asyncio.gather(*(fast(t) for t in TOKENS if t != SLOW))
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    await slow_task
+    spread = float(
+        np.max(np.std(np.stack([vals[t] for t in TOKENS]), axis=0))
+    )
+    slow_rounds = runners[SLOW].round
+    await _teardown(master, agents)
+    return rounds / elapsed, spread, slow_rounds
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--base-ms", type=float, default=5.0,
+                    help="fast agents' per-round compute (ms)")
+    ap.add_argument("--slowdown", type=float, default=10.0,
+                    help="straggler compute multiplier")
+    ap.add_argument("--staleness-bound", type=int, default=2)
+    ap.add_argument("--deadline-ms", type=float, default=10.0)
+    args = ap.parse_args()
+    base_s = args.base_ms / 1000.0
+    slow_s = base_s * args.slowdown
+
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        lock_rate, lock_spread = await run_lockstep(
+            args.rounds, base_s, slow_s
+        )
+    print(
+        f"lock-step: {lock_rate:7.1f} rounds/s  "
+        f"(every agent paced by the {args.slowdown:.0f}x straggler; "
+        f"spread {lock_spread:.2e})"
+    )
+
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        async_rate, async_spread, slow_rounds = await run_async(
+            args.rounds, base_s, slow_s,
+            args.staleness_bound, args.deadline_ms / 1000.0,
+        )
+    c = reg.counters
+    stale_pts = [
+        v for _, v in reg.series.get("comm.agent.staleness", ())
+    ]
+    print(
+        f"async:     {async_rate:7.1f} rounds/s  "
+        f"(fast agents; straggler completed {slow_rounds} of its own; "
+        f"spread {async_spread:.2e})"
+    )
+    print(
+        f"  staleness: mean "
+        f"{(sum(stale_pts) / len(stale_pts)) if stale_pts else 0.0:.2f} "
+        f"max {max(stale_pts) if stale_pts else 0:.0f} · "
+        f"stale-mixed {int(c.get('comm.agent.async_stale_mixed', 0))} · "
+        f"dropped {int(c.get('comm.agent.async_stale_dropped', 0))} · "
+        f"pokes {int(c.get('comm.agent.pokes_sent', 0))}"
+    )
+    print(f"async speedup: {async_rate / lock_rate:.2f}x")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

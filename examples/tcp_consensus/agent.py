@@ -21,7 +21,7 @@ import asyncio
 
 import numpy as np
 
-from distributed_learning_tpu.comm import ConsensusAgent
+from distributed_learning_tpu.comm import AsyncGossipRunner, ConsensusAgent
 from distributed_learning_tpu.obs import MetricsRegistry
 
 
@@ -42,6 +42,18 @@ async def main():
                     help="stream registry deltas to the master's "
                          "RunAggregator every N seconds (0 = off; pair "
                          "with master.py --obs-dir)")
+    ap.add_argument("--async", dest="async_mode", action="store_true",
+                    help="run asynchronous push-based gossip rounds "
+                         "(AsyncGossipRunner) instead of master-gated "
+                         "run_round consensus")
+    ap.add_argument("--staleness-bound", type=int, default=0,
+                    help="async mode: mix values up to tau rounds stale "
+                         "at w/(1+s) weight, drop older (0 = "
+                         "synchronous, bit-identical to lock-step)")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="async mode: cap any blocking wait; expiry "
+                         "drops the straggler for the round and pokes "
+                         "it")
     args = ap.parse_args()
 
     agent = ConsensusAgent(
@@ -58,10 +70,26 @@ async def main():
     i = (int(args.token) - 1) % args.dim
     x = (10.0 * np.eye(args.dim, dtype=np.float32)[i]).copy()
     weight = args.weight if args.weight is not None else float(args.token)
+    runner = None
+    if args.async_mode:
+        runner = AsyncGossipRunner(
+            agent, staleness_bound=args.staleness_bound,
+            deadline_s=args.deadline_s,
+        )
     for r in range(args.rounds):
-        x = await agent.run_round(x, weight)
-        print(f"agent {agent.token} round {r}: {np.round(x, 4).tolist()}",
-              flush=True)
+        if runner is not None:
+            x = await runner.run_async_round(x)
+            stats = runner.last_stats
+            print(
+                f"agent {agent.token} round {r}: "
+                f"{np.round(x, 4).tolist()} "
+                f"(stale {stats.mixed}, dropped {stats.dropped})",
+                flush=True,
+            )
+        else:
+            x = await agent.run_round(x, weight)
+            print(f"agent {agent.token} round {r}: {np.round(x, 4).tolist()}",
+                  flush=True)
         await agent.send_telemetry({"round": r, "norm": float(np.linalg.norm(x))})
     if args.obs_period > 0:
         await agent.send_obs_delta()  # ship the tail before closing

@@ -50,6 +50,14 @@ async def main():
     ap.add_argument("--eps", type=float, default=1e-6)
     ap.add_argument("--elastic", action="store_true",
                     help="survive agent death; allow token rejoin")
+    ap.add_argument("--regenerate", action="store_true",
+                    help="elastic membership: on death/(re)join, re-form "
+                         "the topology over live agents, re-solve W, and "
+                         "broadcast a new membership generation")
+    ap.add_argument("--enforce-deadline", action="store_true",
+                    help="promote --round-deadline from observe-only to "
+                         "drop-rather-than-wait (formation drops missing "
+                         "agents; an overstaying round is cut)")
     ap.add_argument("--obs-dir", default=None,
                     help="host the run-wide observability plane: "
                          "aggregate.jsonl stream, flight-recorder dumps, "
@@ -71,8 +79,10 @@ async def main():
     master = ConsensusMaster(
         edges, port=args.port, weight_mode=args.weights,
         convergence_eps=args.eps, telemetry=PrintTelemetry(),
-        elastic=args.elastic, aggregator=aggregator, flight=flight,
+        elastic=args.elastic, regenerate=args.regenerate,
+        aggregator=aggregator, flight=flight,
         round_deadline_s=args.round_deadline,
+        enforce_round_deadline=args.enforce_deadline,
     )
     host, port = await master.start()
     print(f"master listening on {host}:{port}; topology {edges}", flush=True)

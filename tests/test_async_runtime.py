@@ -1,0 +1,705 @@
+"""Asynchronous straggler-tolerant gossip runtime (ISSUE 8).
+
+Three layers under test:
+
+* device — the stale-weighted double-buffered mixing program
+  (``ops/mixing.py`` + ``ConsensusEngine.mix_async``): row-stochasticity
+  under staleness/presence renormalization, the BIT-IDENTITY oracle at
+  neutral knobs (tau=0, all periods 1 == the lock-step ``mix``), and the
+  convergence-vs-staleness oracle (residual decreasing in expectation
+  for tau in {1, 4} under a straggling publisher);
+* wire — ``FramedStream`` read timeouts (frame-boundary safe) and
+  bounded-backoff send retry; the ``AsyncGossipRunner`` push/poke
+  protocol with its tau=0 lock-step bit-identity (plain AND CHOCO) and
+  its drop-and-poke straggler behavior;
+* control — deadline-ENFORCED rounds (formation drop + mid-round cut)
+  and elastic membership generations (death -> flight dump + topology/W
+  regeneration, row-stochastic at every generation; rejoin and join
+  realign via the generation counter and reach the consensus fixed
+  point).
+"""
+
+import asyncio
+import errno
+import glob
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from distributed_learning_tpu.comm import (
+    AsyncGossipRunner,
+    ConsensusAgent,
+    ConsensusMaster,
+)
+from distributed_learning_tpu.comm.framing import (
+    FramedStream,
+    FrameTimeout,
+)
+from distributed_learning_tpu.comm import protocol as P
+from distributed_learning_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    use_registry,
+)
+from distributed_learning_tpu.ops import mixing as ops
+from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+from distributed_learning_tpu.parallel.topology import Topology
+
+TRIANGLE = [("A", "B"), ("B", "C"), ("C", "A")]
+RING4 = [("1", "2"), ("2", "3"), ("3", "4"), ("4", "1")]
+
+
+# --------------------------------------------------------------------- #
+# Device layer: stale-weighted mixing                                   #
+# --------------------------------------------------------------------- #
+def test_stale_weight_matrix_row_stochastic_and_neutral():
+    W = jnp.asarray(Topology.ring(5).metropolis_weights(), jnp.float32)
+    age = jnp.asarray([0, 1, 3, 7, 2])
+    We = ops.stale_weight_matrix(W, age, tau=3)
+    np.testing.assert_allclose(np.asarray(We).sum(axis=1), 1.0, atol=1e-6)
+    # Beyond tau the column is dropped entirely (off-diagonal zero).
+    We_np = np.asarray(We)
+    for i in range(5):
+        if i != 3:
+            assert We_np[i, 3] == 0.0
+    # Within tau the edge decays as 1/(1+s).
+    W_np = np.asarray(W)
+    assert We_np[0, 1] == pytest.approx(W_np[0, 1] / 2.0)
+    # Neutral: age 0 everywhere is bitwise W.
+    We0 = ops.stale_weight_matrix(W, jnp.zeros(5, jnp.int32), tau=0)
+    assert np.array_equal(np.asarray(We0), W_np)
+
+
+def test_presence_weight_matrix_drops_and_renormalizes():
+    W = jnp.asarray(Topology.ring(4).metropolis_weights(), jnp.float32)
+    present = jnp.asarray([1.0, 0.0, 1.0, 1.0])
+    Wp = np.asarray(ops.presence_weight_matrix(W, present))
+    np.testing.assert_allclose(Wp.sum(axis=1), 1.0, atol=1e-6)
+    # The absent agent's row is the identity; its column is zero
+    # elsewhere (nobody mixes a value that did not arrive).
+    np.testing.assert_allclose(Wp[1], np.eye(4)[1])
+    for i in (0, 2, 3):
+        assert Wp[i, 1] == 0.0
+    # Everyone present is bitwise W.
+    Wall = np.asarray(
+        ops.presence_weight_matrix(W, jnp.ones(4, jnp.float32))
+    )
+    assert np.array_equal(Wall, np.asarray(W))
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_mix_async_neutral_is_bit_identical_to_mix(fused):
+    """The acceptance oracle, device side: tau=0 + all periods 1 ==
+    the lock-step ``mix`` program, bit for bit, including across a
+    carried state."""
+    n = 4
+    eng = ConsensusEngine(
+        Topology.ring(n).metropolis_weights(), fused=fused
+    )
+    rng = np.random.default_rng(3)
+    x = {
+        "w": jnp.asarray(rng.normal(size=(n, 3, 2)).astype(np.float32)),
+        "b": jnp.zeros((n, 5), jnp.float32),
+        "h": jnp.asarray(
+            rng.normal(size=(n, 4)).astype(np.float32)
+        ).astype(jnp.bfloat16),
+    }
+    ref = eng.mix(x, times=3)
+    got, st = eng.mix_async(x, tau=0, periods=1, times=3)
+    for k in ref:
+        assert np.array_equal(np.asarray(ref[k]), np.asarray(got[k])), k
+    # The carry threads: a second call continues bit-identically.
+    ref2 = eng.mix(ref, times=2)
+    got2, _ = eng.mix_async(got, st, tau=0, periods=1, times=2)
+    for k in ref2:
+        assert np.array_equal(np.asarray(ref2[k]), np.asarray(got2[k])), k
+    assert int(st.rnd) == 3 and np.asarray(st.age).max() == 0
+
+
+@pytest.mark.parametrize("tau", [1, 4])
+def test_mix_async_convergence_monotone_under_straggler(tau):
+    """Convergence-vs-staleness oracle: with one 3-slow publisher the
+    consensus residual still decreases monotonically in expectation
+    (checked on block checkpoints) for tau in {1, 4}."""
+    n = 8
+    eng = ConsensusEngine(Topology.ring(n).metropolis_weights())
+    rng = np.random.default_rng(7)
+    x = {"w": jnp.asarray(rng.normal(size=(n, 16)).astype(np.float32))}
+    periods = (1,) * (n - 1) + (3,)
+    st = None
+    checkpoints = []
+    for r in range(48):
+        x, st = eng.mix_async(x, st, tau=tau, periods=periods, times=1)
+        if (r + 1) % 8 == 0:
+            checkpoints.append(float(eng.max_deviation(x)))
+    assert all(
+        b < a for a, b in zip(checkpoints, checkpoints[1:])
+    ), checkpoints
+    assert checkpoints[-1] < checkpoints[0] * 1e-2
+
+
+def test_trainer_async_neutral_bit_identity_and_straggler_run():
+    """The acceptance oracle, trainer side: async_gossip with neutral
+    knobs is bit-identical to the plain-mix trainer — params, opt
+    state, per-step losses, AND the per-round residual; a straggler
+    config trains and keeps a bounded deviation."""
+    from distributed_learning_tpu.training.trainer import GossipTrainer
+
+    def make(async_gossip=None):
+        n = 4
+        rng = np.random.default_rng(0)
+        train = {
+            i: (
+                rng.normal(size=(32, 6)).astype(np.float32),
+                rng.integers(0, 3, size=(32,)).astype(np.int32),
+            )
+            for i in range(n)
+        }
+        tr = GossipTrainer(
+            node_names=list(range(n)), model="mlp",
+            model_kwargs={"hidden_dim": 8, "output_dim": 3},
+            weights=Topology.ring(n), train_data=train, batch_size=8,
+            epoch_len=2, mix_times=2, dropout=False, donate_state=False,
+            async_gossip=async_gossip,
+        )
+        tr.initialize_nodes()
+        return tr
+
+    a = make()
+    b = make(async_gossip={"staleness_bound": 0, "publish_period": 1})
+    for _ in range(3):
+        ra, rb = a.train_epoch(), b.train_epoch()
+        assert np.array_equal(ra["train_loss"], rb["train_loss"])
+        assert ra["deviation"] == rb["deviation"]
+    for la, lb in zip(
+        jax.tree.leaves(a._state[0]), jax.tree.leaves(b._state[0])
+    ):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+    for la, lb in zip(
+        jax.tree.leaves(a._state[2]), jax.tree.leaves(b._state[2])
+    ):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+    c = make(
+        async_gossip={
+            "staleness_bound": 3, "publish_period": (1, 1, 1, 4)
+        }
+    )
+    devs = [c.train_epoch()["deviation"] for _ in range(5)]
+    assert all(np.isfinite(devs)) and max(devs) < 0.1
+
+    # Exclusivity: async gossip is the plain-mix path only.
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_kwargs = dict(async_gossip={"staleness_bound": 1})
+        n = 2
+        rng = np.random.default_rng(0)
+        train = {
+            i: (
+                rng.normal(size=(16, 6)).astype(np.float32),
+                rng.integers(0, 3, size=(16,)).astype(np.int32),
+            )
+            for i in range(n)
+        }
+        GossipTrainer(
+            node_names=list(range(n)), model="mlp",
+            model_kwargs={"hidden_dim": 4, "output_dim": 3},
+            weights=Topology.ring(2), train_data=train, batch_size=8,
+            chebyshev=True, **make_kwargs,
+        )
+
+
+# --------------------------------------------------------------------- #
+# Wire layer: framing resilience                                        #
+# --------------------------------------------------------------------- #
+def test_framed_stream_send_retries_transient_errors():
+    class FlakyWriter:
+        def __init__(self, failures):
+            self.failures = failures
+            self.chunks = []
+
+        def write(self, data):
+            self.chunks.append(data)
+
+        async def drain(self):
+            if self.failures:
+                self.failures -= 1
+                self.chunks.pop()
+                raise OSError(errno.EAGAIN, "try again")
+
+        def close(self):
+            pass
+
+    async def main():
+        retries = []
+        w = FlakyWriter(failures=2)
+        s = FramedStream(
+            None, w, send_retries=3, retry_base_s=0.001,
+            on_retry=lambda: retries.append(1),
+        )
+        await s.send(P.Ok(info="hi"))
+        assert len(retries) == 2
+        assert s.frames_sent == 1 and len(w.chunks) == 1
+
+        # A connection error is NOT transient: no retry, first raise.
+        class DeadWriter(FlakyWriter):
+            async def drain(self):
+                raise ConnectionResetError(
+                    errno.ECONNRESET, "peer gone"
+                )
+
+        s2 = FramedStream(
+            None, DeadWriter(0), send_retries=3,
+            on_retry=lambda: retries.append(1),
+        )
+        with pytest.raises(ConnectionError):
+            await s2.send(P.Ok())
+        assert len(retries) == 2  # unchanged
+
+        # Retries exhausted -> the transient error surfaces.
+        s3 = FramedStream(
+            None, FlakyWriter(failures=5), send_retries=2,
+            retry_base_s=0.001,
+        )
+        with pytest.raises(OSError):
+            await s3.send(P.Ok())
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_framed_stream_recv_timeout_is_frame_boundary_safe():
+    """A recv timeout while no frame has started raises FrameTimeout
+    (not ConnectionError) and leaves the stream fully usable — the
+    next recv returns the late frame intact."""
+
+    async def main():
+        server_streams = []
+
+        async def on_conn(reader, writer):
+            server_streams.append(FramedStream(reader, writer))
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        client = FramedStream(reader, writer)
+        await asyncio.sleep(0.05)
+        (srv,) = server_streams
+
+        with pytest.raises(FrameTimeout):
+            await client.recv(timeout=0.05)
+        assert not isinstance(
+            FrameTimeout("x"), ConnectionError
+        )  # heal paths must not evict on quiet periods
+        # The late frame arrives whole.
+        await srv.send(P.Telemetry(token="t", payload={"k": 1}))
+        msg = await client.recv(timeout=1.0)
+        assert isinstance(msg, P.Telemetry) and msg.payload == {"k": 1}
+        client.close()
+        srv.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+# --------------------------------------------------------------------- #
+# Runner: tau=0 lock-step oracle + straggler behavior                   #
+# --------------------------------------------------------------------- #
+async def _deploy(edges=TRIANGLE, tokens="ABC", **kw):
+    master = ConsensusMaster(edges, convergence_eps=1e-7, **kw)
+    host, port = await master.start()
+    agents = {t: ConsensusAgent(t, host, port) for t in tokens}
+    await asyncio.gather(*(a.start() for a in agents.values()))
+    return master, agents
+
+
+async def _teardown(master, agents):
+    await master.shutdown()
+    for a in agents.values():
+        await a.close(drain=0.1)
+
+
+def test_async_runner_tau0_bit_identical_to_lockstep_plain_and_choco():
+    """The acceptance oracle, wire side: async rounds with tau=0, no
+    deadline, static membership are bit-identical to the lock-step
+    ``run_once`` / ``run_choco_once`` sequences — plain AND compressed."""
+
+    def topk(v):
+        k = max(1, v.size // 2)
+        out = np.zeros_like(v)
+        idx = np.argsort(np.abs(v))[-k:]
+        out[idx] = v[idx]
+        return out
+
+    async def lockstep(choco):
+        master, agents = await _deploy()
+        rng = np.random.default_rng(0)
+        xs = {t: rng.normal(size=8).astype(np.float32) for t in "ABC"}
+        for _ in range(5):
+            if choco:
+                outs = await asyncio.gather(
+                    *(
+                        agents[t].run_choco_once(xs[t], topk, gamma=0.4)
+                        for t in "ABC"
+                    )
+                )
+            else:
+                outs = await asyncio.gather(
+                    *(agents[t].run_once(xs[t]) for t in "ABC")
+                )
+            xs = dict(zip("ABC", outs))
+        await _teardown(master, agents)
+        return xs
+
+    async def async_mode(choco):
+        master, agents = await _deploy()
+        runners = {
+            t: AsyncGossipRunner(agents[t], staleness_bound=0)
+            for t in "ABC"
+        }
+        rng = np.random.default_rng(0)
+        xs = {t: rng.normal(size=8).astype(np.float32) for t in "ABC"}
+        for _ in range(5):
+            if choco:
+                outs = await asyncio.gather(
+                    *(
+                        runners[t].run_async_choco(
+                            xs[t], topk, gamma=0.4
+                        )
+                        for t in "ABC"
+                    )
+                )
+            else:
+                outs = await asyncio.gather(
+                    *(runners[t].run_async_round(xs[t]) for t in "ABC")
+                )
+            xs = dict(zip("ABC", outs))
+        await _teardown(master, agents)
+        return xs
+
+    async def main():
+        for choco in (False, True):
+            ref = await lockstep(choco)
+            got = await async_mode(choco)
+            for t in "ABC":
+                assert np.array_equal(ref[t], got[t]), (choco, t)
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_async_runner_straggler_drops_pokes_and_observes():
+    """Straggler behavior: fast agents outpace a slow one, mix its
+    stale value within tau, drop-and-poke beyond it, and the staleness
+    series + counters land in the registry (the histogram channel the
+    straggler profile consumes)."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            master, agents = await _deploy(RING4, tokens="1234")
+            runners = {
+                t: AsyncGossipRunner(
+                    agents[t], staleness_bound=1, deadline_s=0.05
+                )
+                for t in "1234"
+            }
+            rng = np.random.default_rng(1)
+            vals = {
+                t: rng.normal(size=16).astype(np.float32)
+                for t in "1234"
+            }
+            stop = asyncio.Event()
+
+            async def fast(t):
+                x = vals[t]
+                for _ in range(12):
+                    x = await runners[t].run_async_round(
+                        x, local=lambda: asyncio.sleep(0.003)
+                    )
+                return x
+
+            async def slow(t):
+                x = vals[t]
+                while not stop.is_set():
+                    x = await runners[t].run_async_round(
+                        x, local=lambda: asyncio.sleep(0.05)
+                    )
+                return x
+
+            slow_task = asyncio.ensure_future(slow("4"))
+            await asyncio.gather(*(fast(t) for t in "123"))
+            stop.set()
+            await slow_task
+            fast_rounds = runners["1"].round
+            slow_rounds = runners["4"].round
+            counters = dict(reg.counters)
+            await _teardown(master, agents)
+        assert fast_rounds == 12 and slow_rounds < fast_rounds
+        assert counters.get("comm.agent.async_stale_dropped", 0) > 0
+        assert counters.get("comm.agent.pokes_sent", 0) >= 1
+        assert counters.get("comm.agent.async_rounds", 0) >= 36
+        stale = [
+            v for _, v in reg.series.get("comm.agent.staleness", ())
+        ]
+        assert stale and max(stale) >= 1
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+# --------------------------------------------------------------------- #
+# Control plane: deadline-enforced rounds                               #
+# --------------------------------------------------------------------- #
+def test_enforced_formation_deadline_drops_missing_agent():
+    """Drop-rather-than-wait, formation phase: a round whose quorum is
+    still missing an agent when the deadline fires starts without it —
+    participants converge to the weighted mean over PARTICIPANTS (the
+    dropped edges renormalize), and the straggler's late request forms
+    its own later round instead of erroring."""
+
+    async def main():
+        master, agents = await _deploy(
+            round_deadline_s=0.25, enforce_round_deadline=True
+        )
+        vals = {
+            "A": np.full(3, 3.0, np.float32),
+            "B": np.full(3, 9.0, np.float32),
+            "C": np.full(3, 100.0, np.float32),
+        }
+
+        async def late_c():
+            await asyncio.sleep(0.8)
+            return await agents["C"].run_round(vals["C"], 1.0)
+
+        ra, rb, rc = await asyncio.gather(
+            agents["A"].run_round(vals["A"], 1.0),
+            agents["B"].run_round(vals["B"], 1.0),
+            late_c(),
+        )
+        # A and B agreed on THEIR weighted mean; C was dropped.
+        np.testing.assert_allclose(ra, 6.0, atol=1e-3)
+        np.testing.assert_allclose(rb, 6.0, atol=1e-3)
+        # C's own (solo or later) round returned a finite value
+        # without deadlocking the deployment.
+        assert np.isfinite(rc).all()
+        assert master.counters.get("round_formation_deadlines", 0) >= 1
+        assert master.counters.get("round_agents_dropped", 0) >= 1
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_enforced_mid_round_deadline_cuts_the_round():
+    """Drop-rather-than-wait, in-round phase: an unreachable eps keeps
+    the round iterating forever; the enforced deadline cuts it with
+    Done(deadline=True) and every agent returns its current value."""
+
+    class SlowIterAgent(ConsensusAgent):
+        """Each gossip iteration pays 50 ms — with an unreachable eps
+        the round cannot end before the 0.3 s deadline."""
+
+        async def _gossip_iteration(self, y):
+            await asyncio.sleep(0.05)
+            return await super()._gossip_iteration(y)
+
+    async def main():
+        # Path graph: convergence is geometric, never exact within the
+        # few iterations the deadline allows (a triangle's uniform
+        # weights would hit the exact fixed point in one step).
+        master = ConsensusMaster(
+            [("A", "B"), ("B", "C")], convergence_eps=1e-30,
+            weight_mode="metropolis",
+            round_deadline_s=0.3, enforce_round_deadline=True,
+        )
+        host, port = await master.start()
+        agents = {t: SlowIterAgent(t, host, port) for t in "ABC"}
+        await asyncio.gather(*(a.start() for a in agents.values()))
+        vals = {
+            t: np.full(2, float(i), np.float32)
+            for i, t in enumerate("ABC")
+        }
+        outs = await asyncio.gather(
+            *(agents[t].run_round(vals[t], 1.0) for t in "ABC")
+        )
+        # Partially converged values came back (the cut returns the
+        # current iterate, bounded between the extremes).
+        for out in outs:
+            assert np.isfinite(out).all()
+            assert 0.0 <= out.min() and out.max() <= 2.0
+        assert master.counters.get("rounds_deadline_cut", 0) == 1
+        assert master.counters.get("round_deadlines_expired", 0) >= 1
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+# --------------------------------------------------------------------- #
+# Elastic membership generations                                        #
+# --------------------------------------------------------------------- #
+def test_elastic_membership_death_regen_rejoin_join(tmp_path):
+    """The acceptance scenario: an agent crash mid-run triggers a
+    flight dump, the master re-forms the topology and re-solves
+    fastest-mixing weights (row-stochastic at EVERY generation), the
+    survivors keep making progress at N-1, a rejoin realigns via the
+    generation counter, and the run reaches the consensus fixed point;
+    a brand-new token then JOINS the running deployment."""
+
+    async def heal_round(token, agent, value, weight=1.0):
+        for _ in range(5):
+            try:
+                return await agent.run_round(value, weight)
+            except ConnectionError:
+                await agent.wait_neighbors(timeout=20.0)
+        raise AssertionError(f"{token} could not complete the round")
+
+    async def main():
+        flight = FlightRecorder(str(tmp_path))
+        master = ConsensusMaster(
+            RING4, convergence_eps=1e-7, weight_mode="sdp",
+            regenerate=True, flight=flight,
+        )
+        host, port = await master.start()
+        agents = {t: ConsensusAgent(t, host, port) for t in "1234"}
+        await asyncio.gather(*(a.start() for a in agents.values()))
+        vals = {
+            t: np.full(3, float(t), np.float32) for t in "1234"
+        }
+        outs = await asyncio.gather(
+            *(agents[t].run_round(vals[t], 1.0) for t in "1234")
+        )
+        for out in outs:
+            np.testing.assert_allclose(out, 2.5, atol=1e-3)
+        assert master.generation == 0
+        np.testing.assert_allclose(master.W.sum(axis=1), 1.0, atol=1e-8)
+
+        # --- crash mid-run -------------------------------------------- #
+        await agents["2"].close(drain=0)
+        deadline = asyncio.get_event_loop().time() + 10
+        while master.generation < 1:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        assert sorted(master._tokens) == ["1", "3", "4"]
+        np.testing.assert_allclose(master.W.sum(axis=1), 1.0, atol=1e-8)
+        dumps = glob.glob(os.path.join(str(tmp_path), "flight-*"))
+        assert dumps, "agent death must trigger a flight dump"
+
+        # Survivors keep converging at N-1 under the regenerated W.
+        outs = await asyncio.gather(
+            *(
+                heal_round(t, agents[t], vals[t])
+                for t in ("1", "3", "4")
+            )
+        )
+        for out in outs:
+            np.testing.assert_allclose(
+                out, (1.0 + 3.0 + 4.0) / 3.0, atol=1e-3
+            )
+        assert all(agents[t].generation == 1 for t in ("1", "3", "4"))
+
+        # --- rejoin ---------------------------------------------------- #
+        b2 = ConsensusAgent("2", host, port, rejoin=True)
+        start_task = asyncio.ensure_future(b2.start())
+        deadline = asyncio.get_event_loop().time() + 10
+        while master.generation < 2:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        # Survivors heal concurrently: their queued generation broadcast
+        # is applied inside wait_neighbors, which also accepts the
+        # rejoiner's dial-ins.
+        await asyncio.gather(
+            *(agents[t].wait_neighbors(20.0) for t in ("1", "3", "4"))
+        )
+        await start_task
+        agents["2"] = b2
+        assert master.generation == 2
+        assert sorted(master._tokens) == ["1", "2", "3", "4"]
+        np.testing.assert_allclose(master.W.sum(axis=1), 1.0, atol=1e-8)
+        outs = await asyncio.gather(
+            *(heal_round(t, agents[t], vals[t]) for t in "1234")
+        )
+        for out in outs:
+            # Back to the ORIGINAL consensus fixed point: the full
+            # membership's weighted mean.
+            np.testing.assert_allclose(out, 2.5, atol=1e-3)
+        assert all(agents[t].generation == 2 for t in "1234")
+
+        # --- a brand-new token joins mid-run -------------------------- #
+        j = ConsensusAgent("5", host, port, rejoin=True)
+        start_task = asyncio.ensure_future(j.start())
+        deadline = asyncio.get_event_loop().time() + 10
+        while master.generation < 3:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        await asyncio.gather(
+            *(agents[t].wait_neighbors(20.0) for t in "1234")
+        )
+        await start_task
+        agents["5"] = j
+        assert master.generation == 3
+        assert "5" in master._tokens
+        np.testing.assert_allclose(master.W.sum(axis=1), 1.0, atol=1e-8)
+        vals["5"] = np.full(3, 10.0, np.float32)
+        outs = await asyncio.gather(
+            *(heal_round(t, agents[t], vals[t]) for t in "12345")
+        )
+        for out in outs:
+            np.testing.assert_allclose(out, 4.0, atol=1e-3)
+
+        await _teardown(master, agents)
+
+    asyncio.run(asyncio.wait_for(main(), 180))
+
+
+# --------------------------------------------------------------------- #
+# Obs: staleness feeds the straggler profile                            #
+# --------------------------------------------------------------------- #
+def test_straggler_profile_gains_staleness_vs_convergence():
+    from distributed_learning_tpu.obs.aggregate import (
+        straggler_profile_from_registry,
+    )
+    from distributed_learning_tpu.obs.report import (
+        format_straggler_profile,
+    )
+
+    reg = MetricsRegistry(clock=lambda: 0.0)
+    for r in range(6):
+        reg.observe("comm.agent.async_round_s/a", 0.01, step=r)
+        reg.observe("comm.agent.async_round_s/b", 0.1, step=r)
+        reg.observe("comm.agent.staleness/a", 0.0, step=r)
+        reg.observe("comm.agent.staleness/b", float(min(r, 3)), step=r)
+        reg.observe("consensus.residual/a", 1.0 / (r + 1), step=r)
+        reg.observe("consensus.residual/b", 2.0 / (r + 1), step=r)
+    reg.inc("comm.agent.async_stale_mixed/b", 4)
+    reg.inc("comm.agent.async_stale_dropped/b", 2)
+
+    profile = straggler_profile_from_registry(reg)
+    assert profile["source"] == "agent-async-round-wall"
+    b = profile["per_agent"]["b"]
+    assert b["staleness"]["max"] == 3
+    assert b["staleness"]["n"] == 6
+    assert b["stale_mixed"] == 4 and b["stale_dropped_mix"] == 2
+    assert b["residual_first"] == 2.0
+    assert b["residual_last"] == pytest.approx(2.0 / 6.0)
+    text = format_straggler_profile(profile)
+    assert "staleness vs convergence" in text
+    assert "resid first" in text
+
+    # obs-monitor renders the staleness line off the same series.
+    from distributed_learning_tpu.obs.report import render_dashboard
+
+    frame = render_dashboard(reg, now=0.0)
+    assert "staleness: mean" in frame and "dropped" in frame
+
+    asyncio.run(asyncio.sleep(0))  # keep the event loop policy clean
+
+    # The AsyncValue wire frame carries the staleness/generation fields
+    # end to end (the schema the doc pins).
+    msg = P.AsyncValue(
+        round_id=3, generation=2, staleness=1,
+        value=np.arange(3, dtype=np.float32),
+    )
+    code, body = P.pack_message(msg)
+    back = P.unpack_message(code, body)
+    assert (back.round_id, back.generation, back.staleness) == (3, 2, 1)

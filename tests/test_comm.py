@@ -116,8 +116,19 @@ def test_protocol_message_roundtrips():
         P.NotConverged(round_id=7, iteration=3),
         P.Done(round_id=7),
         P.Done(round_id=8, aborted=True),
+        P.Done(round_id=9, deadline=True),
         P.Shutdown(reason="bye"),
         P.Telemetry(token="a", payload={"loss": 0.5, "n": 3}),
+        P.AsyncValue(
+            round_id=4, generation=2, staleness=1,
+            value=np.arange(6, dtype=np.float32),
+        ),
+        P.AsyncValue(
+            round_id=5, generation=2,
+            value=np.array([0, 0, 2.5, 0, -1.0, 0], np.float32),
+            kind=1,  # sparse payload
+        ),
+        P.AsyncPoke(round_id=5, generation=2),
     ]
     assert {type(m).TYPE_CODE for m in msgs} == set(P._REGISTRY), (
         "roundtrip list must cover every registered message type"

@@ -208,6 +208,36 @@ def test_host_sync_ignores_cold_paths(tmp_path):
     assert fs == []
 
 
+def test_host_sync_covers_async_runtime_dispatch_loop(tmp_path):
+    """The async gossip runtime's per-round receive/mix functions are
+    hot roots WITHOUT any jit/scan marker (extra_hot_functions): a
+    device sync there stalls the fabric once per gossip round.  The
+    same code outside the registered functions (or the registered file)
+    stays cold."""
+    code = """
+    import numpy as np
+
+    class AsyncGossipRunner:
+        def _mix_plain(self, y):
+            return float(y)
+
+        def _collect(self):
+            return np.asarray([1.0])
+
+    def elsewhere(y):
+        return np.asarray(y)
+    """
+    fs = _lint(
+        tmp_path, code,
+        relname="distributed_learning_tpu/comm/async_runtime.py",
+        rules=["host-sync-in-hot-path"],
+    )
+    assert len(fs) == 2, fs
+    # Identical code under any other path is not hot.
+    fs = _lint(tmp_path, code, rules=["host-sync-in-hot-path"])
+    assert fs == []
+
+
 # --------------------------------------------------------------------- #
 # stdout-contract                                                       #
 # --------------------------------------------------------------------- #

@@ -358,7 +358,7 @@ def test_loopback_flight_recorder_dumps_on_injected_abort(tmp_path):
         # vanish mid-exchange, deterministically mid-round.
         b = agents["b"]
 
-        async def crash_exchange(y):
+        async def crash_exchange(y, active=None):
             b._mux.close()
             for s in b._neighbors.values():
                 s.close()

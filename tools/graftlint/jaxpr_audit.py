@@ -490,6 +490,53 @@ def _choco_run_fused() -> Counter:
     return collect_collectives(jx.jaxpr)
 
 
+@entry("async_stale_mix", kind="jaxpr", requires=("shard_map",))
+def _async_stale_mix() -> Counter:
+    """The sharded stale-weighted async gossip program
+    (``ConsensusEngine.async_gossip_program`` — the device side of
+    ``comm/async_runtime.py``) on a ring(8) agent mesh over a FOUR-leaf,
+    two-dtype-bucket state, 2 rounds, tau=1, one 2-slow publisher.
+
+    Pin: one round (the fori_loop body, traced once regardless of the
+    round count) moves ONE all_gather of the published buffer per dtype
+    BUCKET (the stale-weighted effective matrix is traced, so the round
+    contracts this device's W_eff row against the gathered agent axis —
+    2 buckets = 2 all_gathers) and NOTHING else: the staleness decay,
+    the hard-bound drop, and the row renormalization are all local
+    arithmetic on the replicated (n, n) matrix.  A psum appearing here
+    means the renormalization silently went collective; extra
+    all_gathers (4 = the leaf count) mean the double buffer stopped
+    fusing per bucket and pays per leaf.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.consensus import (
+        AsyncGossipState,
+        ConsensusEngine,
+    )
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    mesh = _mesh((8,), ("agents",))
+    engine = ConsensusEngine(
+        Topology.ring(8).metropolis_weights(), mesh=mesh
+    )
+    x = {
+        "w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        "b": jnp.ones((8, 2), jnp.float32),
+        "s": jnp.zeros((8,), jnp.float32),
+        "h": jnp.ones((8, 3), jnp.bfloat16),
+    }
+    st = AsyncGossipState(
+        pub=x, age=jnp.zeros((8,), jnp.int32), rnd=jnp.int32(0)
+    )
+    program = engine.async_gossip_program(
+        tau=1, periods=(1,) * 7 + (2,), times=2
+    )
+    jx = jax.make_jaxpr(program)(x, st)
+    return collect_collectives(jx.jaxpr)
+
+
 def _cost_drift(exp_cost: Optional[dict],
                 obs_cost: Optional[dict]) -> List[str]:
     """Human-readable drifts of the pinned cost columns beyond their

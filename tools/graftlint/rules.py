@@ -244,6 +244,27 @@ class HostSyncInHotPath(Rule):
             "jax.device_get",
         }
     )
+    #: Host-side dispatch loops held to the same no-sync discipline even
+    #: without a jit/scan marker: the async gossip runtime's per-round
+    #: receive/mix path runs once per gossip round per agent — an
+    #: accidental device round-trip there stalls the whole fabric the
+    #: way a hot-path .item() stalls a compiled step.  Values on these
+    #: paths stay numpy end to end by design.
+    extra_hot_functions = {
+        "distributed_learning_tpu/comm/async_runtime.py": frozenset(
+            {
+                "_push",
+                "_recv_step",
+                "_handle_peer_msg",
+                "_collect",
+                "_collect_choco",
+                "_consume",
+                "_mix_plain",
+                "_needs_fresh",
+                "_needs_correction",
+            }
+        ),
+    }
 
     def _hot_roots(self, ctx: FileContext) -> List[ast.AST]:
         defs: Dict[str, List[ast.AST]] = {}
@@ -252,6 +273,8 @@ class HostSyncInHotPath(Rule):
                 defs.setdefault(node.name, []).append(node)
 
         roots: List[ast.AST] = []
+        for fname in self.extra_hot_functions.get(ctx.relpath, ()):
+            roots.extend(defs.get(fname, []))
 
         def add_callable(arg):
             if isinstance(arg, ast.Lambda):
