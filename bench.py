@@ -20,10 +20,14 @@ for gossip mixing, which only handicaps us.)
 
 Prints exactly one JSON line:
     {"metric": ..., "value": ..., "unit": "samples/sec", "vs_baseline": ...,
-     "cost": {flops, peak_hbm_bytes, mfu, bytes_per_round, ...}}
+     "cost": {flops, peak_hbm_bytes, mfu, bytes_per_round, ...},
+     "wire": {native, bytes_per_sec, ...}}
 
 The ``cost`` payload is the device-cost observatory (obs/cost.py): the
-measured program's compiled cost profile plus measured MFU.  Side
+measured program's compiled cost profile plus measured MFU; ``wire``
+says which frame-codec path (native wire engine vs Python fallback)
+served and its measured fused-frame throughput at this model's width
+(benchmarks/bench_wire.py is the full measurement).  Side
 ledgers (files, never stdout): every probe outcome appends to
 ``TPU_HEALTH.jsonl`` (wedge windows are dateable) and every emitted
 record appends to ``PERF_LEDGER.jsonl`` (``obs-report --ledger``).
@@ -246,6 +250,7 @@ def measure_throughput(model, tx, engine, *, n_agents, batch, steps, epochs,
             choco_top_k(0.1)
         ).wire_bytes_per_round(layout, n_agents),
     )
+    _measure_wire(sum(width for _name, width in layout.buckets))
     bs = stack(variables["batch_stats"])
     opt = jax.vmap(tx.init)(params)
     state = (params, bs, opt, jax.random.key(1))
@@ -349,9 +354,47 @@ _LAYOUT_INFO: dict = {}
 # JSON record as its "cost" field and the perf ledger as "cost".
 _COST_INFO: dict = {}
 
+# Native wire engine summary (ISSUE 9): which frame-codec path this box
+# runs (comm.wire.native) and its measured fused-frame throughput at the
+# measured model's width — host-side microbenchmark, never stdout.
+_WIRE_INFO: dict = {}
+
 # Environment-health summary for the perf ledger: the probe outcome and
 # timing this run observed (TPU_HEALTH.jsonl carries the full history).
 _ENV_HEALTH: dict = {}
+
+
+def _measure_wire(total_params: int) -> None:
+    """Fill _WIRE_INFO with {native, bytes_per_sec}: one fused-sparse
+    frame (10% density, bf16 wire — the per-round gossip frame) encoded
+    and decoded at the measured model's width, capped so the probe stays
+    ~100 ms.  The TCP data plane ships exactly these frames, so the
+    record says what the wire can sustain next to what the device did."""
+    try:
+        from distributed_learning_tpu.comm.tensor_codec import (
+            decode_fused_sparse,
+            encode_fused_sparse,
+        )
+        from distributed_learning_tpu.native import wire as native_wire
+
+        total = max(1024, min(int(total_params), 1 << 23))
+        rng = np.random.default_rng(0)
+        flat = rng.normal(size=total).astype(np.float32)
+        flat[rng.random(total) >= 0.1] = 0.0
+        buckets = (("float32", ((0, total),)),)
+        frame = encode_fused_sparse(flat, buckets, bf16_wire=True)
+        t0 = time.perf_counter()
+        frame = encode_fused_sparse(flat, buckets, bf16_wire=True)
+        decode_fused_sparse(frame)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        _WIRE_INFO.update(
+            native=native_wire.available(),
+            bytes_per_sec=round(2 * len(frame) / dt, 1),
+            frame_bytes=len(frame),
+            probe_elems=total,
+        )
+    except Exception:  # pragma: no cover - the record just omits wire
+        _WIRE_INFO.update(native=False, bytes_per_sec=None)
 
 
 def _record_probe(outcome: str, **fields) -> None:
@@ -393,6 +436,7 @@ def _ledger_append_record(rec: dict) -> None:
             "tunnel_wedged": bool(rec.get("tunnel_wedged")),
             "superstep": rec.get("superstep"),
             "cost": rec.get("cost"),
+            "wire": rec.get("wire"),
             "env": dict(_ENV_HEALTH),
             "phases": rec.get("phases"),
         })
@@ -721,6 +765,7 @@ def main():
                 "superstep": 1,
                 "consensus": dict(_LAYOUT_INFO),
                 "cost": dict(_COST_INFO),
+                "wire": dict(_WIRE_INFO),
                 "phases": _phase_payload(),
                 "obs": _obs_payload(),
             })
@@ -820,6 +865,7 @@ def main():
             "superstep": superstep_k,
             "consensus": dict(_LAYOUT_INFO),
             "cost": dict(_COST_INFO),
+            "wire": dict(_WIRE_INFO),
         }
     result["phases"] = _phase_payload()
     result["obs"] = _obs_payload()

@@ -1,0 +1,222 @@
+"""Native wire engine vs the Python codec: measured bytes/sec.
+
+ISSUE 9's acceptance benchmark.  PR 8's async runtime made the TCP
+stack the fleet's hot path, and the last Python stage on it was the
+frame codec: every fused sparse frame round-tripped through a per-bucket
+numpy pipeline (arange/concatenate positions, gather, flatnonzero,
+gather again, convert, ``tobytes``, join) in
+``comm/tensor_codec.py``, while ``native/codec.cpp`` only accelerated
+the element-wise conversions.  The native wire engine
+(``native/wire.cpp``) collapses a whole frame to one call — two linear
+passes for encode (measure, then fused gather+convert+crc into an
+exact-size buffer) and validate-then-scatter for decode.
+
+Measured here, native vs the pure-Python oracle (the ``DLT_NO_NATIVE=1``
+fallback, forced per call), at FULL MODEL WIDTH (the WRN-28-10 ravel,
+~36.5M elements) on TPU/BENCH_FULL and a smoke width on CI:
+
+* fused-sparse encode and decode bytes/sec (frame bytes moved per wall
+  second) at the nominal 10% top-k density — the per-round gossip frame;
+* dense encode and decode bytes/sec under the bf16 wire mode — the
+  dense ``ValueResponse`` path;
+* the combined fused encode+decode speedup, gated >= 5x at full width
+  by ISSUE 9 (the tier-1 rot guard in ``tests/test_benchmarks.py``
+  gates a looser 2x at smoke width so CI timing noise cannot flake).
+
+Byte-identity is asserted in-run: the native frame must equal the
+Python oracle's frame bit for bit, both directions — a fast wrong codec
+is worthless.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from benchmarks import common
+from distributed_learning_tpu.comm import tensor_codec as tc
+from distributed_learning_tpu.native import wire as native_wire
+
+#: WRN-28-10's parameter count — "full model width" for this repo's
+#: headline model (bench.py).
+FULL_WIDTH = 36_479_194
+SMOKE_WIDTH = 1 << 19
+#: CHOCO's nominal top-k fraction (the density bench.py accounts wire
+#: bytes at).
+DENSITY = 0.1
+
+
+def _model_ravel(total: int, leaves: int = 64, seed: int = 7):
+    """A model-shaped (flat, buckets) pair: ``leaves`` spans of varying
+    sizes tiling the ravel, alternating bf16/f32 storage origin — the
+    shape ``TreeSpec.dtype_buckets()`` produces for a real mixed tree."""
+    rng = np.random.default_rng(seed)
+    cuts = np.sort(rng.choice(np.arange(1, total), leaves - 1, replace=False))
+    bounds = np.concatenate([[0], cuts, [total]])
+    bf16_spans, f32_spans = [], []
+    for i in range(leaves):
+        span = (int(bounds[i]), int(bounds[i + 1] - bounds[i]))
+        (bf16_spans if i % 4 == 3 else f32_spans).append(span)
+    buckets = (
+        ("bfloat16", tuple(bf16_spans)),
+        ("float32", tuple(f32_spans)),
+    )
+    flat = rng.normal(size=total).astype(np.float32)
+    flat[rng.random(total) >= DENSITY] = 0.0
+    return flat, buckets
+
+
+def _timed(fn, *, min_s: float = 0.3, max_reps: int = 50) -> float:
+    """Seconds per call: one warmup, then enough reps to fill ~min_s."""
+    fn()
+    t0 = time.perf_counter()
+    fn()
+    once = max(time.perf_counter() - t0, 1e-9)
+    reps = max(1, min(max_reps, int(min_s / once)))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+class _forced_python:
+    """Force the pure-Python codec path (the DLT_NO_NATIVE discipline,
+    honored per call by the dispatcher)."""
+
+    def __enter__(self):
+        self._prev = os.environ.get("DLT_NO_NATIVE")
+        os.environ["DLT_NO_NATIVE"] = "1"
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            os.environ.pop("DLT_NO_NATIVE", None)
+        else:
+            os.environ["DLT_NO_NATIVE"] = self._prev
+
+
+def _measure_fused(flat, buckets) -> Dict[str, float]:
+    frame = tc.encode_fused_sparse(flat, buckets, bf16_wire=True)
+    enc = lambda: tc.encode_fused_sparse(flat, buckets, bf16_wire=True)
+    dec = lambda: tc.decode_fused_sparse(frame)
+    t_enc = _timed(enc)
+    t_dec = _timed(dec)
+    return {
+        "frame_bytes": float(len(frame)),
+        "encode_s": t_enc,
+        "decode_s": t_dec,
+        "encode_bytes_per_sec": len(frame) / t_enc,
+        "decode_bytes_per_sec": len(frame) / t_dec,
+        "roundtrip_bytes_per_sec": 2 * len(frame) / (t_enc + t_dec),
+    }
+
+
+def _measure_dense(flat) -> Dict[str, float]:
+    frame = tc.encode_tensor(flat, bf16_wire=True)
+    enc = lambda: tc.encode_tensor(flat, bf16_wire=True)
+    dec = lambda: tc.decode_tensor(frame)
+    t_enc = _timed(enc)
+    t_dec = _timed(dec)
+    return {
+        "frame_bytes": float(len(frame)),
+        "encode_bytes_per_sec": len(frame) / t_enc,
+        "decode_bytes_per_sec": len(frame) / t_dec,
+        "roundtrip_bytes_per_sec": 2 * len(frame) / (t_enc + t_dec),
+    }
+
+
+def run(total: Optional[int] = None) -> dict:
+    if total is None:
+        total = FULL_WIDTH if common.full_scale() else SMOKE_WIDTH
+    flat, buckets = _model_ravel(total)
+    native_up = native_wire.available()
+    out: dict = {
+        "total_elems": total,
+        "density": DENSITY,
+        "native": native_up,
+        "fused": {},
+        "dense": {},
+    }
+
+    # Byte-identity first: a fast wrong codec is worthless.  The oracle
+    # (forced-Python) frame must equal the native frame bit for bit, and
+    # each side must decode the other's bytes to the same ravel.
+    with _forced_python():
+        frame_py = tc.encode_fused_sparse(flat, buckets, bf16_wire=True)
+        dense_py = tc.encode_tensor(flat, bf16_wire=True)
+    frame_nat = tc.encode_fused_sparse(flat, buckets, bf16_wire=True)
+    dense_nat = tc.encode_tensor(flat, bf16_wire=True)
+    out["fused"]["byte_identical"] = frame_nat == frame_py
+    out["dense"]["byte_identical"] = dense_nat == dense_py
+    with _forced_python():
+        ravel_py = tc.decode_fused_sparse(frame_nat)
+    identical_decode = bool(
+        np.array_equal(
+            tc.decode_fused_sparse(frame_py), ravel_py, equal_nan=True
+        )
+    )
+    out["fused"]["decode_identical"] = identical_decode
+
+    with _forced_python():
+        fused_py = _measure_fused(flat, buckets)
+        dense_py_m = _measure_dense(flat)
+    if native_up:
+        fused_nat = _measure_fused(flat, buckets)
+        dense_nat_m = _measure_dense(flat)
+    else:
+        fused_nat, dense_nat_m = fused_py, dense_py_m
+
+    for section, nat, py in (
+        ("fused", fused_nat, fused_py),
+        ("dense", dense_nat_m, dense_py_m),
+    ):
+        out[section].update(
+            frame_bytes=nat["frame_bytes"],
+            encode_bytes_per_sec=nat["encode_bytes_per_sec"],
+            decode_bytes_per_sec=nat["decode_bytes_per_sec"],
+            roundtrip_bytes_per_sec=nat["roundtrip_bytes_per_sec"],
+            python_encode_bytes_per_sec=py["encode_bytes_per_sec"],
+            python_decode_bytes_per_sec=py["decode_bytes_per_sec"],
+            encode_speedup=(
+                nat["encode_bytes_per_sec"] / py["encode_bytes_per_sec"]
+            ),
+            decode_speedup=(
+                nat["decode_bytes_per_sec"] / py["decode_bytes_per_sec"]
+            ),
+            roundtrip_speedup=(
+                nat["roundtrip_bytes_per_sec"] / py["roundtrip_bytes_per_sec"]
+            ),
+        )
+
+    for section in ("fused", "dense"):
+        s = out[section]
+        common.emit({
+            "metric": f"wire_{section}_roundtrip_bytes_per_sec",
+            "value": round(s["roundtrip_bytes_per_sec"], 1),
+            "unit": "bytes/sec",
+            "vs_baseline": None,
+            "config": (
+                f"{total} elems, density {DENSITY}, bf16 wire, "
+                f"native={native_up}"
+            ),
+            "native": native_up,
+            "byte_identical": s["byte_identical"],
+            "encode_bytes_per_sec": round(s["encode_bytes_per_sec"], 1),
+            "decode_bytes_per_sec": round(s["decode_bytes_per_sec"], 1),
+            "python_encode_bytes_per_sec": round(
+                s["python_encode_bytes_per_sec"], 1
+            ),
+            "python_decode_bytes_per_sec": round(
+                s["python_decode_bytes_per_sec"], 1
+            ),
+            "speedup_vs_python": round(s["roundtrip_speedup"], 2),
+            "encode_speedup": round(s["encode_speedup"], 2),
+            "decode_speedup": round(s["decode_speedup"], 2),
+        })
+    return out
+
+
+if __name__ == "__main__":
+    run()

@@ -13,19 +13,34 @@ wire format for gossip values.  ``flags`` bit 1 marks symmetric int8
 quantization (quarter bytes: one f32 scale = max|x|/127 ahead of the
 int8 payload) — the CHOCO-wire option whose quantization error the
 error-feedback loop absorbs.  Integrity is checked one level up by the
-frame crc32 (``framing.py``).
+frame crc32 (``framing.py``); the fused sparse frame additionally
+carries its OWN trailing crc32 (see ``encode_fused_sparse``) so its
+decoder can reject corruption before the first scatter into the ravel.
+
+Native wire engine (ISSUE 9): the dense frame path and the fused sparse
+frame path route through ``native/wire.cpp`` when it builds — whole
+frames encoded/decoded in one call, the u32 gather/scatter fused with
+the bf16/int8 conversion, a slicing-by-8 crc over the assembled frame.
+THIS module's pure-Python implementation stays the byte-for-byte
+authoritative oracle and the ``DLT_NO_NATIVE=1`` fallback; the native
+path must produce identical bytes (pinned by ``tests/test_wire.py``).
+Every encode/decode records which path served on the ``comm.wire.native``
+gauge so run reports say which engine a measurement ran on.
 """
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Tuple
 
 import numpy as np
 
 from distributed_learning_tpu import native
+from distributed_learning_tpu.native import wire as native_wire
 
 __all__ = [
+    "CodecError",
     "encode_tensor",
     "decode_tensor",
     "encode_sparse",
@@ -36,6 +51,17 @@ __all__ = [
     "FLAG_BF16_COMPRESSED",
     "FLAG_INT8_COMPRESSED",
 ]
+
+
+class CodecError(ValueError):
+    """Corrupt or protocol-violating tensor frame.
+
+    Subclasses ``ValueError`` so pre-existing callers (and tests) that
+    catch the broad class keep working; raised by the wire-engine paths
+    for every corruption class — truncation, checksum mismatch, section
+    lengths/offsets out of bounds, scatter indices outside the ravel —
+    and NEVER accompanied by a partial write (decode validates before it
+    scatters, both native and Python)."""
 
 FLAG_BF16_COMPRESSED = 0x01
 FLAG_INT8_COMPRESSED = 0x02
@@ -58,6 +84,22 @@ _MAX_NDIM = 16
 _MAX_SPARSE_DENSE_ELEMS = 1 << 28
 
 
+def _wire_engine():
+    """The native wire engine module, or None when unavailable or
+    disabled (``DLT_NO_NATIVE=1``, honored per call so the fallback can
+    be forced without restarting).  Records the serving path on the
+    ``comm.wire.native`` gauge — one dict write per FRAME, so run
+    reports (and bench records) can say which engine ran."""
+    eng = native_wire if native_wire.available() else None
+    try:  # lazy: obs is optional at this layer and must not cycle imports
+        from distributed_learning_tpu.obs import get_registry
+
+        get_registry().gauge("comm.wire.native", 1.0 if eng else 0.0)
+    except Exception:
+        pass
+    return eng
+
+
 def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False,
                   int8_wire: bool = False) -> bytes:
     """Serialize an array.
@@ -77,6 +119,23 @@ def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False,
         raise TypeError(f"unsupported wire dtype {x.dtype}")
     if x.ndim > _MAX_NDIM:
         raise ValueError(f"ndim {x.ndim} exceeds wire limit {_MAX_NDIM}")
+    if x.dtype == np.dtype(np.float32):
+        # Native whole-frame path: header + converted payload written
+        # into one preallocated buffer (wire.cpp), byte-identical to the
+        # Python assembly below.
+        eng = _wire_engine()
+        if eng is not None:
+            mode = (
+                native_wire.MODE_BF16 if bf16_wire
+                else native_wire.MODE_I8 if int8_wire
+                else native_wire.MODE_F32
+            )
+            try:
+                frame = eng.encode_dense(x, mode)
+            except ValueError as exc:
+                raise CodecError(str(exc)) from None
+            if frame is not None:
+                return frame
     flags = 0
     payload = x
     prefix = b""
@@ -88,8 +147,9 @@ def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False,
         if not np.isfinite(scale):
             # A NaN/Inf anywhere poisons max|x| (and would quantize the
             # whole tensor to garbage, platform-dependently).  Loud, not
-            # dropped — same stance as top_k_sparse.
-            raise ValueError(
+            # dropped — same stance as top_k_sparse.  CodecError (a
+            # ValueError) so both wire-engine paths raise the same class.
+            raise CodecError(
                 "int8 wire requires finite values (scale came out "
                 f"{scale}); refusing to quantize a poisoned tensor"
             )
@@ -133,6 +193,20 @@ def decode_tensor(buf: bytes) -> np.ndarray:
             f"tensor frame truncated: want {expect} payload bytes, "
             f"have {len(data)}"
         )
+    if (
+        flags & (FLAG_BF16_COMPRESSED | FLAG_INT8_COMPRESSED)
+        and len(buf) == offset + expect
+        and code in (5, 7)
+    ):
+        # Native whole-frame decode for the converting layouts (bf16 and
+        # int8 payloads): parse + convert in one call.  Raw frames keep
+        # the zero-copy numpy view below; a buffer with trailing slack
+        # (tolerated here) also stays on the Python path.
+        eng = _wire_engine()
+        if eng is not None:
+            out = np.empty(dims, np.float32)
+            if eng.decode_dense(buf, out) == 0:
+                return out
     x = np.frombuffer(data, dtype=dtype).reshape(dims)
     if flags & FLAG_BF16_COMPRESSED:
         x = native.bf16_to_f32(x)
@@ -231,9 +305,28 @@ def decode_sparse(buf: bytes) -> np.ndarray:
 # Fused sparse wire format (one frame per gossip round)                 #
 # --------------------------------------------------------------------- #
 _FUSED_MAGIC = 0xFE
+#: Fused frame version.  v1 (ISSUE 9) added the version byte itself and
+#: the trailing frame crc32, so the decoder — whose scatter writes into a
+#: freshly allocated ravel — rejects corruption before touching it.
+_FUSED_VERSION = 1
 #: bf16-precision storage dtypes: their value sections always narrow to
 #: bf16 on the wire (that IS their information content).
 _BF16_ORIGIN = ("bfloat16", "float16")
+
+
+def _bucket_modes(buckets, bf16_wire: bool, int8_wire: bool):
+    """Per-bucket wire mode (native_wire.MODE_*): bf16-origin buckets
+    always ride bf16 values, f32 buckets honor ``bf16_wire``, and
+    ``int8_wire`` quantizes every section."""
+    modes = []
+    for name, _spans in buckets:
+        if int8_wire:
+            modes.append(native_wire.MODE_I8)
+        elif bf16_wire or name in _BF16_ORIGIN:
+            modes.append(native_wire.MODE_BF16)
+        else:
+            modes.append(native_wire.MODE_F32)
+    return tuple(modes)
 
 
 def encode_fused_sparse(
@@ -258,10 +351,21 @@ def encode_fused_sparse(
     honor ``bf16_wire``; ``int8_wire`` quantizes every section (the
     CHOCO error-feedback loop absorbs the noise).
 
-    Layout::
+    Layout (v1)::
 
-        u8 0xFE | u8 0 | u8 nbuckets | u8 0 | u32 total_dim |
-        per bucket: u32 k | u32 idx[k] | u32 vlen | encode_tensor(vals)
+        u8 0xFE | u8 version=1 | u8 nbuckets | u8 0 | u32 total_dim |
+        per bucket: u32 k | u32 idx[k] | u32 vlen | encode_tensor(vals) |
+        u32 crc32(all preceding bytes)
+
+    The trailing crc is the frame's own integrity check (on top of the
+    transport-level one in ``framing.py``): the decoder verifies it — and
+    bounds-checks every section header — BEFORE the first scatter into
+    the ravel, so corruption raises :class:`CodecError` and never writes.
+
+    When the native wire engine is up, the whole frame is assembled by
+    ONE call into ``wire.cpp`` (gather + conversion + crc fused, two
+    linear passes over the ravel); the Python loop below is the
+    byte-for-byte oracle and the ``DLT_NO_NATIVE=1`` fallback.
     """
     if bf16_wire and int8_wire:
         raise ValueError("bf16_wire and int8_wire are mutually exclusive")
@@ -288,8 +392,34 @@ def encode_fused_sparse(
             f"bucket spans cover {covered} of {flat.size} wire elements — "
             "buckets must tile the TreeSpec ravel exactly"
         )
-    out = [struct.pack("<BBBBI", _FUSED_MAGIC, 0, len(buckets), 0, flat.size)]
-    for name, spans in buckets:
+    modes = _bucket_modes(buckets, bf16_wire, int8_wire)
+    eng = _wire_engine()
+    if eng is not None:
+        try:
+            frame = eng.encode_fused(
+                flat,
+                tuple(
+                    (mode, spans)
+                    for mode, (_name, spans) in zip(modes, buckets)
+                ),
+            )
+        except ValueError as exc:
+            raise CodecError(str(exc)) from None
+        if frame is not None:
+            return frame
+    return _encode_fused_sparse_py(flat, buckets, modes)
+
+
+def _encode_fused_sparse_py(flat: np.ndarray, buckets, modes) -> bytes:
+    """The authoritative Python assembly of a fused sparse frame (inputs
+    pre-validated by :func:`encode_fused_sparse`)."""
+    out = [
+        struct.pack(
+            "<BBBBI", _FUSED_MAGIC, _FUSED_VERSION, len(buckets), 0,
+            flat.size,
+        )
+    ]
+    for (_name, spans), mode in zip(buckets, modes):
         pos = np.concatenate(
             [np.arange(off, off + size, dtype=np.uint32)
              for off, size in spans]
@@ -297,62 +427,104 @@ def encode_fused_sparse(
         sub = flat[pos]
         nz = np.flatnonzero(sub)
         idx = pos[nz]
-        section_bf16 = bf16_wire or name in _BF16_ORIGIN
         vals = encode_tensor(
             sub[nz],
-            bf16_wire=section_bf16 and not int8_wire,
-            int8_wire=int8_wire,
+            bf16_wire=mode == native_wire.MODE_BF16,
+            int8_wire=mode == native_wire.MODE_I8,
         )
         out.append(struct.pack("<I", idx.size))
         out.append(idx.tobytes())
         out.append(struct.pack("<I", len(vals)))
         out.append(vals)
-    return b"".join(out)
+    body = b"".join(out)
+    return body + struct.pack("<I", native.crc32(body))
 
 
 def decode_fused_sparse(buf: bytes) -> np.ndarray:
     """Inverse of :func:`encode_fused_sparse`; returns the densified flat
     f32 wire vector (the receiver rebuilds the pytree via its own
-    ``TreeSpec`` — the deployment invariant: same model, same spec)."""
-    if len(buf) < 8:
-        raise ValueError("fused sparse frame too short")
-    magic, _flags, nbuckets, _r, total = struct.unpack_from("<BBBBI", buf, 0)
+    ``TreeSpec`` — the deployment invariant: same model, same spec).
+
+    Corruption discipline (native and Python paths alike): the frame crc
+    is verified and every section header bounds-checked BEFORE the first
+    scatter write; violations raise :class:`CodecError`."""
+    if len(buf) < 12:
+        raise CodecError("fused sparse frame too short")
+    magic, version, nbuckets, _r, total = struct.unpack_from(
+        "<BBBBI", buf, 0
+    )
     if magic != _FUSED_MAGIC:
-        raise ValueError(f"not a fused sparse frame (magic {magic:#x})")
+        raise CodecError(f"not a fused sparse frame (magic {magic:#x})")
     if total > _MAX_SPARSE_DENSE_ELEMS:
-        raise ValueError(
+        raise CodecError(
             f"fused sparse frame densifies to {total} elements "
             f"(limit {_MAX_SPARSE_DENSE_ELEMS})"
         )
+    if version != _FUSED_VERSION:
+        raise CodecError(
+            f"unsupported fused sparse frame version {version}"
+        )
+    eng = _wire_engine()
+    if eng is not None:
+        out = np.zeros(total, np.float32)
+        status = eng.decode_fused(buf, out)
+        if status == 0:
+            return out
+        if status != native_wire.ERR_UNSUPPORTED:
+            raise CodecError(
+                native_wire.CORRUPT_MESSAGES.get(
+                    status, f"wire status {status}"
+                )
+            )
+        # A valid frame with a value dtype the native engine does not
+        # speak: the Python oracle below decodes it.
+    return _decode_fused_sparse_py(buf, nbuckets, total)
+
+
+def _decode_fused_sparse_py(buf: bytes, nbuckets: int,
+                            total: int) -> np.ndarray:
+    """The authoritative Python decode (header pre-parsed): crc first,
+    then per-section bounds checks, then the scatter."""
+    body_end = len(buf) - 4
+    (crc,) = struct.unpack_from("<I", buf, body_end)
+    if native.crc32(buf[:body_end]) != crc:
+        raise CodecError("fused sparse frame checksum mismatch")
     out = np.zeros(total, np.float32)
     off = 8
     for _ in range(nbuckets):
-        if len(buf) < off + 4:
-            raise ValueError("fused sparse frame truncated at bucket header")
+        if body_end < off + 4:
+            raise CodecError("fused sparse frame truncated at bucket header")
         (k,) = struct.unpack_from("<I", buf, off)
         off += 4
         if k > total:
-            raise ValueError(
+            raise CodecError(
                 f"fused sparse bucket claims {k} entries in {total} slots"
             )
-        idx_bytes = buf[off : off + 4 * k]
+        idx_bytes = buf[off : off + min(4 * k, body_end - off)]
         if len(idx_bytes) != 4 * k:
-            raise ValueError("fused sparse frame truncated in indices")
+            raise CodecError("fused sparse frame truncated in indices")
         idx = np.frombuffer(idx_bytes, dtype=np.uint32)
         off += 4 * k
         if k and int(idx.max()) >= total:
-            raise ValueError("fused sparse index out of range")
-        if len(buf) < off + 4:
-            raise ValueError("fused sparse frame truncated at value header")
+            raise CodecError("fused sparse index out of range")
+        if body_end < off + 4:
+            raise CodecError("fused sparse frame truncated at value header")
         (vlen,) = struct.unpack_from("<I", buf, off)
         off += 4
-        vals = decode_tensor(buf[off : off + vlen])
+        if off + vlen > body_end:
+            raise CodecError("fused sparse frame truncated in values")
+        try:
+            vals = decode_tensor(buf[off : off + vlen])
+        except (ValueError, struct.error) as exc:
+            raise CodecError(str(exc)) from None
         off += vlen
         if vals.shape != (k,):
-            raise ValueError(
+            raise CodecError(
                 f"fused sparse value count {vals.shape} != {k}"
             )
         out[idx] = vals.astype(np.float32)
+    if off != body_end:
+        raise CodecError("fused sparse frame section out of bounds")
     return out
 
 

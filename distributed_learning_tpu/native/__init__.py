@@ -1,19 +1,30 @@
 """Native (C++) components, loaded via ctypes with pure-Python fallbacks.
 
-``codec.cpp`` holds the wire-codec hot path (f32<->bf16 conversion, crc32).
-The shared library is compiled with g++ on first use and cached beside the
-source; environments without a toolchain fall back to numpy/ml_dtypes/zlib
-implementations with identical semantics (the tests assert bit-equality).
+``codec.cpp`` holds the element-wise wire-codec hot path (f32<->bf16
+conversion, int8 quantization, crc32); ``wire.cpp`` (wrapped by
+:mod:`.wire`) is the whole-frame wire engine layered on the same
+primitives.  Each shared library is compiled with g++ on first use and
+cached beside its source; environments without a toolchain fall back to
+numpy/ml_dtypes/zlib implementations with identical semantics (the tests
+assert bit-equality).
+
+Build hardening (ISSUE 9): every library exports ``dlt_abi_version()``
+(``dlt_abi.h``), checked right after ``dlopen`` — a stale cached ``.so``
+missing new symbols triggers a rebuild, never an ``AttributeError`` at
+first use.  A failed g++ build logs ONE warning on the ``dlt.native``
+logger and bumps the ``native.build_failed`` obs counter (it used to
+return ``None`` silently), then the pure-Python fallback serves.
 """
 
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
 import zlib
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -33,29 +44,153 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
+#: Expected ``dlt_abi_version()`` of every native library; must match
+#: DLT_ABI_VERSION in ``dlt_abi.h`` (bumped when the symbol set changes).
+_ABI_VERSION = 2
 
-def _build() -> Optional[str]:
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return _LIB
+_logger = logging.getLogger("dlt.native")
+
+
+def _report_build_failure(src: str, detail: str) -> None:
+    """One warning + one counter per failed build — a box quietly running
+    the slow path is an observability bug, not a convenience."""
+    _logger.warning(
+        "native build of %s failed (%s); falling back to the pure-Python "
+        "codec — wire throughput will be the fallback's",
+        os.path.basename(src), detail,
+    )
+    try:  # lazy: obs must stay importable without the comm/native stack
+        from distributed_learning_tpu.obs import get_registry
+
+        get_registry().inc("native.build_failed")
+    except Exception:
+        pass
+
+
+def _build_lib(src: str, lib_path: str, *, force: bool = False) -> Optional[str]:
+    """Compile ``src`` to ``lib_path`` unless a fresh cache exists.
+
+    ``force`` ignores the cache (the ABI-mismatch rebuild path).
+    """
+    if (
+        not force
+        and os.path.exists(lib_path)
+        and os.path.getmtime(lib_path) >= os.path.getmtime(src)
+    ):
+        return lib_path
     # Per-process temp name: concurrent first-use builds (multi-process
     # deployments) must not interleave g++ output on a shared path; the
-    # final os.replace is atomic either way.
-    tmp = f"{_LIB}.{os.getpid()}.tmp"
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        os.replace(tmp, _LIB)
-        return _LIB
-    except (OSError, subprocess.SubprocessError):
+    # final os.replace is atomic either way.  -march=native is safe for
+    # a compiled-per-box-at-first-use cache (it IS this box) and lets
+    # the wire engine's bulk loops vectorize; boxes whose toolchain
+    # rejects it retry with the portable baseline.
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+    last_exc: Optional[BaseException] = None
+    for extra in (["-march=native"], []):
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+            subprocess.run(
+                base[:2] + extra + base[2:],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, lib_path)
+            return lib_path
+        except (OSError, subprocess.SubprocessError) as exc:
+            last_exc = exc
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    detail = type(last_exc).__name__
+    stderr = getattr(last_exc, "stderr", None)
+    if stderr:
+        detail += ": " + stderr.decode("utf-8", "replace").strip()[:200]
+    _report_build_failure(src, detail)
+    return None
+
+
+def _abi_ok(lib: ctypes.CDLL) -> bool:
+    try:
+        fn = lib.dlt_abi_version
+    except AttributeError:
+        return False
+    fn.argtypes = []
+    fn.restype = ctypes.c_uint32
+    return int(fn()) == _ABI_VERSION
+
+
+def _load_lib(
+    src: str,
+    lib_path: str,
+    configure: Callable[[ctypes.CDLL], None],
+) -> Optional[ctypes.CDLL]:
+    """Build (if needed), dlopen, ABI-check, and configure one library.
+
+    An ABI mismatch — a cached ``.so`` from an older source whose mtime
+    beat the checkout's — forces ONE rebuild from the current source; a
+    second mismatch means the toolchain itself is stale and the Python
+    fallback serves.
+    """
+    path = _build_lib(src, lib_path)
+    if path is None:
         return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    if not _abi_ok(lib):
+        _logger.warning(
+            "cached %s has a stale ABI (wanted v%d); rebuilding from source",
+            os.path.basename(lib_path), _ABI_VERSION,
+        )
+        try:
+            # dlopen caches by pathname while a handle stays open: the
+            # rebuilt library would silently resolve to the stale image
+            # unless the old handle is closed first.
+            import _ctypes
+
+            _ctypes.dlclose(lib._handle)
+        except Exception:
+            pass
+        path = _build_lib(src, lib_path, force=True)
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        if not _abi_ok(lib):
+            _report_build_failure(src, "rebuilt library still ABI-stale")
+            return None
+    configure(lib)
+    return lib
+
+
+def _configure_codec(lib: ctypes.CDLL) -> None:
+    lib.dlt_f32_to_bf16.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+    ]
+    lib.dlt_f32_to_bf16.restype = None
+    lib.dlt_bf16_to_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+    ]
+    lib.dlt_bf16_to_f32.restype = None
+    lib.dlt_f32_to_i8.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_float,
+    ]
+    lib.dlt_f32_to_i8.restype = None
+    lib.dlt_i8_to_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_float,
+    ]
+    lib.dlt_i8_to_f32.restype = None
+    lib.dlt_crc32.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
+    ]
+    lib.dlt_crc32.restype = ctypes.c_uint32
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -68,36 +203,7 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("DLT_NO_NATIVE") == "1":
             return None
-        path = _build()
-        if path is None:
-            return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError:
-            return None
-        lib.dlt_f32_to_bf16.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
-        ]
-        lib.dlt_f32_to_bf16.restype = None
-        lib.dlt_bf16_to_f32.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
-        ]
-        lib.dlt_bf16_to_f32.restype = None
-        lib.dlt_f32_to_i8.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
-            ctypes.c_float,
-        ]
-        lib.dlt_f32_to_i8.restype = None
-        lib.dlt_i8_to_f32.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
-            ctypes.c_float,
-        ]
-        lib.dlt_i8_to_f32.restype = None
-        lib.dlt_crc32.argtypes = [
-            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32,
-        ]
-        lib.dlt_crc32.restype = ctypes.c_uint32
-        _lib = lib
+        _lib = _load_lib(_SRC, _LIB, _configure_codec)
         return _lib
 
 

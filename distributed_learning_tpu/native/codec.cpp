@@ -21,7 +21,14 @@
 #include <cstdint>
 #include <cstring>
 
+#include "dlt_abi.h"
+
 extern "C" {
+
+// Checked by native/__init__.py right after dlopen: a cached .so built
+// from an older source (missing symbols or changed signatures) must
+// trigger a rebuild, never an AttributeError at first use.
+uint32_t dlt_abi_version() { return DLT_ABI_VERSION; }
 
 // f32 -> bf16 with round-to-nearest-even (ties to even), matching the
 // hardware semantics XLA uses when it narrows f32 to bf16.
@@ -70,7 +77,7 @@ void dlt_i8_to_f32(const int8_t* src, float* dst, size_t n, float scale) {
   }
 }
 
-static uint32_t kCrcTable[256];
+static uint32_t kCrcTable[8][256];
 static bool kCrcInit = false;
 
 static void crc_init() {
@@ -79,18 +86,43 @@ static void crc_init() {
     for (int k = 0; k < 8; ++k) {
       c = (c & 1u) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
     }
-    kCrcTable[i] = c;
+    kCrcTable[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    for (int t = 1; t < 8; ++t) {
+      kCrcTable[t][i] =
+          (kCrcTable[t - 1][i] >> 8) ^ kCrcTable[0][kCrcTable[t - 1][i] & 0xFFu];
+    }
   }
   kCrcInit = true;
 }
 
 // Same polynomial/reflection as zlib.crc32, so the Python fallback and the
-// native path produce identical checksums.
+// native path produce identical checksums.  Slicing-by-8 (ISSUE 9): the
+// old byte-at-a-time loop bottlenecked framing.py's per-frame checksum
+// behind one serial table lookup per byte; eight parallel tables process
+// 8 bytes per iteration at ~4-5x the throughput.
 uint32_t dlt_crc32(const uint8_t* data, size_t n, uint32_t seed) {
   if (!kCrcInit) crc_init();
   uint32_t c = seed ^ 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, data, 4);
+    std::memcpy(&hi, data + 4, 4);
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ != __ORDER_LITTLE_ENDIAN__
+    lo = __builtin_bswap32(lo);
+    hi = __builtin_bswap32(hi);
+#endif
+    lo ^= c;
+    c = kCrcTable[7][lo & 0xFFu] ^ kCrcTable[6][(lo >> 8) & 0xFFu] ^
+        kCrcTable[5][(lo >> 16) & 0xFFu] ^ kCrcTable[4][lo >> 24] ^
+        kCrcTable[3][hi & 0xFFu] ^ kCrcTable[2][(hi >> 8) & 0xFFu] ^
+        kCrcTable[1][(hi >> 16) & 0xFFu] ^ kCrcTable[0][hi >> 24];
+    data += 8;
+    n -= 8;
+  }
   for (size_t i = 0; i < n; ++i) {
-    c = kCrcTable[(c ^ data[i]) & 0xFFu] ^ (c >> 8);
+    c = kCrcTable[0][(c ^ data[i]) & 0xFFu] ^ (c >> 8);
   }
   return c ^ 0xFFFFFFFFu;
 }

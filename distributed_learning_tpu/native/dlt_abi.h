@@ -1,0 +1,13 @@
+// Shared ABI version for the native codec/wire shared objects.
+//
+// Bump DLT_ABI_VERSION whenever the exported C symbol set or any
+// function signature changes.  native/__init__.py calls the exported
+// dlt_abi_version() right after dlopen and force-rebuilds a cached .so
+// whose version does not match — a stale cache must become a rebuild,
+// never an AttributeError at first use (ISSUE 9 build hardening).
+#ifndef DLT_ABI_H_
+#define DLT_ABI_H_
+
+#define DLT_ABI_VERSION 2u
+
+#endif  // DLT_ABI_H_

@@ -371,6 +371,52 @@ def test_wrn_accuracy_cifar100_proxy_smoke(tmp_path, monkeypatch):
     assert len(saved["curve"]) == 1
 
 
+def test_bench_wire_native_gate(capsys):
+    """ISSUE 9 rot guard: the native wire engine's fused-sparse
+    encode+decode bytes/sec >= 2x the Python codec at smoke width (the
+    full-width headline on the measurement box shows >= 5x; the tier-1
+    gate is looser so shared-CI timing noise cannot flake), and the
+    native frames are byte-identical to the Python oracle in BOTH
+    directions — a fast wrong codec must fail here, not in a fleet."""
+    from benchmarks import bench_wire
+    from distributed_learning_tpu.native import wire
+
+    if not wire.available():
+        pytest.skip("native wire engine unavailable (no toolchain)")
+    out = bench_wire.run()
+    assert out["native"] is True
+    assert out["fused"]["byte_identical"] is True
+    assert out["dense"]["byte_identical"] is True
+    assert out["fused"]["decode_identical"] is True
+    assert out["fused"]["roundtrip_speedup"] >= 2.0, out["fused"]
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    recs = {r["metric"]: r for r in lines}
+    fused = recs["wire_fused_roundtrip_bytes_per_sec"]
+    assert fused["byte_identical"] and fused["native"]
+    assert fused["value"] > 0 and fused["encode_bytes_per_sec"] > 0
+    # The dense record is reported (disclosed, not gated: the dense
+    # Python path was already near memcpy speed).
+    assert "wire_dense_roundtrip_bytes_per_sec" in recs
+    for r in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
+
+
+def test_bench_wire_python_fallback_runs_anywhere(capsys, monkeypatch):
+    """The benchmark itself must not need a toolchain: under
+    DLT_NO_NATIVE=1 it measures the fallback against itself, emits
+    native=false records, and byte-identity still holds trivially."""
+    from benchmarks import bench_wire
+
+    monkeypatch.setenv("DLT_NO_NATIVE", "1")
+    out = bench_wire.run(total=1 << 14)
+    assert out["native"] is False
+    assert out["fused"]["byte_identical"] is True
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert all(r["native"] is False for r in lines)
+
+
 def test_bench_async_gossip_straggler_gate(capsys):
     """ISSUE 8 straggler gate: with one of 4 loopback agents injected
     10x slow, async rounds/sec of the fast agents >= 2x the lock-step

@@ -664,12 +664,20 @@ def test_fused_sparse_codec_roundtrip_and_bucket_precision():
 
 def test_fused_sparse_codec_rejects_corrupt_and_hostile_frames():
     import struct
+    import zlib
 
     from distributed_learning_tpu.comm.tensor_codec import (
+        CodecError,
         decode_fused_sparse,
         encode_fused_sparse,
         encode_tensor,
     )
+
+    def recrc(frame: bytes) -> bytes:
+        """Re-stamp a tampered v1 frame's trailing crc so the decoder's
+        SECTION checks (not just the checksum) are what reject it."""
+        body = frame[:-4]
+        return body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
 
     buckets = (("float32", ((0, 8),)),)
     good = encode_fused_sparse(
@@ -682,16 +690,32 @@ def test_fused_sparse_codec_rejects_corrupt_and_hostile_frames():
     with pytest.raises(ValueError, match="magic"):
         decode_fused_sparse(encode_tensor(np.zeros(3, np.float32)))
     with pytest.raises(ValueError):
-        decode_fused_sparse(good[: len(good) - 3])  # truncated values
+        decode_fused_sparse(good[: len(good) - 3])  # truncated: crc torn
+    # Any bit flip is caught by the frame crc before any scatter.
+    flipped = bytearray(good)
+    flipped[12] ^= 0x10
+    with pytest.raises(CodecError, match="checksum"):
+        decode_fused_sparse(bytes(flipped))
     # Hostile: huge claimed total must be rejected before densification.
-    huge = struct.pack("<BBBBI", 0xFE, 0, 1, 0, 1 << 31)
+    huge = struct.pack("<BBBBI", 0xFE, 1, 1, 0, 1 << 31)
     with pytest.raises(ValueError, match="densifies"):
         decode_fused_sparse(huge + struct.pack("<I", 0))
-    # Out-of-range index.
+    # Unknown frame version (e.g. the pre-crc v0 layout) is refused.
+    v0 = bytearray(good)
+    v0[1] = 0
+    with pytest.raises(CodecError, match="version"):
+        decode_fused_sparse(recrc(bytes(v0)))
+    # Out-of-range index WITH a valid crc: the bounds check must reject
+    # it before the scatter (never an out-of-bounds write).
     bad = bytearray(good)
     bad[12:16] = (10 ** 6).to_bytes(4, "little")  # first index u32
     with pytest.raises(ValueError, match="range"):
-        decode_fused_sparse(bytes(bad))
+        decode_fused_sparse(recrc(bytes(bad)))
+    # Adversarial section count with a valid crc: k beyond the ravel.
+    overk = bytearray(good)
+    overk[8:12] = (1000).to_bytes(4, "little")
+    with pytest.raises(CodecError):
+        decode_fused_sparse(recrc(bytes(overk)))
     # Encode-side: spans must tile the vector.
     with pytest.raises(ValueError, match="tile"):
         encode_fused_sparse(

@@ -383,6 +383,120 @@ def test_wallclock_duration_reasoned_anchor_accepted(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# wire-code-unique                                                      #
+# --------------------------------------------------------------------- #
+_PROTOCOL_RELNAME = "distributed_learning_tpu/comm/protocol.py"
+
+
+def _proto_snippet(codes, registry):
+    """A protocol.py-shaped module: one class per (name, code) plus a
+    _REGISTRY dict comprehension over ``registry`` names."""
+    lines = ["from typing import ClassVar", ""]
+    for name, code in codes:
+        lines += [
+            f"class {name}:",
+            f"    TYPE_CODE: ClassVar[int] = {code}",
+            "",
+        ]
+    lines.append(
+        "_REGISTRY = {cls.TYPE_CODE: cls for cls in (%s)}"
+        % (", ".join(registry) + ("," if registry else ""))
+    )
+    return "\n".join(lines) + "\n"
+
+
+def test_wire_code_unique_passes_clean_protocol(tmp_path):
+    code = _proto_snippet(
+        [("A", 1), ("B", 2), ("C", 3)], ["A", "B", "C"]
+    )
+    assert _lint(
+        tmp_path, code, relname=_PROTOCOL_RELNAME,
+        rules=["wire-code-unique"],
+    ) == []
+
+
+def test_wire_code_unique_fires_on_duplicate_code(tmp_path):
+    code = _proto_snippet([("A", 1), ("B", 1)], ["A", "B"])
+    fs = _lint(
+        tmp_path, code, relname=_PROTOCOL_RELNAME,
+        rules=["wire-code-unique"],
+    )
+    assert _rules_of(fs) == ["wire-code-unique"]
+    assert "duplicates" in fs[0].message and "misparse" in fs[0].message
+
+
+def test_wire_code_unique_fires_on_unregistered_class(tmp_path):
+    code = _proto_snippet([("A", 1), ("B", 2)], ["A"])
+    fs = _lint(
+        tmp_path, code, relname=_PROTOCOL_RELNAME,
+        rules=["wire-code-unique"],
+    )
+    assert len(fs) == 1 and "missing from the _REGISTRY" in fs[0].message
+
+
+def test_wire_code_unique_fires_on_phantom_and_double_registration(tmp_path):
+    code = _proto_snippet([("A", 1)], ["A", "A", "Ghost"])
+    fs = _lint(
+        tmp_path, code, relname=_PROTOCOL_RELNAME,
+        rules=["wire-code-unique"],
+    )
+    msgs = " | ".join(f.message for f in fs)
+    assert "'Ghost'" in msgs and "more than once" in msgs
+
+
+def test_wire_code_unique_fires_when_registry_table_is_missing(tmp_path):
+    code = (
+        "from typing import ClassVar\n"
+        "class A:\n    TYPE_CODE: ClassVar[int] = 1\n"
+    )
+    fs = _lint(
+        tmp_path, code, relname=_PROTOCOL_RELNAME,
+        rules=["wire-code-unique"],
+    )
+    assert len(fs) == 1 and "one place" in fs[0].message
+
+
+def test_wire_code_unique_ignores_negative_sentinel_and_other_files(tmp_path):
+    # The Message base's -1 sentinel is not a wire code.
+    code = _proto_snippet([("Message", -1), ("A", 1)], ["A"])
+    assert _lint(
+        tmp_path, code, relname=_PROTOCOL_RELNAME,
+        rules=["wire-code-unique"],
+    ) == []
+    # Scoped: the same duplicate codes elsewhere are not this rule's job.
+    dup = _proto_snippet([("A", 1), ("B", 1)], ["A", "B"])
+    assert _lint(
+        tmp_path, dup, relname="distributed_learning_tpu/other.py",
+        rules=["wire-code-unique"],
+    ) == []
+
+
+def test_wire_code_unique_real_protocol_is_clean_and_complete():
+    """The shipped protocol.py passes, and the rule actually SEES all
+    17+ codes (a rule that silently matches nothing is worse than none)."""
+    import ast as ast_mod
+
+    from tools.graftlint.rules import WireCodeUnique
+
+    path = os.path.join(
+        REPO_ROOT, "distributed_learning_tpu", "comm", "protocol.py"
+    )
+    fs = lint_file(path, rules={"wire-code-unique": RULES["wire-code-unique"]})
+    assert [f for f in fs if f.rule == "wire-code-unique"] == []
+    tree = ast_mod.parse(open(path).read())
+    codes = [
+        WireCodeUnique._type_code_of(n)[0]
+        for n in ast_mod.walk(tree)
+        if isinstance(n, ast_mod.ClassDef)
+        and WireCodeUnique._type_code_of(n) is not None
+        and WireCodeUnique._type_code_of(n)[0] >= 0
+    ]
+    assert len(codes) >= 17 and len(set(codes)) == len(codes)
+    names, _ = WireCodeUnique._registry_names(tree)
+    assert len(names) == len(codes)
+
+
+# --------------------------------------------------------------------- #
 # reference-citation                                                    #
 # --------------------------------------------------------------------- #
 @pytest.fixture

@@ -1,0 +1,449 @@
+"""Native wire engine (ISSUE 9): oracle matrix, corruption fuzz, build
+hardening.
+
+Three layers, all tier-1 (no mesh, no jitted programs):
+
+* **Wire-oracle matrix** — the public codec (native engine when it
+  builds) must be byte-identical to the pure-Python oracle
+  (``_encode_fused_sparse_py`` / forced ``DLT_NO_NATIVE=1``) across
+  dtype-bucket mixes, NaN payloads, empty buckets, zero-length trees,
+  and both frame kinds.  Every matrix test runs twice via the
+  ``wire_path`` fixture — once on the native engine, once with the
+  fallback forced — so correctness never needs a toolchain.
+* **Corruption/fuzz property test** — ~200 seeded mutations of valid
+  frames (truncation, bit flips, adversarial section lengths/offsets
+  with a re-stamped crc) must raise ``CodecError`` and never segfault
+  or scatter out of bounds, on both paths.
+* **Build hardening** — ``dlt_abi_version()`` is checked at load: a
+  stale cached ``.so`` missing the symbol (or reporting the wrong
+  version) triggers a rebuild; failed g++ builds warn once on
+  ``dlt.native`` and bump the ``native.build_failed`` counter.
+"""
+
+import ctypes
+import os
+import struct
+import subprocess
+import zlib
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu import native
+from distributed_learning_tpu.comm import tensor_codec as tc
+from distributed_learning_tpu.comm.tensor_codec import (
+    CodecError,
+    decode_fused_sparse,
+    decode_tensor,
+    encode_fused_sparse,
+    encode_tensor,
+)
+from distributed_learning_tpu.native import wire
+from distributed_learning_tpu.obs import MetricsRegistry, use_registry
+
+_HAVE_NATIVE = wire.available()
+
+
+@pytest.fixture(params=["native", "python"])
+def wire_path(request, monkeypatch):
+    """Run the test on the native engine AND with the fallback forced.
+
+    ``DLT_NO_NATIVE`` is honored per call by the codec's dispatcher, so
+    setting it mid-process flips the served path without reloads."""
+    if request.param == "native":
+        if not _HAVE_NATIVE:
+            pytest.skip("native wire engine unavailable in this env")
+        monkeypatch.delenv("DLT_NO_NATIVE", raising=False)
+    else:
+        monkeypatch.setenv("DLT_NO_NATIVE", "1")
+    return request.param
+
+
+def _sparsify(rng, dense, keep=0.1):
+    return np.where(
+        rng.random(dense.size) < keep, dense, 0.0
+    ).astype(np.float32)
+
+
+def _scenarios():
+    """(name, flat, buckets) — the fused-frame shapes the fleet ships."""
+    rng = np.random.default_rng(42)
+    out = []
+    # Mixed bf16+f32 buckets, multi-span, ~10% density (a model tree's
+    # dtype_buckets() shape).
+    flat = _sparsify(rng, rng.normal(size=4096).astype(np.float32))
+    out.append((
+        "mixed",
+        flat,
+        (
+            ("bfloat16", ((0, 1024), (3072, 512))),
+            ("float32", ((1024, 2048), (3584, 512))),
+        ),
+    ))
+    # float16-origin bucket (also a _BF16_ORIGIN narrow-always dtype).
+    out.append((
+        "f16_origin",
+        _sparsify(rng, rng.normal(size=256).astype(np.float32)),
+        (("float16", ((0, 128),)), ("float32", ((128, 128),))),
+    ))
+    # Empty value sets: an all-zero bucket and a bucket with no spans.
+    z = np.zeros(64, np.float32)
+    z[50] = 1.5
+    out.append((
+        "empty_bucket",
+        z,
+        (("bfloat16", ()), ("float32", ((0, 32), (32, 32)))),
+    ))
+    # Zero-length tree: no buckets, no elements.
+    out.append(("zero_tree", np.zeros(0, np.float32), ()))
+    # Fully dense ravel (k == total; worst-case frame).
+    out.append((
+        "all_dense",
+        rng.normal(size=512).astype(np.float32) + 0.25,
+        (("float32", ((0, 512),)),),
+    ))
+    return out
+
+
+_MODES = [
+    {},
+    {"bf16_wire": True},
+    {"int8_wire": True},
+]
+
+
+@pytest.mark.parametrize(
+    "name,flat,buckets", _scenarios(), ids=[s[0] for s in _scenarios()]
+)
+@pytest.mark.parametrize(
+    "mode", _MODES, ids=["plain", "bf16", "int8"]
+)
+def test_fused_matrix_byte_identical_to_python_oracle(
+    wire_path, name, flat, buckets, mode
+):
+    """The full fused-frame matrix: public path == Python oracle bytes,
+    decode agreement, and semantic round-trip per wire mode."""
+    frame = encode_fused_sparse(flat, buckets, **mode)
+    modes = tc._bucket_modes(
+        tuple(buckets), mode.get("bf16_wire", False),
+        mode.get("int8_wire", False),
+    )
+    oracle = tc._encode_fused_sparse_py(flat, tuple(buckets), modes)
+    assert frame == oracle, (wire_path, name, mode)
+    out = decode_fused_sparse(frame)
+    np.testing.assert_array_equal(
+        out, tc._decode_fused_sparse_py(frame, len(buckets), flat.size)
+    )
+    # Semantics: f32 sections exact under plain; bf16 sections are the
+    # RNE narrowing; int8 bounded by scale/2 per bucket.
+    if not mode:
+        for bname, spans in buckets:
+            for off, size in spans:
+                seg, got = flat[off : off + size], out[off : off + size]
+                if bname in tc._BF16_ORIGIN:
+                    exp = native.bf16_to_f32(native.f32_to_bf16(seg))
+                    exp = np.where(seg == 0, 0.0, exp).astype(np.float32)
+                    np.testing.assert_array_equal(got, exp)
+                else:
+                    np.testing.assert_array_equal(got, seg)
+    elif mode.get("int8_wire"):
+        # The int8 scale is per BUCKET (max|v| over the nonzeros of all
+        # its spans), so the quantization error bound is bucket-wide.
+        for _bname, spans in buckets:
+            segs = [flat[off : off + size] for off, size in spans]
+            cat = np.concatenate(segs) if segs else np.zeros(0, np.float32)
+            nz = cat[cat != 0]
+            scale = float(np.abs(nz).max() / 127.0) if nz.size else 0.0
+            for off, size in spans:
+                assert float(
+                    np.abs(out[off : off + size] - flat[off : off + size])
+                    .max(initial=0.0)
+                ) <= 0.5 * scale + 1e-9
+
+
+def test_fused_nan_payload_survives_bf16_and_refuses_int8(wire_path):
+    """A NaN-poisoned correction must stay LOUD: carried through the
+    bf16/f32 frames, refused (CodecError) by the int8 quantizer."""
+    flat = np.zeros(128, np.float32)
+    flat[3] = np.nan
+    flat[77] = 2.0
+    buckets = (("bfloat16", ((0, 64),)), ("float32", ((64, 64),)))
+    for kw in ({}, {"bf16_wire": True}):
+        out = decode_fused_sparse(encode_fused_sparse(flat, buckets, **kw))
+        assert np.isnan(out[3]) and out[77] == 2.0
+    with pytest.raises(CodecError, match="finite"):
+        encode_fused_sparse(flat, buckets, int8_wire=True)
+    # Inf poisons the int8 scale the same way.
+    flat[3] = np.inf
+    with pytest.raises(CodecError, match="finite"):
+        encode_fused_sparse(flat, buckets, int8_wire=True)
+
+
+@pytest.mark.parametrize(
+    "shape", [(), (0,), (7,), (64, 33), (2, 3, 4)],
+    ids=["0d", "empty", "vec", "mat", "3d"],
+)
+@pytest.mark.parametrize("mode", _MODES, ids=["plain", "bf16", "int8"])
+def test_dense_matrix_byte_identical_across_paths(
+    wire_path, monkeypatch, shape, mode
+):
+    """Dense frames: the served path's bytes equal the forced-fallback
+    bytes, and decode agrees — the dense half of the wire matrix."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=shape).astype(np.float32)
+    frame = encode_tensor(x, **mode)
+    monkeypatch.setenv("DLT_NO_NATIVE", "1")
+    oracle = encode_tensor(x, **mode)
+    decoded_py = decode_tensor(frame)
+    monkeypatch.delenv("DLT_NO_NATIVE")
+    assert frame == oracle
+    np.testing.assert_array_equal(decode_tensor(frame), decoded_py)
+
+
+def test_dense_non_f32_dtypes_keep_python_path(wire_path):
+    """int32/bool/f64 payloads (control-plane tensors) round-trip
+    unchanged — the native fast path only claims f32-sourced frames."""
+    for arr in (
+        np.arange(12, dtype=np.int32).reshape(3, 4),
+        np.asarray([True, False, True]),
+        np.linspace(0, 1, 9, dtype=np.float64),
+    ):
+        np.testing.assert_array_equal(decode_tensor(encode_tensor(arr)), arr)
+
+
+def test_wire_gauge_records_serving_path(monkeypatch):
+    """`comm.wire.native` says which engine ran — run reports and bench
+    records read it instead of guessing from the environment."""
+    flat = np.asarray([1.0, 0.0, 2.0], np.float32)
+    buckets = (("float32", ((0, 3),)),)
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        encode_fused_sparse(flat, buckets)
+    expected = 1.0 if _HAVE_NATIVE else 0.0
+    assert reg.snapshot()["gauges"]["comm.wire.native"] == expected
+    reg2 = MetricsRegistry()
+    monkeypatch.setenv("DLT_NO_NATIVE", "1")
+    with use_registry(reg2):
+        encode_fused_sparse(flat, buckets)
+    assert reg2.snapshot()["gauges"]["comm.wire.native"] == 0.0
+
+
+# --------------------------------------------------------------------- #
+# Corruption / fuzz property test                                       #
+# --------------------------------------------------------------------- #
+def _recrc(frame: bytes) -> bytes:
+    body = frame[:-4]
+    return body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def _base_frames():
+    rng = np.random.default_rng(1234)
+    frames = []
+    for total, buckets in [
+        (256, (("bfloat16", ((0, 128),)), ("float32", ((128, 128),)))),
+        (64, (("float32", ((0, 64),)),)),
+    ]:
+        flat = _sparsify(rng, rng.normal(size=total).astype(np.float32),
+                         keep=0.3)
+        for kw in ({}, {"bf16_wire": True}, {"int8_wire": True}):
+            frames.append(
+                (encode_fused_sparse(flat, buckets, **kw), flat)
+            )
+    return frames
+
+
+def test_fused_fuzz_corruption_never_scatters(wire_path):
+    """~200 seeded mutations per path — truncations, bit flips, and
+    adversarial section lengths/offsets/counts re-stamped with a valid
+    crc — must ALL raise CodecError (crc or bounds), never segfault,
+    never return a silently-wrong ravel of a different shape."""
+    rng = np.random.default_rng(99)
+    frames = _base_frames()
+    cases = rejected = 0
+    while cases < 200:
+        frame, flat = frames[int(rng.integers(len(frames)))]
+        roll = int(rng.integers(3))
+        if roll == 0:  # truncation at a random point
+            cut = int(rng.integers(0, len(frame)))
+            mutant = frame[:cut]
+        elif roll == 1:  # single bit flip anywhere
+            b = bytearray(frame)
+            pos = int(rng.integers(len(b)))
+            b[pos] ^= 1 << int(rng.integers(8))
+            mutant = bytes(b)
+        else:  # adversarial section field + valid crc
+            b = bytearray(frame)
+            # Overwrite a u32 inside the section area (k, an index, a
+            # vlen, a dims field...) with an extreme value.
+            if len(b) <= 16:
+                continue
+            pos = int(rng.integers(8, len(b) - 8))
+            val = int(rng.choice([0xFFFFFFFF, 0x7FFFFFFF, len(b) * 2,
+                                  int(flat.size), 1 << 28]))
+            b[pos : pos + 4] = struct.pack("<I", val)
+            mutant = _recrc(bytes(b))
+        cases += 1
+        try:
+            out = decode_fused_sparse(mutant)
+        except (CodecError, ValueError):
+            rejected += 1
+            continue
+        # The rare mutant that still decodes must be a coherent frame:
+        # right size, and (bit flips aside) values where the crc says.
+        assert out.shape == (flat.size,)
+    # Truncations and bit flips must ALL be rejected (the crc covers
+    # every byte); only the adversarial-u32-then-recrc class may
+    # legitimately survive — when the overwrite lands inside a value
+    # payload it IS a valid frame.  Seeded generator: deterministic.
+    assert rejected >= 150, (rejected, cases)
+
+
+def test_fused_adversarial_sections_raise_bounds_not_write(wire_path):
+    """Targeted adversarial section headers with VALID checksums: the
+    bounds check (not the crc) must reject every one before scatter."""
+    flat = np.zeros(32, np.float32)
+    flat[[1, 9, 30]] = [1.0, -2.0, 3.0]
+    frame = encode_fused_sparse(flat, (("float32", ((0, 32),)),))
+    # k inflated past the ravel.
+    b = bytearray(frame)
+    b[8:12] = struct.pack("<I", 1000)
+    with pytest.raises(CodecError):
+        decode_fused_sparse(_recrc(bytes(b)))
+    # Scatter index == total (one past the end).
+    b = bytearray(frame)
+    b[12:16] = struct.pack("<I", 32)
+    with pytest.raises(CodecError, match="range"):
+        decode_fused_sparse(_recrc(bytes(b)))
+    # Value-section length lying about its payload.
+    b = bytearray(frame)
+    vlen_off = 8 + 4 + 4 * 3  # header | k | idx[3]
+    b[vlen_off : vlen_off + 4] = struct.pack("<I", 5)
+    with pytest.raises(CodecError):
+        decode_fused_sparse(_recrc(bytes(b)))
+    # Trailing slack between the last section and the crc.
+    with pytest.raises(CodecError):
+        decode_fused_sparse(_recrc(frame[:-4] + b"\x00\x00" + frame[-4:]))
+
+
+def test_fused_unsupported_value_dtype_falls_back_to_python_oracle():
+    """A crc-valid frame whose value section rides a dtype the native
+    engine does not speak (here f64) must decode through the Python
+    oracle — identically on both paths, never an error."""
+    idx = np.asarray([2, 5], np.uint32)
+    vals = np.asarray([1.5, -2.5], np.float64)
+    vframe = encode_tensor(vals)
+    body = (
+        struct.pack("<BBBBI", 0xFE, 1, 1, 0, 8)
+        + struct.pack("<I", 2) + idx.tobytes()
+        + struct.pack("<I", len(vframe)) + vframe
+    )
+    frame = body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+    out = decode_fused_sparse(frame)
+    np.testing.assert_array_equal(
+        out, np.asarray([0, 0, 1.5, 0, 0, -2.5, 0, 0], np.float32)
+    )
+
+
+# --------------------------------------------------------------------- #
+# Build hardening: ABI versioning, stale caches, failure visibility     #
+# --------------------------------------------------------------------- #
+def _have_gxx() -> bool:
+    try:
+        subprocess.run(["g++", "--version"], capture_output=True, timeout=30)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def test_abi_version_matches_loaded_libraries():
+    if not _HAVE_NATIVE:
+        pytest.skip("native wire engine unavailable in this env")
+    for lib in (native._load(), wire._load()):
+        assert lib is not None
+        fn = lib.dlt_abi_version
+        fn.restype = ctypes.c_uint32
+        assert int(fn()) == native._ABI_VERSION
+
+
+def test_stale_cached_so_triggers_rebuild_not_attribute_error(tmp_path):
+    """The ISSUE 9 scenario: a cached .so compiled from OLDER source but
+    with a NEWER mtime (git checkout) lacks the new symbols.  _load_lib
+    must detect the ABI mismatch and rebuild from source — the old
+    behavior was an AttributeError at first use."""
+    if not _have_gxx():
+        pytest.skip("no g++ in this environment")
+    src = tmp_path / "mini.cpp"
+    lib_path = tmp_path / "_mini.so"
+    src.write_text(
+        "#include <cstdint>\n"
+        'extern "C" { uint32_t dlt_abi_version() { return %du; }\n'
+        "int dlt_mini_marker() { return 7; } }\n" % native._ABI_VERSION
+    )
+    # Build a STALE library (no dlt_abi_version at all) and postdate it
+    # so the mtime check alone would keep serving it.
+    stale_src = tmp_path / "stale.cpp"
+    stale_src.write_text('extern "C" { int old_symbol() { return 1; } }\n')
+    subprocess.run(
+        ["g++", "-O0", "-shared", "-fPIC", str(stale_src), "-o",
+         str(lib_path)],
+        check=True, capture_output=True, timeout=120,
+    )
+    os.utime(lib_path, (2**31 - 10, 2**31 - 10))
+    lib = native._load_lib(str(src), str(lib_path), lambda l: None)
+    assert lib is not None, "stale cache must be rebuilt, not served"
+    lib.dlt_mini_marker.restype = ctypes.c_int
+    assert lib.dlt_mini_marker() == 7
+
+
+def test_wrong_abi_after_rebuild_falls_back_with_counter(tmp_path):
+    """A source that genuinely reports the wrong ABI (toolchain/source
+    skew) must end in the Python fallback with the failure counted."""
+    if not _have_gxx():
+        pytest.skip("no g++ in this environment")
+    src = tmp_path / "wrong.cpp"
+    src.write_text(
+        "#include <cstdint>\n"
+        'extern "C" { uint32_t dlt_abi_version() { return 424242u; } }\n'
+    )
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        lib = native._load_lib(
+            str(src), str(tmp_path / "_wrong.so"), lambda l: None
+        )
+    assert lib is None
+    assert reg.snapshot()["counters"]["native.build_failed"] == 1
+
+
+def test_failed_build_warns_and_bumps_counter(tmp_path, caplog):
+    """g++ failing must be VISIBLE: one dlt.native warning and a
+    native.build_failed counter bump (it used to return None silently)."""
+    if not _have_gxx():
+        pytest.skip("no g++ in this environment")
+    src = tmp_path / "broken.cpp"
+    src.write_text("this is not C++\n")
+    reg = MetricsRegistry()
+    with caplog.at_level("WARNING", logger="dlt.native"):
+        with use_registry(reg):
+            out = native._build_lib(str(src), str(tmp_path / "_broken.so"))
+    assert out is None
+    assert reg.snapshot()["counters"]["native.build_failed"] == 1
+    assert any("native build" in r.message for r in caplog.records)
+
+
+def test_so_artifacts_are_gitignored():
+    """The built libraries are per-box artifacts: they must never be
+    trackable (a committed .so from one box is a stale cache on every
+    other)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        ["git", "check-ignore",
+         "distributed_learning_tpu/native/_codec.so",
+         "distributed_learning_tpu/native/_wire.so"],
+        cwd=repo, capture_output=True, text=True,
+    )
+    assert out.returncode == 0, "native *.so must be gitignored"
+    tracked = subprocess.run(
+        ["git", "ls-files", "distributed_learning_tpu/native/"],
+        cwd=repo, capture_output=True, text=True,
+    ).stdout
+    assert ".so" not in tracked

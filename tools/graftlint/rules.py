@@ -39,6 +39,11 @@ invariants (CLAUDE.md "Conventions that bite", SURVEY.md §2):
 * ``reference-citation`` — docstring/comment ``file:line`` citations
   must resolve (into ``/root/reference`` when present, else against the
   repo itself) so provenance pointers cannot rot.
+* ``wire-code-unique`` — the one-byte message type codes of
+  ``comm/protocol.py`` must be unique and every message class must be
+  registered in the single ``_REGISTRY`` table: a duplicated code is a
+  silent frame-misparse (the receiver unpacks the wrong dataclass from
+  a valid frame), and an unregistered class raises only at first send.
 """
 
 from __future__ import annotations
@@ -562,6 +567,162 @@ class WallclockDuration(Rule):
                         "<why monotonic cannot serve here>'",
                     )
                 )
+        return out
+
+
+@register
+class WireCodeUnique(Rule):
+    """Message TYPE_CODEs must be unique and registered in ONE table.
+
+    ``comm/protocol.py``'s one-byte type codes are the wire's dispatch
+    keys: a duplicated code makes ``unpack_message`` deserialize a valid
+    frame into the WRONG dataclass — a silent misparse the crc cannot
+    catch — and a class missing from ``_REGISTRY`` fails only at first
+    send/receive.  With 17+ codes across stacked PRs, this is checked
+    statically: every ``TYPE_CODE`` (>= 0) appears once, and the set of
+    classes defining one exactly matches the classes enumerated in the
+    single ``_REGISTRY`` dict-comprehension table.
+    """
+
+    name = "wire-code-unique"
+    files = frozenset({"distributed_learning_tpu/comm/protocol.py"})
+
+    @staticmethod
+    def _type_code_of(cls: ast.ClassDef):
+        """(code, lineno) when the class body assigns TYPE_CODE to an
+        int literal, else None."""
+        for node in cls.body:
+            target = None
+            if isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                target = node.target.id
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+                isinstance(node.targets[0], ast.Name)
+            ):
+                target = node.targets[0].id
+            if target != "TYPE_CODE":
+                continue
+            value = node.value
+            code = None
+            if isinstance(value, ast.Constant) and isinstance(
+                value.value, int
+            ):
+                code = value.value
+            elif (
+                isinstance(value, ast.UnaryOp)
+                and isinstance(value.op, ast.USub)
+                and isinstance(value.operand, ast.Constant)
+            ):
+                code = -value.operand.value
+            if code is not None:
+                return code, node.lineno
+        return None
+
+    @staticmethod
+    def _registry_names(tree: ast.Module):
+        """Class names enumerated in the ``_REGISTRY`` dict-comprehension
+        table, or None when no such single table exists."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):  # _REGISTRY: Dict[...] =
+                target = node.target
+            else:
+                continue
+            if not (
+                isinstance(target, ast.Name)
+                and target.id == "_REGISTRY"
+                and isinstance(node.value, ast.DictComp)
+                and node.value.generators
+            ):
+                continue
+            src = node.value.generators[0].iter
+            if isinstance(src, (ast.Tuple, ast.List)):
+                names = [
+                    el.id for el in src.elts if isinstance(el, ast.Name)
+                ]
+                return names, node.lineno
+        return None
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        if ctx.relpath not in self.files:
+            return []
+        out: List[Finding] = []
+        coded: Dict[int, str] = {}
+        class_lines: Dict[str, int] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            tc = self._type_code_of(node)
+            if tc is None:
+                continue
+            code, lineno = tc
+            if code < 0:
+                continue  # the Message base's sentinel
+            class_lines[node.name] = lineno
+            if code in coded:
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.relpath,
+                        lineno,
+                        f"TYPE_CODE {code} of {node.name} duplicates "
+                        f"{coded[code]}: a shared code makes "
+                        "unpack_message deserialize valid frames into "
+                        "the wrong message class (silent misparse)",
+                    )
+                )
+            else:
+                coded[code] = node.name
+        reg = self._registry_names(ctx.tree)
+        if reg is None:
+            if class_lines:
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.relpath,
+                        1,
+                        "no single _REGISTRY dict-comprehension table "
+                        "found: all message classes must register their "
+                        "type codes in one place",
+                    )
+                )
+            return out
+        names, reg_line = reg
+        for cls_name, lineno in sorted(class_lines.items()):
+            if cls_name not in names:
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.relpath,
+                        lineno,
+                        f"{cls_name} defines a TYPE_CODE but is missing "
+                        "from the _REGISTRY table: its frames raise "
+                        "'unknown message type code' at first receive",
+                    )
+                )
+        for name in names:
+            if name not in class_lines:
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.relpath,
+                        reg_line,
+                        f"_REGISTRY lists '{name}', which defines no "
+                        "integer TYPE_CODE in this module",
+                    )
+                )
+        dup_reg = {n for n in names if names.count(n) > 1}
+        for name in sorted(dup_reg):
+            out.append(
+                Finding(
+                    self.name,
+                    ctx.relpath,
+                    reg_line,
+                    f"_REGISTRY lists '{name}' more than once",
+                )
+            )
         return out
 
 
