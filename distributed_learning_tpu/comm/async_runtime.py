@@ -257,6 +257,7 @@ class AsyncGossipRunner:
             await a._apply_neighborhood(msg)
             for token in list(self._inbox):
                 if token not in a._weights:
+                    # graftlint: disable=task-shared-mutation -- membership turn discipline: _handle_master runs inside the round task's own _recv_step await (never concurrently with _consume/_mix_plain, which only run after _collect returns), so evicting a removed edge's inbox here cannot race the round's reads
                     del self._inbox[token]
         elif isinstance(msg, P.Shutdown):
             a.status = AgentStatus.SHUTDOWN
@@ -286,6 +287,7 @@ class AsyncGossipRunner:
                 (msg.value, msg.round_id, msg.staleness)
             )
             box.dropped = False
+            # graftlint: disable=task-shared-mutation -- arrival-clears-excursion FIFO discipline: the discard runs at the single dispatch service point (inside the round task's _recv_step await), and _poke only re-adds after _collect has re-checked _needs_fresh on the post-arrival state
             self._poked.discard(token)
             a._count("async_values_received")
         elif isinstance(msg, P.AsyncPoke):

@@ -67,10 +67,25 @@ def _report_build_failure(src: str, detail: str) -> None:
         pass
 
 
+def _cache_override(lib_path: str) -> str:
+    """Instrumented-build hook (graftlint --native, ISSUE 10): when
+    ``DLT_NATIVE_CACHE_DIR`` is set, the built ``.so`` lives under that
+    directory instead of beside its source — a sanitizer run rebuilds
+    with its own flags WITHOUT ever clobbering the production cache."""
+    cache_dir = os.environ.get("DLT_NATIVE_CACHE_DIR")
+    if not cache_dir:
+        return lib_path
+    os.makedirs(cache_dir, exist_ok=True)
+    return os.path.join(cache_dir, os.path.basename(lib_path))
+
+
 def _build_lib(src: str, lib_path: str, *, force: bool = False) -> Optional[str]:
     """Compile ``src`` to ``lib_path`` unless a fresh cache exists.
 
     ``force`` ignores the cache (the ABI-mismatch rebuild path).
+    ``DLT_NATIVE_EXTRA_CFLAGS`` (space-separated) appends build flags —
+    the sanitizer stage's ``-fsanitize=...`` hook; combined with
+    ``DLT_NATIVE_CACHE_DIR`` the instrumented build is fully separate.
     """
     if (
         not force
@@ -85,7 +100,11 @@ def _build_lib(src: str, lib_path: str, *, force: bool = False) -> Optional[str]
     # the wire engine's bulk loops vectorize; boxes whose toolchain
     # rejects it retry with the portable baseline.
     tmp = f"{lib_path}.{os.getpid()}.tmp"
-    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+    extra_cflags = os.environ.get("DLT_NATIVE_EXTRA_CFLAGS", "").split()
+    base = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        *extra_cflags, src, "-o", tmp,
+    ]
     last_exc: Optional[BaseException] = None
     for extra in (["-march=native"], []):
         try:
@@ -203,7 +222,7 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("DLT_NO_NATIVE") == "1":
             return None
-        _lib = _load_lib(_SRC, _LIB, _configure_codec)
+        _lib = _load_lib(_SRC, _cache_override(_LIB), _configure_codec)
         return _lib
 
 

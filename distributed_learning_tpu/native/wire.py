@@ -32,7 +32,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-from distributed_learning_tpu.native import _HERE, _load_lib
+from distributed_learning_tpu.native import _HERE, _cache_override, _load_lib
 
 __all__ = [
     "available",
@@ -125,7 +125,10 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("DLT_NO_NATIVE") == "1":
             return None
-        _lib = _load_lib(_SRC, _LIB, _configure)
+        # DLT_NATIVE_CACHE_DIR reroutes the built .so (the sanitized-
+        # build hook for graftlint --native): instrumented builds live
+        # in their own cache, never clobbering the production _wire.so.
+        _lib = _load_lib(_SRC, _cache_override(_LIB), _configure)
         return _lib
 
 

@@ -591,6 +591,209 @@ def test_cli_rejects_unknown_rule():
 
 
 # --------------------------------------------------------------------- #
+# --list-rules --json golden (ISSUE 10: docs/CI cannot silently drift   #
+# from the registered rule set)                                         #
+# --------------------------------------------------------------------- #
+#: The registered rule set, pinned.  Adding/removing/renaming a rule
+#: means updating THIS list and docs/static_analysis.md together.
+GOLDEN_RULES = [
+    "banned-import",
+    "blocking-in-async",
+    "host-sync-in-hot-path",
+    "no-pickle",
+    "no-print-in-library",
+    "raw-collective-in-shard-map",
+    "reference-citation",
+    "stdout-contract",
+    "task-shared-mutation",
+    "unawaited-coroutine",
+    "wallclock-duration",
+    "wire-code-unique",
+    "wire-contract-drift",
+    "wire-contract-pin",
+]
+
+#: Rules whose suppression must carry a reason, pinned.
+GOLDEN_REQUIRES_REASON = [
+    "blocking-in-async",
+    "host-sync-in-hot-path",
+    "no-print-in-library",
+    "raw-collective-in-shard-map",
+    "task-shared-mutation",
+    "unawaited-coroutine",
+    "wallclock-duration",
+]
+
+
+def test_cli_list_rules_json_golden():
+    out = _cli("--list-rules", "--json")
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout)
+    assert [r["name"] for r in payload["rules"]] == GOLDEN_RULES
+    assert [
+        r["name"] for r in payload["rules"] if r["requires_reason"]
+    ] == GOLDEN_REQUIRES_REASON
+    assert payload["stages"] == [
+        "ast", "wire-contract", "audit", "native-san"
+    ]
+    assert "disable=<rule>" in payload["suppression"]
+    for r in payload["rules"]:
+        assert r["summary"], f"rule {r['name']} has no docstring summary"
+        assert r["stage"] in ("ast", "wire-contract")
+    # The human docs must mention every registered rule.
+    doc = open(os.path.join(REPO_ROOT, "docs", "static_analysis.md")).read()
+    missing = [r for r in GOLDEN_RULES if f"`{r}`" not in doc]
+    assert not missing, f"docs/static_analysis.md lacks rows for {missing}"
+
+
+# --------------------------------------------------------------------- #
+# --changed robustness (ISSUE 10 fix: deleted/renamed files)            #
+# --------------------------------------------------------------------- #
+def test_changed_files_partitions_deleted_paths(tmp_path):
+    """A file deleted from the working tree appears in the diff but must
+    land in the 'missing' bucket, never be opened."""
+    from tools.graftlint.__main__ import _changed_files
+
+    repo = tmp_path / "repo"
+    (repo / "benchmarks").mkdir(parents=True)
+    keep = repo / "benchmarks" / "keep.py"
+    gone = repo / "benchmarks" / "gone.py"
+    keep.write_text("x = 1\n")
+    gone.write_text("y = 2\n")
+    env = {
+        **os.environ,
+        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+    }
+    for cmd in (
+        ["git", "init", "-q"],
+        ["git", "add", "-A"],
+        ["git", "commit", "-qm", "seed"],
+    ):
+        subprocess.run(cmd, cwd=repo, env=env, check=True,
+                       capture_output=True)
+    gone.unlink()
+    keep.write_text("x = 3\n")
+    scoped, missing, changed = _changed_files(repo_root=str(repo))
+    assert scoped == [str(keep)]
+    assert missing == ["benchmarks/gone.py"]
+    assert "benchmarks/gone.py" in changed
+
+
+def test_cli_changed_notices_deleted_paths(monkeypatch, capsys):
+    """main() with a diff of only-deleted paths: notice + rc 0, no
+    crash, no full-tree fallback lint."""
+    import tools.graftlint.__main__ as cli
+
+    monkeypatch.setattr(
+        cli, "_changed_files",
+        lambda repo_root=None: ([], ["benchmarks/gone.py"],
+                                ["benchmarks/gone.py"]),
+    )
+    rc = cli.main(["--changed"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "skipping deleted/renamed path(s): benchmarks/gone.py" in err
+
+
+def test_cli_explicit_missing_path_notices_and_continues(tmp_path):
+    good = tmp_path / "ok.py"
+    good.write_text("x = 1\n")
+    out = _cli(str(good), str(tmp_path / "missing.py"))
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "skipping non-existent path(s)" in out.stderr
+
+
+def test_cli_all_missing_paths_never_fall_back_to_full_tree(
+    monkeypatch, capsys
+):
+    """An explicit selection that filtered down to nothing lints
+    NOTHING — the empty-selection/default-roots ambiguity must not turn
+    a typo'd path into a silent whole-tree run."""
+    import tools.graftlint.__main__ as cli
+
+    def _no_full_tree(paths, rules=None):
+        assert paths, "explicit empty selection must not lint the tree"
+        return []
+
+    monkeypatch.setattr(cli, "lint_paths", _no_full_tree)
+    rc = cli.main(["/nonexistent/a.py"])
+    err = capsys.readouterr().err
+    assert rc == 0 and "skipping non-existent path(s)" in err
+
+
+# --------------------------------------------------------------------- #
+# tools/precommit.sh (ISSUE 10 satellite)                               #
+# --------------------------------------------------------------------- #
+def test_precommit_clean_tree_exits_zero():
+    out = subprocess.run(
+        ["bash", os.path.join(REPO_ROOT, "tools", "precommit.sh")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-500:])
+    assert "graftlint:" in out.stderr
+
+
+def test_precommit_fails_on_seeded_violation():
+    seed = os.path.join(REPO_ROOT, "benchmarks", "_precommit_seed_tmp.py")
+    try:
+        with open(seed, "w") as fh:
+            fh.write("import cvxpy\n")
+        out = subprocess.run(
+            ["bash", os.path.join(REPO_ROOT, "tools", "precommit.sh")],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 1, (out.stdout, out.stderr)
+        assert "banned-import" in out.stdout
+        assert "_precommit_seed_tmp.py" in out.stdout
+    finally:
+        os.unlink(seed)
+
+
+# --------------------------------------------------------------------- #
+# --report-unverified (ISSUE 10 satellite)                              #
+# --------------------------------------------------------------------- #
+def test_report_unverified_lists_shim_pins_with_provenance(tmp_path):
+    """The library path, against a fixture pin file: verified entries
+    are silent, shim-pinned ones carry provenance + a re-verify line
+    (skipped on jaxes without the feature; live-matched on newer ones),
+    stale entry names are called out."""
+    exp = tmp_path / "expected.json"
+    exp.write_text(json.dumps({
+        "tp_train_step": {"kind": "hlo", "inventory": {}, "verified": True},
+        "async_stale_mix": {
+            "kind": "jaxpr",
+            "inventory": {"all_gather|agents": 2},
+            "verified": False,
+            "provenance": "shim-pinned: fixture",
+        },
+        "ghost_entry": {
+            "kind": "jaxpr", "inventory": {}, "verified": False,
+        },
+        "wire_contract": {"kind": "wire-contract", "contract": {}},
+    }))
+    report = jaxpr_audit.report_unverified(expected_path=str(exp))
+    assert sorted(report) == ["async_stale_mix", "ghost_entry"]
+    entry = report["async_stale_mix"]
+    assert entry["provenance"] == "shim-pinned: fixture"
+    assert entry["reverify"].startswith(("ok:", "MISMATCH:", "skipped:"))
+    assert "no longer registered" in report["ghost_entry"]["reverify"]
+    assert "provenance" in report["ghost_entry"]  # unrecorded default
+    # Reporting must never flip verified flags (that is --audit-write's
+    # job): the fixture file is untouched.
+    assert json.loads(exp.read_text())["async_stale_mix"]["verified"] is False
+
+
+def test_report_unverified_cli_smoke():
+    out = _cli("--report-unverified", "--rules", "no-pickle")
+    # rc 1 is reserved for a live re-verify MISMATCH — a real defect.
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-500:])
+    for name in ("async_stale_mix", "choco_run_fused", "pp_1f1b_head_fn"):
+        assert f"unverified pin: {name}" in out.stdout
+    assert "provenance:" in out.stdout and "re-verify:" in out.stdout
+
+
+# --------------------------------------------------------------------- #
 # jaxpr/HLO audit                                                       #
 # --------------------------------------------------------------------- #
 def test_normalize_primitive_prefixes():

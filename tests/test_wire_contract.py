@@ -1,0 +1,214 @@
+"""graftlint wire-contract stage (ISSUE 10): the Python<->C++ drift
+checker sees the real constants, passes on the shipped tree, and each
+seeded drift class — mutated TYPE_CODE, frame-version byte, ABI
+version, crc polynomial — fails with the constant named.
+
+Fixture pattern: the real contract files are COPIED into a tmp repo
+skeleton, one constant is mutated, and the stage runs against the copy
+— the acceptance criterion's "copied wire.cpp fixture", so the tests
+never touch the live sources.
+"""
+
+import json
+import os
+import re
+import shutil
+
+import pytest
+
+import tools.graftlint  # noqa: F401  (registers the rule set)
+from tools.graftlint import wire_contract as wc
+from tools.graftlint.core import REPO_ROOT
+
+
+@pytest.fixture
+def contract_tree(tmp_path):
+    """A tmp repo skeleton holding copies of all contract files plus a
+    copy of the real pin; returns (root, expected_path)."""
+    for rel in wc.CONTRACT_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO_ROOT, rel), dst)
+    expected = tmp_path / "audit_expected.json"
+    shutil.copy(
+        os.path.join(REPO_ROOT, "tools", "graftlint", "audit_expected.json"),
+        expected,
+    )
+    return str(tmp_path), str(expected)
+
+
+def _mutate(root, rel, pattern, repl):
+    path = os.path.join(root, rel)
+    src = open(path).read()
+    out, n = re.subn(pattern, repl, src, count=1)
+    assert n == 1, f"fixture mutation {pattern!r} did not match {rel}"
+    open(path, "w").write(out)
+
+
+# --------------------------------------------------------------------- #
+# the shipped tree                                                      #
+# --------------------------------------------------------------------- #
+def test_real_tree_contract_extracts_and_passes():
+    contract, findings = wc.extract()
+    assert findings == [], [str(f) for f in findings]
+    # The extractor must actually SEE the surface it guards.
+    assert contract["abi_version"] == 2
+    assert contract["fused_magic"] == 0xFE
+    assert contract["crc_poly"] == "0xedb88320"
+    assert len(contract["type_codes"]) >= 17
+    assert contract["vlen"] == {
+        "bf16": [8, 2], "f32": [8, 4], "i8": [12, 1]
+    }
+    assert contract["status_codes"]["ERR_INTERNAL"] == -10
+    assert wc.check() == []
+
+
+def test_contract_is_pinned_in_audit_expected():
+    expected = json.load(
+        open(os.path.join(
+            REPO_ROOT, "tools", "graftlint", "audit_expected.json"
+        ))
+    )
+    entry = expected.get("wire_contract")
+    assert entry and entry["kind"] == "wire-contract"
+    contract, _ = wc.extract()
+    assert entry["contract"] == contract
+
+
+# --------------------------------------------------------------------- #
+# seeded drifts (one per acceptance class)                              #
+# --------------------------------------------------------------------- #
+def test_drift_mutated_type_code_fails_pin(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/comm/protocol.py",
+        r"TYPE_CODE: ClassVar\[int\] = 17", "TYPE_CODE: ClassVar[int] = 18",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    assert [f.rule for f in fs] == [wc.PIN_RULE], [str(f) for f in fs]
+    assert "AsyncPoke" in fs[0].message and "audit-write" in fs[0].message
+
+
+def test_drift_frame_version_byte_fails_cross_language(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"constexpr uint8_t kFusedVersion = 1;",
+        "constexpr uint8_t kFusedVersion = 2;",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift, [str(f) for f in fs]
+    assert "kFusedVersion" in drift[0].message
+    assert "_FUSED_VERSION" in drift[0].message
+    assert drift[0].path.endswith("wire.cpp")
+
+
+def test_drift_abi_version_fails_cross_language(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/dlt_abi.h",
+        r"#define DLT_ABI_VERSION 2u", "#define DLT_ABI_VERSION 3u",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift and "DLT_ABI_VERSION" in drift[0].message
+    assert "_ABI_VERSION" in drift[0].message
+
+
+def test_drift_crc_polynomial_fails_cross_language(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"\? 0xEDB88320u \^ \(c >> 1\)", "? 0xEDB88321u ^ (c >> 1)",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift and "polynomial" in drift[0].message
+
+
+def test_drift_dtype_code_fails_cross_language(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"constexpr uint8_t kDtypeBf16 = 5;",
+        "constexpr uint8_t kDtypeBf16 = 4;",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift and "kDtypeBf16" in drift[0].message
+
+
+def test_drift_value_section_width_fails_cross_language(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"case kModeI8:\n      return 12 \+ k;",
+        "case kModeI8:\n      return 16 + k;",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift and "vlen_of(i8)" in drift[0].message
+
+
+def test_extraction_failure_is_a_finding_not_a_silent_pass(contract_tree):
+    """Refactoring a constant out of the extractor's reach must FAIL
+    (a drift checker that silently sees nothing is disarmed)."""
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"constexpr uint8_t kFusedMagic = 0xFE;",
+        "static const unsigned char kFusedMagic = 0xFE;",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift and "kFusedMagic not found" in drift[0].message
+
+
+# --------------------------------------------------------------------- #
+# pin lifecycle                                                         #
+# --------------------------------------------------------------------- #
+def test_unpinned_contract_reports_and_write_pin_records(contract_tree):
+    root, expected = contract_tree
+    exp = json.load(open(expected))
+    del exp["wire_contract"]
+    json.dump(exp, open(expected, "w"))
+    fs = wc.check(repo_root=root, expected_path=expected)
+    assert [f.rule for f in fs] == [wc.PIN_RULE]
+    assert "no pin recorded" in fs[0].message
+    assert wc.write_pin(repo_root=root, expected_path=expected) == []
+    assert wc.check(repo_root=root, expected_path=expected) == []
+    entry = json.load(open(expected))["wire_contract"]
+    assert entry["verified"] is True and "provenance" in entry
+
+
+def test_write_pin_refuses_to_freeze_a_cross_language_drift(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"constexpr uint8_t kFusedVersion = 1;",
+        "constexpr uint8_t kFusedVersion = 2;",
+    )
+    before = json.load(open(expected))["wire_contract"]
+    fs = wc.write_pin(repo_root=root, expected_path=expected)
+    assert fs, "write_pin must refuse while the sides disagree"
+    assert json.load(open(expected))["wire_contract"] == before
+
+
+def test_intentional_bump_goes_through_audit_write(contract_tree):
+    """Both sides bumped consistently: the pin (not the drift check)
+    fails, and --audit-write's write_pin acknowledges it."""
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"constexpr uint8_t kFusedVersion = 1;",
+        "constexpr uint8_t kFusedVersion = 2;",
+    )
+    _mutate(
+        root, "distributed_learning_tpu/comm/tensor_codec.py",
+        r"_FUSED_VERSION = 1", "_FUSED_VERSION = 2",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    assert [f.rule for f in fs] == [wc.PIN_RULE]
+    assert wc.write_pin(repo_root=root, expected_path=expected) == []
+    assert wc.check(repo_root=root, expected_path=expected) == []

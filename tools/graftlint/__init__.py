@@ -1,18 +1,27 @@
 """graftlint: static analysis enforcing this repo's SPMD, wire-format,
-and dependency invariants.
+concurrency, and dependency invariants.
 
-Two stages:
+Four stages (full reference: ``docs/static_analysis.md``):
 
-* AST (``tools/graftlint/rules.py``): pluggable source rules over
+* AST (``rules.py`` + ``concurrency.py``): pluggable source rules over
   ``distributed_learning_tpu/``, ``benchmarks/``, ``examples/`` and
   ``bench.py``, with ``# graftlint: disable=<rule>[ -- reason]`` inline
   suppressions.  Imports no jax — safe and fast anywhere.
-* jaxpr/HLO audit (``tools/graftlint/jaxpr_audit.py``): traces the
+* Wire contract (``wire_contract.py``): the Python<->C++ drift checker
+  for the native wire engine's hand-maintained constants, pinned next
+  to the collective inventories in ``audit_expected.json``.  Also
+  jax-free (regex + ``ast``, no compiler).
+* jaxpr/HLO audit (``jaxpr_audit.py``, ``--audit``): traces the
   registered SPMD entry points on the 8-virtual-device CPU mesh and
-  pins their collective inventories.
+  pins their collective inventories (+ cost columns).
+* Sanitizer replay (``native_san.py``, ``--native``): rebuilds the
+  native libs under ASan/UBSan into a separate cache and replays the
+  wire fuzz corpus + oracle matrix; any report fails lint.
 
-CLI: ``python -m tools.graftlint`` (see ``--help``); tier-1 coverage:
-``tests/test_graftlint.py``.
+CLI: ``python -m tools.graftlint`` (see ``--help``); pre-commit gate:
+``tools/precommit.sh``; tier-1 coverage: ``tests/test_graftlint.py``,
+``tests/test_graftlint_concurrency.py``, ``tests/test_wire_contract.py``,
+``tests/test_native_san.py``.
 """
 
 from tools.graftlint.core import (  # noqa: F401
@@ -29,3 +38,4 @@ from tools.graftlint.core import (  # noqa: F401
     register,
 )
 import tools.graftlint.rules  # noqa: F401  (registers the rule set)
+import tools.graftlint.concurrency  # noqa: F401  (async-concurrency rules)

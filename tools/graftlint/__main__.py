@@ -1,20 +1,31 @@
 """CLI: ``python -m tools.graftlint [paths...]``.
 
-Exits non-zero when any unsuppressed finding (or audit mismatch)
-survives.  The AST stage imports no jax, so it is safe to run without
-the CPU-pinning env dance; ``--audit`` sets ``JAX_PLATFORMS=cpu`` and
-the 8-virtual-device flag itself *before* jax is first imported.
+Exits non-zero when any unsuppressed finding (or audit/contract/
+sanitizer mismatch) survives.  Four stages:
 
-Pre-commit usage: ``python -m tools.graftlint --changed`` lints only
-files modified vs. HEAD (plus untracked ones) inside the scanned roots.
+* **AST rules** (always): import no jax — safe to run bare.
+* **Wire contract** (always on full/--changed runs touching the
+  contract files): Python<->C++ drift check + pin, also jax-free.
+* **jaxpr/HLO audit** (``--audit``): sets ``JAX_PLATFORMS=cpu`` and the
+  8-virtual-device flag itself *before* jax is first imported.
+* **Sanitizer replay** (``--native``): rebuilds both native libs under
+  ASan/UBSan into a separate cache and replays the wire fuzz corpus +
+  oracle matrix; skips with a notice when the toolchain is absent.
+
+Pre-commit usage: ``python -m tools.graftlint --changed`` (or
+``tools/precommit.sh``) lints only files modified vs. HEAD (plus
+untracked ones) inside the scanned roots — deleted/renamed paths are
+skipped with a notice.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
+from typing import List, Tuple
 
 from tools.graftlint import (
     DEFAULT_ROOTS,
@@ -22,19 +33,24 @@ from tools.graftlint import (
     RULES,
     lint_paths,
 )
+from tools.graftlint import wire_contract
 
 
-def _changed_files() -> list:
+def _changed_files(repo_root: str = REPO_ROOT) -> Tuple[list, list, list]:
+    """(python paths to lint, skipped non-existent relpaths, all changed
+    relpaths).  Deleted/renamed entries in the diff resolve to paths
+    that no longer exist — they are reported, never opened."""
     out = subprocess.run(
         ["git", "diff", "--name-only", "HEAD"],
-        cwd=REPO_ROOT, capture_output=True, text=True, check=False,
+        cwd=repo_root, capture_output=True, text=True, check=False,
     ).stdout.splitlines()
     out += subprocess.run(
         ["git", "ls-files", "--others", "--exclude-standard"],
-        cwd=REPO_ROOT, capture_output=True, text=True, check=False,
+        cwd=repo_root, capture_output=True, text=True, check=False,
     ).stdout.splitlines()
-    scoped = []
-    for rel in sorted(set(out)):
+    changed = sorted(set(out))
+    scoped, missing = [], []
+    for rel in changed:
         if not rel.endswith(".py"):
             continue
         if not any(
@@ -42,17 +58,123 @@ def _changed_files() -> list:
             for root in DEFAULT_ROOTS
         ):
             continue
-        full = os.path.join(REPO_ROOT, rel)
+        full = os.path.join(repo_root, rel)
         if os.path.isfile(full):
             scoped.append(full)
-    return scoped
+        else:
+            missing.append(rel)
+    return scoped, missing, changed
+
+
+def _list_rules(as_json: bool) -> int:
+    if not as_json:
+        for name in sorted(RULES):
+            doc = (RULES[name].__doc__ or "").strip().splitlines()
+            print(f"{name:32s} {doc[0] if doc else ''}")
+        return 0
+    rules = []
+    for name in sorted(RULES):
+        rule = RULES[name]
+        doc = (rule.__doc__ or "").strip().splitlines()
+        rules.append(
+            {
+                "name": name,
+                "stage": rule.stage,
+                "requires_reason": rule.requires_reason,
+                "summary": doc[0] if doc else "",
+            }
+        )
+    print(
+        json.dumps(
+            {
+                "rules": rules,
+                "stages": ["ast", "wire-contract", "audit", "native-san"],
+                "suppression":
+                    "# graftlint: disable=<rule>[,<rule>] -- <reason>",
+            },
+            indent=2,
+            sort_keys=True,
+        )
+    )
+    return 0
+
+
+def _pin_jax_env() -> None:
+    """Pin the CPU mesh BEFORE jax is imported (tests/conftest.py
+    contract) — shared by --audit and --report-unverified."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def _run_audit(write: bool) -> int:
+    from tools.graftlint.jaxpr_audit import audit
+
+    rc = 0
+    results = audit(write=write)
+    for name, res in sorted(results.items()):
+        line = f"audit {name}: {res['status']}"
+        if res.get("cost"):
+            cost = res["cost"]
+            cols = []
+            if cost.get("flops") is not None:
+                cols.append(f"flops={cost['flops']:.4g}")
+            if cost.get("peak_bytes") is not None:
+                cols.append(f"peak_bytes={int(cost['peak_bytes']):,}")
+            if cols:
+                line += " [cost " + " ".join(cols) + "]"
+        if res.get("detail"):
+            line += f" — {res['detail']}"
+        print(line, file=sys.stderr)
+        if res["status"] in ("mismatch", "error"):
+            rc = 1
+        if res["status"] == "unpinned":
+            print(
+                f"audit {name}: no pin recorded; run with "
+                "--audit-write to record it",
+                file=sys.stderr,
+            )
+            rc = 1
+    return rc
+
+
+def _run_report_unverified() -> int:
+    from tools.graftlint.jaxpr_audit import report_unverified
+
+    rc = 0
+    report = report_unverified()
+    if not report:
+        print("report-unverified: every pinned entry is verified")
+        return 0
+    for name, info in sorted(report.items()):
+        print(f"unverified pin: {name} [{info['kind']}]")
+        print(f"  inventory:  {json.dumps(info['inventory'], sort_keys=True)}")
+        print(f"  provenance: {info['provenance']}")
+        print(f"  re-verify:  {info['reverify']}")
+        if info["reverify"].startswith("MISMATCH"):
+            rc = 1
+    return rc
+
+
+def _run_native() -> Tuple[int, List[str]]:
+    from tools.graftlint.native_san import run_native_stage
+
+    status, detail = run_native_stage()
+    for line in detail:
+        print(f"native-san: {line}", file=sys.stderr)
+    print(f"native-san: {status}", file=sys.stderr)
+    return (1 if status == "fail" else 0), detail
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="AST + jaxpr static analysis for this repo's SPMD, "
-        "wire-format, and dependency invariants.",
+        description="AST + wire-contract + jaxpr + sanitizer static "
+        "analysis for this repo's SPMD, wire-format, concurrency, and "
+        "dependency invariants (docs/static_analysis.md).",
     )
     ap.add_argument("paths", nargs="*",
                     help="files to lint (default: %s)"
@@ -63,19 +185,27 @@ def main(argv=None) -> int:
                     help="comma-separated rule subset")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the registered rules and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="with --list-rules: machine-readable output")
     ap.add_argument("--audit", action="store_true",
                     help="also run the jaxpr/HLO collective-inventory "
                     "audit on the 8-virtual-device CPU mesh")
     ap.add_argument("--audit-write", action="store_true",
-                    help="regenerate audit_expected.json from the "
-                    "observed inventories (implies --audit)")
+                    help="regenerate audit_expected.json (collective "
+                    "inventories AND the wire-contract pin) from the "
+                    "observed state (implies --audit)")
+    ap.add_argument("--report-unverified", action="store_true",
+                    help="list every verified:false shim-pinned audit "
+                    "entry with its provenance, and try a live "
+                    "re-verify when the running jax supports it")
+    ap.add_argument("--native", action="store_true",
+                    help="build the native libs under ASan/UBSan into a "
+                    "separate cache and replay the wire fuzz corpus + "
+                    "oracle matrix; any sanitizer report fails lint")
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for name in sorted(RULES):
-            doc = (RULES[name].__doc__ or "").strip().splitlines()
-            print(f"{name:32s} {doc[0] if doc else ''}")
-        return 0
+        return _list_rules(args.json)
 
     rules = None
     if args.rules:
@@ -86,53 +216,87 @@ def main(argv=None) -> int:
             return 2
         rules = {r: RULES[r] for r in wanted}
 
+    aux_stage = (
+        args.audit or args.audit_write or args.report_unverified
+        or args.native
+    )
     paths = args.paths
+    changed_rels: List[str] = []
     if args.changed:
-        paths = _changed_files()
-        if not paths and not (args.audit or args.audit_write):
+        paths, missing, changed_rels = _changed_files()
+        if missing:
+            print(
+                "graftlint: skipping deleted/renamed path(s): "
+                + ", ".join(missing),
+                file=sys.stderr,
+            )
+        if not paths and not changed_rels and not aux_stage:
             print("graftlint: no changed files in scope", file=sys.stderr)
             return 0
+    elif paths:
+        missing = [p for p in paths if not os.path.isfile(p)]
+        if missing:
+            print(
+                "graftlint: skipping non-existent path(s): "
+                + ", ".join(missing),
+                file=sys.stderr,
+            )
+            paths = [p for p in paths if os.path.isfile(p)]
 
-    findings = lint_paths(paths or None, rules=rules)
+    # Explicit selections (--changed or path args) lint exactly what
+    # survived the existence filter — an empty selection lints nothing,
+    # never the whole tree.
+    explicit = args.changed or bool(args.paths)
+    if paths:
+        findings = lint_paths(paths, rules=rules)
+    elif explicit:
+        findings = []
+    else:
+        findings = lint_paths(None, rules=rules)
+
+    # Wire-contract stage: full runs always; --changed runs when any
+    # contract file (incl. the C++ sources) changed; explicit-path runs
+    # when a contract file was named; skipped when a --rules subset
+    # excludes both of its rule names.
+    contract_rules = {wire_contract.CONTRACT_RULE, wire_contract.PIN_RULE}
+    run_contract = rules is None or bool(contract_rules & set(rules))
+    if run_contract and args.changed:
+        run_contract = any(
+            rel in wire_contract.CONTRACT_FILES for rel in changed_rels
+        )
+    elif run_contract and args.paths:
+        named = {
+            os.path.relpath(os.path.abspath(p), REPO_ROOT).replace(
+                os.sep, "/"
+            )
+            for p in args.paths
+        }
+        run_contract = bool(named & set(wire_contract.CONTRACT_FILES))
+    if run_contract:
+        findings.extend(wire_contract.check())
+
     for f in findings:
         print(str(f))
     rc = 1 if findings else 0
 
     if args.audit or args.audit_write:
-        # The audit traces real entry points: pin the CPU mesh BEFORE
-        # jax is imported (the tests/conftest.py contract).
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            ).strip()
-        from tools.graftlint.jaxpr_audit import audit
+        _pin_jax_env()
+        if args.audit_write:
+            pin_findings = wire_contract.write_pin()
+            for f in pin_findings:
+                print(str(f))
+                rc = 1
+            if not pin_findings:
+                print("audit wire_contract: pin written", file=sys.stderr)
+        rc = max(rc, _run_audit(write=args.audit_write))
 
-        results = audit(write=args.audit_write)
-        for name, res in sorted(results.items()):
-            line = f"audit {name}: {res['status']}"
-            if res.get("cost"):
-                cost = res["cost"]
-                cols = []
-                if cost.get("flops") is not None:
-                    cols.append(f"flops={cost['flops']:.4g}")
-                if cost.get("peak_bytes") is not None:
-                    cols.append(f"peak_bytes={int(cost['peak_bytes']):,}")
-                if cols:
-                    line += " [cost " + " ".join(cols) + "]"
-            if res.get("detail"):
-                line += f" — {res['detail']}"
-            print(line, file=sys.stderr)
-            if res["status"] in ("mismatch", "error"):
-                rc = 1
-            if res["status"] == "unpinned":
-                print(
-                    f"audit {name}: no pin recorded; run with "
-                    "--audit-write to record it",
-                    file=sys.stderr,
-                )
-                rc = 1
+    if args.report_unverified:
+        _pin_jax_env()
+        rc = max(rc, _run_report_unverified())
+
+    if args.native:
+        native_rc, _detail = _run_native()
+        rc = max(rc, native_rc)
 
     n = len(findings)
     print(

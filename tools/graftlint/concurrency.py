@@ -1,0 +1,395 @@
+"""Async-concurrency AST rules (graftlint stage a', ISSUE 10).
+
+PR 8's asyncio comm layer (``comm/async_runtime.py``/``agent.py``/
+``master.py``) introduced failure classes the SPMD rules cannot see:
+
+* ``blocking-in-async`` — a synchronous blocking call (``time.sleep``,
+  a sync socket constructor, file IO, ``subprocess``,
+  ``block_until_ready``) inside an ``async def`` stalls the WHOLE event
+  loop: every coroutine sharing it (gossip dispatch, frame reads, the
+  master's round lifecycle) freezes for the call's duration.  The same
+  hazard exists in the registered *hot coroutines* — sync functions
+  that run inline on the loop between two awaits (the dispatch-loop
+  handlers of ``async_runtime.py``), listed per file in
+  ``extra_hot_coroutines`` (the ``extra_hot_functions`` shape).
+* ``unawaited-coroutine`` — calling a coroutine function and discarding
+  the result creates a coroutine object that never runs: the send/poke
+  silently does not happen and Python's "never awaited" warning only
+  fires at GC time, far from the bug.  Handing the coroutine to
+  ``asyncio.create_task``/``ensure_future``/``gather``/``wait`` is the
+  sanctioned fire-and-forget spelling and is allowed (the allowlist is
+  structural: only a *bare* coroutine call as an expression statement
+  fires).
+* ``task-shared-mutation`` — the async runtime runs REGISTERED task
+  groups (the round task driven by the caller's awaits; the detached
+  dispatch tasks spawned with ``ensure_future``) over shared
+  ``self.``-attributes.  A mutation of a guarded attribute from outside
+  its owning group is exactly where a lost-update/torn-read race hides
+  between two awaits.  Guarded attributes and group membership are
+  seeded from the ``shared_state`` annotation table below (same shape
+  as ``HostSyncInHotPath.extra_hot_functions``); a cross-group mutation
+  must carry a suppression whose reason names the FIFO/lock/turn
+  discipline that makes it safe.
+
+All three rules are ``requires_reason``: a bare suppression is itself a
+finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    register,
+)
+
+#: Calls that block the calling thread (and with it, the event loop).
+#: name -> why / what to use instead.
+_BLOCKING_CALLS: Dict[str, str] = {
+    "time.sleep": "use 'await asyncio.sleep(...)'",
+    "socket.socket": "use asyncio.open_connection / loop.sock_* APIs",
+    "socket.create_connection": "use asyncio.open_connection",
+    "socket.getaddrinfo": "use loop.getaddrinfo",
+    "socket.gethostbyname": "use loop.getaddrinfo",
+    "subprocess.run": "use asyncio.create_subprocess_exec",
+    "subprocess.call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_output": "use asyncio.create_subprocess_exec",
+    "os.system": "use asyncio.create_subprocess_shell",
+}
+
+#: File IO entry points: the builtin plus the pathlib one-shot readers
+#: (attribute calls, matched by method name on any receiver).
+_BLOCKING_IO_METHODS = frozenset(
+    {"read_text", "write_text", "read_bytes", "write_bytes"}
+)
+
+#: Awaitable-returning asyncio APIs whose bare call is a dropped
+#: coroutine/future even without a local ``async def`` to resolve.
+_ASYNCIO_COROUTINES = frozenset(
+    {
+        "asyncio.sleep",
+        "asyncio.wait",
+        "asyncio.wait_for",
+        "asyncio.gather",
+        "asyncio.open_connection",
+        "asyncio.start_server",
+    }
+)
+
+
+def _function_stack_walk(tree: ast.Module):
+    """Yield ``(node, enclosing_function_or_None)`` for every node, where
+    the enclosing function is the NEAREST FunctionDef/AsyncFunctionDef."""
+
+    def walk(node, fn):
+        for child in ast.iter_child_nodes(node):
+            child_fn = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_fn = child
+            yield child, child_fn
+            yield from walk(child, child_fn)
+
+    yield from walk(tree, None)
+
+
+@register
+class BlockingInAsync(Rule):
+    """No synchronous blocking calls inside async code: one ``time.sleep``
+    (or sync socket / file IO / ``block_until_ready``) in a coroutine
+    freezes every coroutine on the loop for its duration."""
+
+    name = "blocking-in-async"
+    requires_reason = True
+
+    #: Sync functions that run inline on the event loop (between two
+    #: awaits of the owning dispatch loop) and are therefore held to the
+    #: same no-blocking discipline as ``async def`` bodies — the
+    #: ``extra_hot_functions`` shape: relpath -> function names.
+    extra_hot_coroutines: Dict[str, frozenset] = {
+        "distributed_learning_tpu/comm/async_runtime.py": frozenset(
+            {
+                "_handle_peer_msg",
+                "_consume",
+                "_mix_plain",
+                "_needs_fresh",
+                "_needs_correction",
+            }
+        ),
+    }
+
+    def _sleep_aliases(self, ctx: FileContext) -> Set[str]:
+        out = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "sleep":
+                        out.add(a.asname or a.name)
+        return out
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        hot_names = self.extra_hot_coroutines.get(ctx.relpath, frozenset())
+        sleep_aliases = self._sleep_aliases(ctx)
+        out: List[Finding] = []
+
+        def hit(node: ast.Call, what: str, fix: str, fn_name: str):
+            out.append(
+                Finding(
+                    self.name,
+                    ctx.relpath,
+                    node.lineno,
+                    f"{what} inside '{fn_name}' blocks the event loop — "
+                    "every coroutine sharing it (gossip dispatch, frame "
+                    f"reads, round lifecycle) stalls with it; {fix}, or "
+                    "run it in an executor",
+                )
+            )
+
+        for node, fn in _function_stack_walk(ctx.tree):
+            if fn is None or not isinstance(node, ast.Call):
+                continue
+            is_async = isinstance(fn, ast.AsyncFunctionDef)
+            if not is_async and fn.name not in hot_names:
+                continue
+            kind = "async def" if is_async else "hot coroutine"
+            fn_label = f"{kind} {fn.name}"
+            name = dotted_name(node.func) or ""
+            if name in _BLOCKING_CALLS:
+                hit(node, f"{name}()", _BLOCKING_CALLS[name], fn_label)
+            elif name in sleep_aliases:
+                hit(node, f"{name}() (time.sleep)",
+                    _BLOCKING_CALLS["time.sleep"], fn_label)
+            elif name == "open":
+                hit(
+                    node, "open() (synchronous file IO)",
+                    "hoist the IO out of the loop", fn_label,
+                )
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr == "block_until_ready":
+                    hit(
+                        node, ".block_until_ready() (device sync)",
+                        "let the dispatch stay async; sync at a "
+                        "chunk boundary off the loop", fn_label,
+                    )
+                elif attr in _BLOCKING_IO_METHODS:
+                    hit(
+                        node, f".{attr}() (synchronous file IO)",
+                        "hoist the IO out of the loop", fn_label,
+                    )
+        return out
+
+
+@register
+class UnawaitedCoroutine(Rule):
+    """A coroutine call whose result is discarded never runs: the frame
+    is never sent, and CPython only warns at GC time.  Either ``await``
+    it or hand it to ``asyncio.create_task``/``ensure_future`` (the
+    structural allowlist: wrapped calls are not expression statements of
+    a bare coroutine, so they never fire)."""
+
+    name = "unawaited-coroutine"
+    requires_reason = True
+
+    @staticmethod
+    def _async_def_names(tree: ast.Module) -> Set[str]:
+        """Names that UNAMBIGUOUSLY resolve to an ``async def`` in this
+        file: a name also bound by a plain ``def`` (e.g. a nested
+        ``async def main`` next to a module-level ``def main``) is
+        ambiguous at AST level and skipped — conservative by design."""
+        async_names = {
+            n.name
+            for n in ast.walk(tree)
+            if isinstance(n, ast.AsyncFunctionDef)
+        }
+        sync_names = {
+            n.name
+            for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)
+        }
+        return async_names - sync_names
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        local_coros = self._async_def_names(ctx.tree)
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Expr) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            call = node.value
+            name = dotted_name(call.func) or ""
+            coro: Optional[str] = None
+            if name in _ASYNCIO_COROUTINES:
+                coro = name
+            elif name in local_coros:
+                coro = name
+            elif isinstance(call.func, ast.Attribute) and isinstance(
+                call.func.value, ast.Name
+            ):
+                recv, attr = call.func.value.id, call.func.attr
+                if recv in ("self", "cls") and attr in local_coros:
+                    coro = f"{recv}.{attr}"
+            if coro is None:
+                continue
+            out.append(
+                Finding(
+                    self.name,
+                    ctx.relpath,
+                    node.lineno,
+                    f"coroutine call '{coro}(...)' is discarded — it "
+                    "never runs (CPython warns only at GC time, far "
+                    "from here): 'await' it, or schedule it with "
+                    "asyncio.create_task(...)/ensure_future(...)",
+                )
+            )
+        return out
+
+
+#: self.attr method calls that mutate the receiver in place.
+_MUTATING_METHODS = frozenset(
+    {
+        "append", "appendleft", "add", "discard", "remove", "clear",
+        "pop", "popleft", "update", "extend", "insert", "setdefault",
+        "sort",
+    }
+)
+
+
+@register
+class TaskSharedMutation(Rule):
+    """Guarded shared ``self.``-attributes may only be mutated by their
+    owning task group; a cross-group mutation is where a lost update
+    hides between two awaits.  Seeded from the ``shared_state``
+    annotation table (relpath -> {"groups": {fn: group}, "attrs":
+    {attr: owning group}}); a legitimate cross-group mutation carries a
+    suppression whose reason names the FIFO/lock/turn discipline that
+    serializes it."""
+
+    name = "task-shared-mutation"
+    requires_reason = True
+
+    #: Annotation table, the ``extra_hot_functions`` shape.  Groups for
+    #: ``async_runtime.py``: "round" is the round task (the caller's
+    #: awaits drive begin/collect/mix/finish), "dispatch" is the receive
+    #: path — the master/peer handlers and the detached ensure_future'd
+    #: poke answers that run between any two of the round task's awaits.
+    shared_state: Dict[str, Dict[str, Dict[str, str]]] = {
+        "distributed_learning_tpu/comm/async_runtime.py": {
+            "groups": {
+                "begin_round": "round",
+                "finish_round": "round",
+                "run_async_round": "round",
+                "run_async_choco": "round",
+                "_collect": "round",
+                "_collect_choco": "round",
+                "_consume": "round",
+                "_mix_plain": "round",
+                "_push": "round",
+                "_poke": "round",
+                "_recv_step": "round",
+                "_handle_master": "dispatch",
+                "_handle_peer_msg": "dispatch",
+                "_answer_poke": "dispatch",
+            },
+            "attrs": {
+                # The published double buffer: written by the round
+                # task, read by the detached _answer_poke task.
+                "_pub_value": "round",
+                "_pub_round": "round",
+                "_round": "round",
+                "last_stats": "round",
+                # Poke bookkeeping: set by the round task on a staleness
+                # excursion, cleared by the dispatch path on arrival.
+                "_poked": "round",
+                # Inbox map: rounds consume, dispatch fills/evicts.
+                "_inbox": "round",
+            },
+        },
+    }
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        """'x' for ``self.x`` / ``self.x[...]`` targets, else None."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _mutations(self, fn: ast.AST) -> List[Tuple[str, int]]:
+        """(attr, line) for every ``self.<attr>`` mutation inside fn:
+        assignments (incl. tuple targets and subscripts), augmented
+        assignments, ``del``, and in-place mutating method calls."""
+        out: List[Tuple[str, int]] = []
+
+        def add_target(tgt: ast.AST, line: int):
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    add_target(el, line)
+                return
+            attr = self._self_attr(tgt)
+            if attr is not None:
+                out.append((attr, line))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    add_target(tgt, node.lineno)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                add_target(node.target, node.lineno)
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    add_target(tgt, node.lineno)
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr in _MUTATING_METHODS:
+                    attr = self._self_attr(node.func.value)
+                    if attr is not None:
+                        out.append((attr, node.lineno))
+        return out
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        table = self.shared_state.get(ctx.relpath)
+        if not table:
+            return []
+        groups = table.get("groups", {})
+        attrs = table.get("attrs", {})
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            group = groups.get(node.name)
+            if group is None:  # unregistered (e.g. __init__): not a task
+                continue
+            for attr, line in self._mutations(node):
+                owner = attrs.get(attr)
+                if owner is None or owner == group:
+                    continue
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.relpath,
+                        line,
+                        f"'{node.name}' (task group '{group}') mutates "
+                        f"self.{attr}, owned by group '{owner}': a "
+                        "cross-group write races the owner between two "
+                        "awaits — route it through the owner's "
+                        "FIFO/lock discipline, or suppress with a "
+                        "reason naming the discipline that serializes "
+                        "this line",
+                    )
+                )
+        return out

@@ -75,11 +75,16 @@ class Suppressions:
 
     A comment sharing a line with code covers that line; a comment alone
     on its line covers the next line (the ``disable-next-line``
-    convention, without needing a second spelling).
+    convention, without needing a second spelling).  An own-line comment
+    directly above a DECORATOR chain attaches across it to the ``def``
+    line below (single-line decorators only: a decorator whose argument
+    list spans lines breaks the chain) — the flagged node of a decorated
+    function reports at its ``def`` line, not the decorator's.
     """
 
     def __init__(self, source: str):
         self.by_line: Dict[int, Suppression] = {}
+        lines = source.splitlines()
         try:
             tokens = tokenize.generate_tokens(io.StringIO(source).readline)
             for tok in tokens:
@@ -94,6 +99,12 @@ class Suppressions:
                 sup = Suppression(rules, m.group("reason"), tok.start[0])
                 own_line = tok.line[: tok.start[1]].strip() == ""
                 target = tok.start[0] + 1 if own_line else tok.start[0]
+                while (
+                    own_line
+                    and target <= len(lines)
+                    and lines[target - 1].lstrip().startswith("@")
+                ):
+                    target += 1
                 self.by_line[target] = sup
         except tokenize.TokenError:
             pass  # syntactically broken file: other tooling will complain
@@ -146,6 +157,11 @@ class Rule:
     name: str = ""
     #: a suppression for this rule must carry ``-- <reason>`` text
     requires_reason: bool = False
+    #: which lint stage produces this rule's findings ("ast" rules run
+    #: per-file; "wire-contract" findings come from the cross-language
+    #: stage in ``wire_contract.py``, where inline suppressions do not
+    #: apply).
+    stage: str = "ast"
 
     def check(self, ctx: FileContext) -> List[Finding]:
         raise NotImplementedError

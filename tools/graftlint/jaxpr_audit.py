@@ -562,6 +562,92 @@ def load_expected(path: str = EXPECTED_PATH) -> Dict[str, dict]:
         return json.load(fh)
 
 
+def _live_provenance() -> str:
+    import jax
+
+    return f"live jaxpr/HLO trace (jax {jax.__version__})"
+
+
+#: Default provenance for pins recorded before provenance tracking.
+_UNRECORDED_PROVENANCE = (
+    "unrecorded (pin predates provenance tracking; see the entry's "
+    "docstring in jaxpr_audit.py for the program contract it encodes)"
+)
+
+
+def report_unverified(
+    expected_path: str = EXPECTED_PATH, reverify: bool = True
+) -> Dict[str, dict]:
+    """The ``--report-unverified`` mode: every ``verified: false``
+    shim-pinned entry with its pin provenance, plus — when the running
+    jax exposes the features the entry needs (``jax.shard_map``) — a
+    live re-verify of the pinned inventory.
+
+    Returns {entry: {"kind", "inventory", "provenance", "reverify"}}
+    where ``reverify`` is one of ``"ok: ..."`` (live trace matches the
+    pin), ``"MISMATCH: ..."`` (it does not — fix or repin), or
+    ``"skipped: ..."`` (environment still lacks the feature, or the
+    entry is no longer registered).  Reporting only: flipping
+    ``verified`` (and repinning a mismatch) stays an ``--audit-write``
+    action, so this mode never touches the pin file.
+    """
+    expected = load_expected(expected_path) if os.path.exists(
+        expected_path
+    ) else {}
+    out: Dict[str, dict] = {}
+    for name in sorted(expected):
+        entry = expected[name]
+        if not isinstance(entry, dict) or entry.get("kind") not in (
+            "jaxpr", "hlo"
+        ):
+            continue  # e.g. the wire_contract pin: not a trace entry
+        if entry.get("verified", True):
+            continue
+        info = {
+            "kind": entry.get("kind"),
+            "inventory": entry.get("inventory", {}),
+            "provenance": entry.get("provenance", _UNRECORDED_PROVENANCE),
+        }
+        ep = ENTRY_POINTS.get(name)
+        if ep is None:
+            info["reverify"] = (
+                "skipped: entry point no longer registered in "
+                "jaxpr_audit.py (stale pin?)"
+            )
+        elif not reverify:
+            info["reverify"] = "skipped: re-verify disabled"
+        else:
+            missing = ep.missing_features()
+            if missing:
+                info["reverify"] = (
+                    "skipped: environment still lacks jax feature(s): "
+                    + ", ".join(missing)
+                )
+            else:
+                try:
+                    observed = _encode(ep.build())
+                except Exception as exc:
+                    info["reverify"] = (
+                        f"MISMATCH: live trace failed — "
+                        f"{type(exc).__name__}: {exc}"
+                    )
+                else:
+                    if observed == entry.get("inventory"):
+                        info["reverify"] = (
+                            "ok: live inventory matches the pin — "
+                            "acknowledge with --audit-write to mark it "
+                            "verified"
+                        )
+                    else:
+                        info["reverify"] = (
+                            f"MISMATCH: live inventory {observed} != "
+                            f"pin {entry.get('inventory')} — fix the "
+                            "program or repin with --audit-write"
+                        )
+        out[name] = info
+    return out
+
+
 def audit(
     names: Optional[List[str]] = None,
     write: bool = False,
@@ -618,6 +704,7 @@ def audit(
                 "kind": ep.kind,
                 "inventory": observed,
                 "verified": True,
+                "provenance": _live_provenance(),
             }
             if observed_cost:
                 expected[name]["cost"] = {

@@ -1,0 +1,687 @@
+"""Wire-contract stage (graftlint stage b', ISSUE 10): Python<->C++
+drift checker for the hand-maintained wire constants.
+
+PR 9's native wire engine (``native/wire.cpp``) re-states the frame
+format owned by the Python authorities — ``comm/tensor_codec.py``
+(fused magic/version, dtype codes, flags), ``comm/protocol.py``
+(message TYPE_CODEs), ``comm/framing.py`` (transport header, wire
+version, frame cap), ``native/wire.py`` (modes, status codes) and
+``native/__init__.py``/``native/dlt_abi.h`` (ABI version) — as
+``constexpr`` constants.  Nothing ties the two sides together at build
+time (the .so compiles per box at first use), so a one-sided edit is a
+SILENT format drift: the native encoder keeps producing frames the
+Python oracle calls corrupt, or worse, frames that parse into the wrong
+layout.
+
+This stage parses BOTH sides statically — regex over the C++ (no
+compiler needed), ``ast`` over the Python (no imports) — and fails lint
+unless every shared constant matches exactly:
+
+* fused-frame magic/version bytes, per-bucket value-section widths
+  (``vlen_of`` vs the ``encode_tensor`` header layout), frame-header
+  and trailing-crc widths;
+* dtype codes, compression flags, wire modes, decoder status codes;
+* the crc polynomial (``wire.cpp`` vs ``codec.cpp``);
+* the ABI version (``dlt_abi.h`` vs ``native/__init__.py``);
+* transport framing header/version/cap and message TYPE_CODEs
+  (Python-only authorities, guarded against silent renumbering by the
+  pin below).
+
+The merged contract is additionally PINNED in ``audit_expected.json``
+(key ``wire_contract``, next to the collective pins): an intentional
+bump — a new message code, a frame-version rev, an ABI bump — changes
+both sides consistently and then goes through
+``python -m tools.graftlint --audit-write`` exactly like a collective
+repin.  A pin mismatch with AGREEING sides means "intentional change,
+not yet acknowledged"; a cross-language mismatch means "bug, fix the
+lagging side".
+
+Findings carry rule names ``wire-contract-drift`` (cross-language or
+extraction failure) and ``wire-contract-pin`` (pin drift/unpinned);
+both are registered so ``--rules``/``--list-rules`` know them, but they
+are produced by this stage, not per-file AST checks (inline
+suppressions do not apply — the fix is always to align the sides or
+repin).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from tools.graftlint.core import REPO_ROOT, Finding, Rule, register
+from tools.graftlint.jaxpr_audit import EXPECTED_PATH
+
+CONTRACT_RULE = "wire-contract-drift"
+PIN_RULE = "wire-contract-pin"
+
+#: Repo-relative files the stage reads; a --changed run that touched any
+#: of them re-runs the stage.
+CONTRACT_FILES = (
+    "distributed_learning_tpu/native/wire.cpp",
+    "distributed_learning_tpu/native/codec.cpp",
+    "distributed_learning_tpu/native/dlt_abi.h",
+    "distributed_learning_tpu/native/wire.py",
+    "distributed_learning_tpu/native/__init__.py",
+    "distributed_learning_tpu/comm/tensor_codec.py",
+    "distributed_learning_tpu/comm/protocol.py",
+    "distributed_learning_tpu/comm/framing.py",
+)
+
+
+@register
+class WireContractDrift(Rule):
+    """C++ wire constants must exactly match the Python authorities."""
+
+    name = CONTRACT_RULE
+    stage = "wire-contract"
+
+    def check(self, ctx) -> List[Finding]:  # stage-level, not per-file
+        return []
+
+
+@register
+class WireContractPin(Rule):
+    """The merged wire contract must match its audit_expected.json pin."""
+
+    name = PIN_RULE
+    stage = "wire-contract"
+
+    def check(self, ctx) -> List[Finding]:  # stage-level, not per-file
+        return []
+
+
+# --------------------------------------------------------------------- #
+# Extraction helpers                                                    #
+# --------------------------------------------------------------------- #
+def _read(repo_root: str, rel: str) -> Tuple[str, str]:
+    path = os.path.join(repo_root, rel)
+    with open(path, "r", encoding="utf-8") as fh:
+        return fh.read(), rel
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _to_int(tok: str) -> int:
+    tok = tok.rstrip("uUlL")
+    return int(tok, 0)
+
+
+class _Extract:
+    """Accumulates the contract dict and extraction-failure findings."""
+
+    def __init__(self):
+        self.findings: List[Finding] = []
+
+    def fail(self, rel: str, line: int, msg: str):
+        self.findings.append(Finding(CONTRACT_RULE, rel, line, msg))
+
+
+_CONSTEXPR_RE = re.compile(
+    r"constexpr\s+(?:long long|uint8_t|uint16_t|uint32_t|int)\s+"
+    r"(k\w+)\s*=\s*(-?(?:0[xX][0-9a-fA-F]+|\d+))[uU]?;"
+)
+_CRC_POLY_RE = re.compile(
+    r"\?\s*(0[xX][0-9a-fA-F]+)[uU]?\s*\^\s*\(c >> 1\)"
+)
+_VLEN_BF16_RE = re.compile(r"case kModeBf16:\s*return (\d+) \+ (\d+) \* k;")
+_VLEN_I8_RE = re.compile(r"case kModeI8:\s*return (\d+) \+ k;")
+_VLEN_F32_RE = re.compile(r"default:\s*return (\d+) \+ (\d+) \* k;")
+_FRAME_HDR_RE = re.compile(r"size = (\d+);\s*//\s*frame header")
+_TRAIL_CRC_RE = re.compile(r"size \+ (\d+)\);\s*//\s*\+ trailing crc")
+_ABI_DEFINE_RE = re.compile(r"#define\s+DLT_ABI_VERSION\s+(\d+)[uU]?")
+
+
+def _cpp_side(repo_root: str, ex: _Extract) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    wire_src, wire_rel = _read(repo_root, CONTRACT_FILES[0])
+    codec_src, codec_rel = _read(repo_root, CONTRACT_FILES[1])
+    abi_src, abi_rel = _read(repo_root, CONTRACT_FILES[2])
+
+    consts: Dict[str, Tuple[int, int]] = {}  # name -> (value, line)
+    for m in _CONSTEXPR_RE.finditer(wire_src):
+        consts[m.group(1)] = (_to_int(m.group(2)), _line_of(wire_src, m.start()))
+    out["consts"] = consts
+    out["wire_rel"] = wire_rel
+
+    m = _ABI_DEFINE_RE.search(abi_src)
+    if m is None:
+        ex.fail(abi_rel, 1, "DLT_ABI_VERSION #define not found")
+    else:
+        out["abi_version"] = (_to_int(m.group(1)), _line_of(abi_src, m.start()))
+    out["abi_rel"] = abi_rel
+
+    polys = []
+    for src, rel in ((wire_src, wire_rel), (codec_src, codec_rel)):
+        m = _CRC_POLY_RE.search(src)
+        if m is None:
+            ex.fail(rel, 1, "crc table-generator polynomial not found "
+                            "(expected '... ? 0x... ^ (c >> 1)')")
+        else:
+            polys.append((rel, _to_int(m.group(1)), _line_of(src, m.start())))
+    out["crc_polys"] = polys
+
+    vlen: Dict[str, Tuple[int, int]] = {}
+    m = _VLEN_BF16_RE.search(wire_src)
+    if m:
+        vlen["bf16"] = (int(m.group(1)), int(m.group(2)))
+    m = _VLEN_I8_RE.search(wire_src)
+    if m:
+        vlen["i8"] = (int(m.group(1)), 1)
+    m = _VLEN_F32_RE.search(wire_src)
+    if m:
+        vlen["f32"] = (int(m.group(1)), int(m.group(2)))
+    if len(vlen) != 3:
+        ex.fail(
+            wire_rel, 1,
+            "vlen_of() value-section widths not all extracted "
+            f"(got {sorted(vlen)}); keep the switch's literal "
+            "'return BASE + ELEM * k' shape",
+        )
+    out["vlen"] = vlen
+
+    m = _FRAME_HDR_RE.search(wire_src)
+    out["frame_header"] = int(m.group(1)) if m else None
+    if m is None:
+        ex.fail(wire_rel, 1,
+                "fused frame-header width ('size = N;  // frame header') "
+                "not found")
+    m = _TRAIL_CRC_RE.search(wire_src)
+    out["trailing_crc"] = int(m.group(1)) if m else None
+    if m is None:
+        ex.fail(wire_rel, 1,
+                "trailing crc width ('size + N);  // + trailing crc') "
+                "not found")
+    return out
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """Fold the constant-integer expressions the authorities use
+    (plain literals, unary minus, and ``1 << 31``-style BinOps)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = _const_int(node.left), _const_int(node.right)
+        if lhs is None or rhs is None:
+            return None
+        if isinstance(node.op, ast.LShift):
+            return lhs << rhs
+        if isinstance(node.op, ast.Add):
+            return lhs + rhs
+        if isinstance(node.op, ast.Sub):
+            return lhs - rhs
+        if isinstance(node.op, ast.Mult):
+            return lhs * rhs
+    return None
+
+
+def _module_int_consts(tree: ast.Module) -> Dict[str, Tuple[int, int]]:
+    """name -> (value, line) for top-level integer assignments, including
+    tuple assignments (``MODE_F32, MODE_BF16, MODE_I8 = 0, 1, 2``)."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            v = _const_int(node.value) if node.value is not None else None
+            if v is not None:
+                out[node.target.id] = (v, node.lineno)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    v = _const_int(node.value)
+                    if v is not None:
+                        out[tgt.id] = (v, node.lineno)
+                elif isinstance(tgt, ast.Tuple) and isinstance(
+                    node.value, ast.Tuple
+                ) and len(tgt.elts) == len(node.value.elts):
+                    for el, val in zip(tgt.elts, node.value.elts):
+                        v = _const_int(val)
+                        if isinstance(el, ast.Name) and v is not None:
+                            out[el.id] = (v, node.lineno)
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _dtype_codes(tree: ast.Module, rel: str, ex: _Extract) -> Dict[str, int]:
+    """``_DTYPE_CODES`` keys (``np.dtype(np.float32)`` -> "float32") to
+    their integer codes."""
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "_DTYPE_CODES"
+            and isinstance(node.value, ast.Dict)
+        ):
+            continue
+        out: Dict[str, int] = {}
+        for key, val in zip(node.value.keys, node.value.values):
+            code = _const_int(val)
+            name = None
+            if isinstance(key, ast.Call) and key.args:
+                name = _dotted(key.args[0]).split(".")[-1].rstrip("_")
+            if name and code is not None:
+                out[name] = code
+        return out
+    ex.fail(rel, 1, "_DTYPE_CODES dict not found in tensor_codec.py")
+    return {}
+
+
+def _fused_header_fmt(tree: ast.Module) -> Optional[str]:
+    """The struct format of the fused frame header: the ``struct.pack``
+    whose argument list leads with ``_FUSED_MAGIC``."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        if not _dotted(node.func).endswith("pack"):
+            continue
+        fmt = node.args[0]
+        if (
+            isinstance(fmt, ast.Constant)
+            and isinstance(fmt.value, str)
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Name)
+            and node.args[1].id == "_FUSED_MAGIC"
+        ):
+            return fmt.value
+    return None
+
+
+def _dense_header_base(tree: ast.Module) -> Optional[int]:
+    """Byte width of ``encode_tensor``'s header for a 1-D tensor, parsed
+    from its f-string pack format (``f"<BBBB{x.ndim}I"`` -> "<BBBB1I").
+    This is the base of every fused value section."""
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.FunctionDef) and node.name == "encode_tensor"
+        ):
+            continue
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call) and sub.args):
+                continue
+            if not _dotted(sub.func).endswith("pack"):
+                continue
+            fmt = sub.args[0]
+            if isinstance(fmt, ast.JoinedStr):
+                parts = []
+                for v in fmt.values:
+                    if isinstance(v, ast.Constant):
+                        parts.append(str(v.value))
+                    else:
+                        parts.append("1")  # ndim = 1 for value sections
+                try:
+                    return struct.calcsize("".join(parts))
+                except struct.error:
+                    return None
+    return None
+
+
+def _framing_header_fmt(tree: ast.Module) -> Optional[str]:
+    """The transport header format: ``_HEADER = struct.Struct("<...")``."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "_HEADER"
+            and isinstance(node.value, ast.Call)
+            and node.value.args
+            and isinstance(node.value.args[0], ast.Constant)
+        ):
+            return node.value.args[0].value
+    return None
+
+
+def _type_codes(tree: ast.Module) -> Dict[str, Tuple[int, int]]:
+    """class name -> (TYPE_CODE, line) for protocol.py message classes
+    (negative sentinel codes excluded)."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            target = None
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                target = stmt.target.id
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+                isinstance(stmt.targets[0], ast.Name)
+            ):
+                target = stmt.targets[0].id
+            if target != "TYPE_CODE" or stmt.value is None:
+                continue
+            code = _const_int(stmt.value)
+            if code is not None and code >= 0:
+                out[node.name] = (code, stmt.lineno)
+    return out
+
+
+def _py_side(repo_root: str, ex: _Extract) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    wire_py_src, wire_py_rel = _read(repo_root, CONTRACT_FILES[3])
+    native_init_src, native_init_rel = _read(repo_root, CONTRACT_FILES[4])
+    tc_src, tc_rel = _read(repo_root, CONTRACT_FILES[5])
+    proto_src, proto_rel = _read(repo_root, CONTRACT_FILES[6])
+    framing_src, framing_rel = _read(repo_root, CONTRACT_FILES[7])
+
+    wire_py = ast.parse(wire_py_src)
+    native_init = ast.parse(native_init_src)
+    tc = ast.parse(tc_src)
+    proto = ast.parse(proto_src)
+    framing = ast.parse(framing_src)
+
+    out["wire_py"] = _module_int_consts(wire_py)
+    out["wire_py_rel"] = wire_py_rel
+    out["native_init"] = _module_int_consts(native_init)
+    out["native_init_rel"] = native_init_rel
+    out["tc"] = _module_int_consts(tc)
+    out["tc_rel"] = tc_rel
+    out["dtype_codes"] = _dtype_codes(tc, tc_rel, ex)
+    out["fused_header_fmt"] = _fused_header_fmt(tc)
+    if out["fused_header_fmt"] is None:
+        ex.fail(tc_rel, 1,
+                "fused header struct.pack(_FUSED_MAGIC, ...) not found")
+    out["dense_header_base"] = _dense_header_base(tc)
+    if out["dense_header_base"] is None:
+        ex.fail(tc_rel, 1,
+                "encode_tensor header f-string pack format not found")
+    out["framing"] = _module_int_consts(framing)
+    out["framing_rel"] = framing_rel
+    out["framing_header_fmt"] = _framing_header_fmt(framing)
+    if out["framing_header_fmt"] is None:
+        ex.fail(framing_rel, 1, '_HEADER = struct.Struct("<...") not found')
+    out["type_codes"] = _type_codes(proto)
+    out["proto_rel"] = proto_rel
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Cross-language checks + contract assembly                             #
+# --------------------------------------------------------------------- #
+#: (cpp constant, python module key, python constant) pairs that must
+#: match exactly.  "tc" = tensor_codec, "wire_py" = native/wire.py.
+_PAIRS = (
+    ("kFusedMagic", "tc", "_FUSED_MAGIC"),
+    ("kFusedVersion", "tc", "_FUSED_VERSION"),
+    ("kFlagBf16", "tc", "FLAG_BF16_COMPRESSED"),
+    ("kFlagI8", "tc", "FLAG_INT8_COMPRESSED"),
+    ("kModeF32", "wire_py", "MODE_F32"),
+    ("kModeBf16", "wire_py", "MODE_BF16"),
+    ("kModeI8", "wire_py", "MODE_I8"),
+    ("kErrTrunc", "wire_py", "ERR_TRUNC"),
+    ("kErrMagic", "wire_py", "ERR_MAGIC"),
+    ("kErrVersion", "wire_py", "ERR_VERSION"),
+    ("kErrCrc", "wire_py", "ERR_CRC"),
+    ("kErrBounds", "wire_py", "ERR_BOUNDS"),
+    ("kErrRange", "wire_py", "ERR_RANGE"),
+    ("kErrTotal", "wire_py", "ERR_TOTAL"),
+    ("kErrUnsupported", "wire_py", "ERR_UNSUPPORTED"),
+    ("kErrNonFinite", "wire_py", "ERR_NONFINITE"),
+    ("kErrInternal", "wire_py", "ERR_INTERNAL"),
+)
+
+#: cpp dtype-code constant -> _DTYPE_CODES key (numpy dtype basename).
+_DTYPE_PAIRS = (
+    ("kDtypeF32", "float32"),
+    ("kDtypeBf16", "uint16"),
+    ("kDtypeI8", "int8"),
+)
+
+
+def extract(repo_root: str = REPO_ROOT) -> Tuple[dict, List[Finding]]:
+    """Parse both sides; return (contract, cross-language findings).
+
+    The contract is assembled from whichever side parses even when the
+    other drifts, so pin comparison still reports usefully.
+    """
+    ex = _Extract()
+    try:
+        cpp = _cpp_side(repo_root, ex)
+        py = _py_side(repo_root, ex)
+    except OSError as exc:
+        ex.fail("tools/graftlint/wire_contract.py", 1,
+                f"contract file unreadable: {exc}")
+        return {}, ex.findings
+
+    consts: Dict[str, Tuple[int, int]] = cpp["consts"]
+    wire_rel = cpp["wire_rel"]
+
+    def cpp_val(name: str) -> Optional[int]:
+        ent = consts.get(name)
+        if ent is None:
+            ex.fail(wire_rel, 1,
+                    f"constexpr {name} not found in wire.cpp")
+            return None
+        return ent[0]
+
+    def cpp_line(name: str) -> int:
+        ent = consts.get(name)
+        return ent[1] if ent else 1
+
+    # Named constant pairs.
+    for cname, mod, pname in _PAIRS:
+        table: Dict[str, Tuple[int, int]] = py[mod]
+        rel = py[f"{mod}_rel"]
+        cv = cpp_val(cname)
+        ent = table.get(pname)
+        if ent is None:
+            ex.fail(rel, 1, f"python authority constant {pname} not found")
+            continue
+        if cv is not None and cv != ent[0]:
+            ex.fail(
+                wire_rel, cpp_line(cname),
+                f"{cname} = {cv} in wire.cpp but the python authority "
+                f"{rel} has {pname} = {ent[0]} (line {ent[1]}): "
+                "one-sided edit — align both sides, then repin with "
+                "--audit-write",
+            )
+
+    # Dtype codes against the _DTYPE_CODES table.
+    dtype_codes: Dict[str, int] = py["dtype_codes"]
+    for cname, dtype in _DTYPE_PAIRS:
+        cv = cpp_val(cname)
+        pv = dtype_codes.get(dtype)
+        if pv is None:
+            ex.fail(py["tc_rel"], 1,
+                    f"_DTYPE_CODES has no entry for {dtype}")
+        elif cv is not None and cv != pv:
+            ex.fail(
+                wire_rel, cpp_line(cname),
+                f"{cname} = {cv} in wire.cpp but "
+                f"_DTYPE_CODES[np.{dtype}] = {pv} in tensor_codec.py",
+            )
+
+    # ABI version: dlt_abi.h vs native/__init__.py.
+    abi_cpp = cpp.get("abi_version")
+    abi_py = py["native_init"].get("_ABI_VERSION")
+    if abi_py is None:
+        ex.fail(py["native_init_rel"], 1, "_ABI_VERSION not found")
+    if abi_cpp is not None and abi_py is not None and (
+        abi_cpp[0] != abi_py[0]
+    ):
+        ex.fail(
+            cpp["abi_rel"], abi_cpp[1],
+            f"DLT_ABI_VERSION = {abi_cpp[0]} in dlt_abi.h but "
+            f"native/__init__.py checks _ABI_VERSION = {abi_py[0]}: "
+            "every cached .so would force-rebuild (or serve stale) — "
+            "bump both together",
+        )
+
+    # crc polynomial agreement across the two C++ files.
+    polys = cpp["crc_polys"]
+    if len({p[1] for p in polys}) > 1:
+        detail = ", ".join(f"{rel}:{line} has {val:#010x}"
+                           for rel, val, line in polys)
+        ex.fail(
+            polys[0][0], polys[0][2],
+            f"crc polynomial disagreement between the native sources "
+            f"({detail}): frames crc'd by one library fail the other's "
+            "check",
+        )
+
+    # Value-section widths: vlen_of vs the encode_tensor header layout.
+    base = py["dense_header_base"]
+    expected_vlen = None
+    if base is not None:
+        # int8 sections carry the struct.pack('<f', scale) prefix.
+        expected_vlen = {
+            "f32": (base, 4), "bf16": (base, 2), "i8": (base + 4, 1),
+        }
+        for mode, widths in sorted(cpp["vlen"].items()):
+            want = expected_vlen[mode]
+            if tuple(widths) != want:
+                ex.fail(
+                    wire_rel, 1,
+                    f"vlen_of({mode}) is {widths[0]} + {widths[1]}*k in "
+                    f"wire.cpp but encode_tensor's header layout implies "
+                    f"{want[0]} + {want[1]}*k: the native encoder would "
+                    "mis-place every value section",
+                )
+
+    # Fused header width: python "<BBBBI" vs wire.cpp's size = 8.
+    fmt = py["fused_header_fmt"]
+    if fmt is not None and cpp["frame_header"] is not None:
+        if struct.calcsize(fmt) != cpp["frame_header"]:
+            ex.fail(
+                wire_rel, 1,
+                f"fused frame header is {cpp['frame_header']} bytes in "
+                f"wire.cpp but struct format {fmt!r} "
+                f"({struct.calcsize(fmt)} bytes) in tensor_codec.py",
+            )
+
+    # Assemble the merged contract (pinned in audit_expected.json).
+    contract: Dict[str, object] = {}
+    if abi_py is not None:
+        contract["abi_version"] = abi_py[0]
+    if polys:
+        contract["crc_poly"] = f"{polys[0][1]:#010x}"
+    for key, cname in (
+        ("fused_magic", "kFusedMagic"), ("fused_version", "kFusedVersion"),
+    ):
+        if cname in consts:
+            contract[key] = consts[cname][0]
+    contract["dtype_codes"] = dict(sorted(dtype_codes.items()))
+    contract["flags"] = {
+        "bf16": py["tc"].get("FLAG_BF16_COMPRESSED", (None,))[0],
+        "int8": py["tc"].get("FLAG_INT8_COMPRESSED", (None,))[0],
+    }
+    contract["modes"] = {
+        "f32": py["wire_py"].get("MODE_F32", (None,))[0],
+        "bf16": py["wire_py"].get("MODE_BF16", (None,))[0],
+        "i8": py["wire_py"].get("MODE_I8", (None,))[0],
+    }
+    contract["status_codes"] = {
+        name: val for name, (val, _line) in sorted(py["wire_py"].items())
+        if name.startswith("ERR_")
+    }
+    if expected_vlen is not None:
+        contract["vlen"] = {
+            k: list(v) for k, v in sorted(expected_vlen.items())
+        }
+    if cpp["frame_header"] is not None:
+        contract["fused_header_bytes"] = cpp["frame_header"]
+    if cpp["trailing_crc"] is not None:
+        contract["trailing_crc_bytes"] = cpp["trailing_crc"]
+    if py["framing_header_fmt"] is not None:
+        contract["framing_header"] = py["framing_header_fmt"]
+        contract["framing_header_bytes"] = struct.calcsize(
+            py["framing_header_fmt"]
+        )
+    for key, pname in (
+        ("wire_version", "WIRE_VERSION"), ("max_frame", "MAX_FRAME"),
+    ):
+        ent = py["framing"].get(pname)
+        if ent is None:
+            ex.fail(py["framing_rel"], 1, f"{pname} not found in framing.py")
+        else:
+            contract[key] = ent[0]
+    ent = py["tc"].get("_MAX_NDIM")
+    if ent is not None:
+        contract["max_ndim"] = ent[0]
+    contract["type_codes"] = {
+        name: code for name, (code, _line) in sorted(py["type_codes"].items())
+    }
+    return contract, ex.findings
+
+
+def check(
+    repo_root: str = REPO_ROOT, expected_path: str = EXPECTED_PATH
+) -> List[Finding]:
+    """Run the stage: cross-language drift findings plus the pin check."""
+    contract, findings = extract(repo_root)
+    pin_rel = os.path.relpath(expected_path, repo_root).replace(os.sep, "/")
+    expected = {}
+    if os.path.exists(expected_path):
+        with open(expected_path, "r", encoding="utf-8") as fh:
+            expected = json.load(fh)
+    pinned = expected.get("wire_contract", {}).get("contract")
+    if pinned is None:
+        findings.append(
+            Finding(
+                PIN_RULE, pin_rel, 1,
+                "wire contract has no pin recorded; run "
+                "'python -m tools.graftlint --audit-write' to record it",
+            )
+        )
+        return findings
+    if contract and pinned != contract:
+        gone = {k: v for k, v in pinned.items() if contract.get(k) != v}
+        new = {k: v for k, v in contract.items() if pinned.get(k) != v}
+        findings.append(
+            Finding(
+                PIN_RULE, pin_rel, 1,
+                f"wire contract drifted from its pin: expected "
+                f"{json.dumps(gone, sort_keys=True)} but observed "
+                f"{json.dumps(new, sort_keys=True)} — if the bump is "
+                "intentional (both sides already agree), acknowledge it "
+                "with 'python -m tools.graftlint --audit-write'",
+            )
+        )
+    return findings
+
+
+def write_pin(
+    repo_root: str = REPO_ROOT, expected_path: str = EXPECTED_PATH
+) -> List[Finding]:
+    """Record the observed contract as the pin (the --audit-write path).
+    Cross-language drift still fails: a pin must never freeze a
+    disagreement between the two sides."""
+    contract, findings = extract(repo_root)
+    if findings:
+        return findings
+    expected = {}
+    if os.path.exists(expected_path):
+        with open(expected_path, "r", encoding="utf-8") as fh:
+            expected = json.load(fh)
+    expected["wire_contract"] = {
+        "kind": "wire-contract",
+        "contract": contract,
+        "verified": True,
+        "provenance": "static extraction from the contract files "
+        "(tools/graftlint/wire_contract.py); both sides agreed at pin "
+        "time",
+    }
+    with open(expected_path, "w", encoding="utf-8") as fh:
+        json.dump(expected, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return []

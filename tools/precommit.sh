@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Pre-commit gate: the jax-free graftlint stages (AST rules + the
+# Python<->C++ wire-contract check when a contract file changed) over
+# exactly the files modified vs. HEAD.  Deleted/renamed paths are
+# skipped with a notice; a clean tree exits 0 in well under a second.
+#
+# Install as a git hook:
+#   ln -s ../../tools/precommit.sh .git/hooks/pre-commit
+# or run directly: bash tools/precommit.sh
+#
+# The jaxpr audit (--audit) and the sanitizer replay (--native) are NOT
+# run here — they need jax / a toolchain and belong to tier-1 and CI,
+# not the commit hot path (docs/static_analysis.md §Stages).
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+exec python -m tools.graftlint --changed
