@@ -282,7 +282,7 @@ def make_pipeline_apply(
         # graftlint: disable=raw-collective-in-shard-map -- stage-aux exit: total the per-stage aux over stages (bubble ticks already masked)
         aux = lax.psum(aux_acc, stage_axis) / (S * M)
         for ax in extra_manual_axes:
-            # graftlint: disable=raw-collective-in-shard-map -- pp x sp aux: per-shard mean convention (training/spmd_lm.py)
+            # graftlint: disable=raw-collective-in-shard-map -- aux-mean statistic (pp x sp): per-shard mean convention (training/spmd_lm.py)
             aux = lax.pmean(aux, ax)
         return outs, aux
 
@@ -565,13 +565,13 @@ def make_1f1b_train_step(
         # pvaries its params opts out of that; total its partials here.
         for ax in extra_manual_axes:
             gacc = jax.tree.map(
-                # graftlint: disable=raw-collective-in-shard-map -- pp x sp opt-out total: explicitly pvaried param partials summed over the extra axis (cotangent-psum done by hand)
+                # graftlint: disable=raw-collective-in-shard-map -- gacc exit (pp x sp opt-out): explicitly pvaried param partials summed over the extra axis (cotangent-psum done by hand)
                 lambda g: lax.psum(g, ax)
                 if ax in getattr(jax.typeof(g), "vma", ()) else g,
                 gacc,
             )
             hacc = jax.tree.map(
-                # graftlint: disable=raw-collective-in-shard-map -- pp x sp opt-out total: head-grad partials summed over the extra axis, same rule as gacc
+                # graftlint: disable=raw-collective-in-shard-map -- head-grad exit (pp x sp opt-out): partials summed over the extra axis, same rule as gacc
                 lambda h: lax.psum(h, ax)
                 if ax in getattr(jax.typeof(h), "vma", ()) else h,
                 hacc,
@@ -583,7 +583,7 @@ def make_1f1b_train_step(
             # graftlint: disable=raw-collective-in-shard-map -- stage-aux exit: total over stages (masked bubble ticks), as in make_pipeline_apply
             aux = lax.psum(aacc, stage_axis) / (S * M)
             for ax in extra_manual_axes:
-                # graftlint: disable=raw-collective-in-shard-map -- pp x sp aux: per-shard mean convention (training/spmd_lm.py)
+                # graftlint: disable=raw-collective-in-shard-map -- aux-mean statistic (pp x sp): per-shard mean convention (training/spmd_lm.py)
                 aux = lax.pmean(aux, ax)
             loss = loss + stage_aux_coef * aux
         outs = [grads]

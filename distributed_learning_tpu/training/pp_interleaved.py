@@ -611,13 +611,13 @@ def make_interleaved_1f1b_train_step(
         # partials a pvarying stage_fn left unreduced over the extras.
         for ax in extra_manual_axes:
             gacc = jax.tree.map(
-                # graftlint: disable=raw-collective-in-shard-map -- pp x sp opt-out total: explicitly pvaried param partials summed over the extra axis (see pp.py)
+                # graftlint: disable=raw-collective-in-shard-map -- gacc exit (pp x sp opt-out): explicitly pvaried param partials summed over the extra axis (see pp.py)
                 lambda g: lax.psum(g, ax)
                 if ax in getattr(jax.typeof(g), "vma", ()) else g,
                 gacc,
             )
             hacc = jax.tree.map(
-                # graftlint: disable=raw-collective-in-shard-map -- pp x sp opt-out total: head-grad partials summed over the extra axis, same rule as gacc
+                # graftlint: disable=raw-collective-in-shard-map -- head-grad exit (pp x sp opt-out): partials summed over the extra axis, same rule as gacc
                 lambda h: lax.psum(h, ax)
                 if ax in getattr(jax.typeof(h), "vma", ()) else h,
                 hacc,
@@ -629,7 +629,7 @@ def make_interleaved_1f1b_train_step(
             # graftlint: disable=raw-collective-in-shard-map -- stage-aux exit: total over stages (masked bubble ticks), pp.py convention
             aux = lax.psum(aacc, stage_axis) / (SV * M)
             for ax in extra_manual_axes:
-                # graftlint: disable=raw-collective-in-shard-map -- pp x sp aux: per-shard mean convention (training/spmd_lm.py)
+                # graftlint: disable=raw-collective-in-shard-map -- aux-mean statistic (pp x sp): per-shard mean convention (training/spmd_lm.py)
                 aux = lax.pmean(aux, ax)
             loss = loss + stage_aux_coef * aux
         outs = [grads]

@@ -394,7 +394,7 @@ class _LMParts:
         :meth:`head_loss` on a 1D stage mesh."""
         loss = self.head_loss(head_params, out, y_mb)
         if self.sp:
-            # graftlint: disable=raw-collective-in-shard-map -- pp x sp head contract: the loss must END reduced over seq so the scalar is sequence-invariant (pp.head_seed docstring)
+            # graftlint: disable=raw-collective-in-shard-map -- head-loss exit (pp x sp contract): the loss must END reduced over seq so the scalar is sequence-invariant (pp.head_seed docstring)
             loss = lax.pmean(loss, self.seq_axis)
         return loss
 

@@ -599,14 +599,19 @@ def test_cli_rejects_unknown_rule():
 GOLDEN_RULES = [
     "banned-import",
     "blocking-in-async",
+    "branch-divergent-collective",
+    "collective-order-drift",
+    "donation-alias",
     "host-sync-in-hot-path",
     "no-pickle",
     "no-print-in-library",
     "raw-collective-in-shard-map",
     "reference-citation",
     "stdout-contract",
+    "suppression-claim",
     "task-shared-mutation",
     "unawaited-coroutine",
+    "vma-discipline",
     "wallclock-duration",
     "wire-code-unique",
     "wire-contract-drift",
@@ -634,12 +639,12 @@ def test_cli_list_rules_json_golden():
         r["name"] for r in payload["rules"] if r["requires_reason"]
     ] == GOLDEN_REQUIRES_REASON
     assert payload["stages"] == [
-        "ast", "wire-contract", "audit", "native-san"
+        "ast", "wire-contract", "audit", "dataflow", "native-san"
     ]
     assert "disable=<rule>" in payload["suppression"]
     for r in payload["rules"]:
         assert r["summary"], f"rule {r['name']} has no docstring summary"
-        assert r["stage"] in ("ast", "wire-contract")
+        assert r["stage"] in ("ast", "wire-contract", "dataflow")
     # The human docs must mention every registered rule.
     doc = open(os.path.join(REPO_ROOT, "docs", "static_analysis.md")).read()
     missing = [r for r in GOLDEN_RULES if f"`{r}`" not in doc]

@@ -212,3 +212,41 @@ def test_intentional_bump_goes_through_audit_write(contract_tree):
     assert [f.rule for f in fs] == [wc.PIN_RULE]
     assert wc.write_pin(repo_root=root, expected_path=expected) == []
     assert wc.check(repo_root=root, expected_path=expected) == []
+
+
+# --------------------------------------------------------------------- #
+# obs-delta payload surface (ISSUE 12): authority obs/aggregate.py,     #
+# declared wire surface via the comm/protocol.py re-export             #
+# --------------------------------------------------------------------- #
+def test_real_tree_pins_the_obs_payload_surface():
+    contract, findings = wc.extract()
+    assert findings == [], [str(f) for f in findings]
+    assert contract["obs_payload"] == {"kind": "obs.delta", "version": 1}
+
+
+def test_obs_version_bump_fails_the_pin(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/obs/aggregate.py",
+        r"OBS_PAYLOAD_VERSION = 1", "OBS_PAYLOAD_VERSION = 2",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    pin = [f for f in fs if f.rule == wc.PIN_RULE]
+    assert pin, [str(f) for f in fs]
+    assert "obs_payload" in pin[0].message
+
+
+def test_dropping_the_obs_reexport_is_a_drift(contract_tree):
+    """protocol.py restating (or losing) the constants instead of
+    re-exporting the single authority must fail: the payload schema is
+    wire surface only through obs.aggregate."""
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/comm/protocol.py",
+        r"    OBS_PAYLOAD_VERSION,\n", "",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift, [str(f) for f in fs]
+    assert "re-export" in drift[0].message
+    assert drift[0].path.endswith("protocol.py")

@@ -1,7 +1,7 @@
 """graftlint: static analysis enforcing this repo's SPMD, wire-format,
 concurrency, and dependency invariants.
 
-Four stages (full reference: ``docs/static_analysis.md``):
+Five stages (full reference: ``docs/static_analysis.md``):
 
 * AST (``rules.py`` + ``concurrency.py``): pluggable source rules over
   ``distributed_learning_tpu/``, ``benchmarks/``, ``examples/`` and
@@ -14,6 +14,11 @@ Four stages (full reference: ``docs/static_analysis.md``):
 * jaxpr/HLO audit (``jaxpr_audit.py``, ``--audit``): traces the
   registered SPMD entry points on the 8-virtual-device CPU mesh and
   pins their collective inventories (+ cost columns).
+* Dataflow verify (``jaxpr_verify.py`` + ``claims.py``, ``--audit``):
+  branch-uniform collective sequences, ordered scan/while pins,
+  suppression-claim verification against the traced program, vma
+  discipline, and donation aliasing; the suppression inventory itself
+  is jax-free (``--suppressions``).
 * Sanitizer replay (``native_san.py``, ``--native``): rebuilds the
   native libs under ASan/UBSan into a separate cache and replays the
   wire fuzz corpus + oracle matrix; any report fails lint.
@@ -21,7 +26,7 @@ Four stages (full reference: ``docs/static_analysis.md``):
 CLI: ``python -m tools.graftlint`` (see ``--help``); pre-commit gate:
 ``tools/precommit.sh``; tier-1 coverage: ``tests/test_graftlint.py``,
 ``tests/test_graftlint_concurrency.py``, ``tests/test_wire_contract.py``,
-``tests/test_native_san.py``.
+``tests/test_native_san.py``, ``tests/test_jaxpr_verify.py``.
 """
 
 from tools.graftlint.core import (  # noqa: F401
@@ -39,3 +44,5 @@ from tools.graftlint.core import (  # noqa: F401
 )
 import tools.graftlint.rules  # noqa: F401  (registers the rule set)
 import tools.graftlint.concurrency  # noqa: F401  (async-concurrency rules)
+import tools.graftlint.jaxpr_verify  # noqa: F401  (dataflow-stage rules;
+#   the module import is jax-free — tracing stays behind --audit)

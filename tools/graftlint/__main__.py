@@ -1,16 +1,25 @@
 """CLI: ``python -m tools.graftlint [paths...]``.
 
 Exits non-zero when any unsuppressed finding (or audit/contract/
-sanitizer mismatch) survives.  Four stages:
+sanitizer mismatch) survives.  Five stages:
 
 * **AST rules** (always): import no jax — safe to run bare.
 * **Wire contract** (always on full/--changed runs touching the
   contract files): Python<->C++ drift check + pin, also jax-free.
 * **jaxpr/HLO audit** (``--audit``): sets ``JAX_PLATFORMS=cpu`` and the
   8-virtual-device flag itself *before* jax is first imported.
+* **Dataflow verify** (``--audit``, after the inventory audit): branch
+  uniformity, ordered collective sequences, suppression-claim checks,
+  vma discipline, and donation aliasing (``jaxpr_verify.py``).
 * **Sanitizer replay** (``--native``): rebuilds both native libs under
   ASan/UBSan into a separate cache and replays the wire fuzz corpus +
   oracle matrix; skips with a notice when the toolchain is absent.
+
+``--entry <name>`` (repeatable, with ``--audit``/``--audit-write``/
+``--report-unverified``) restricts the trace stages to the named entry
+points — single-entry repins without re-tracing the whole registry.
+``--suppressions [--json]`` prints the inline-disable inventory (rule,
+reason, file:line, parsed claim) without importing jax.
 
 Pre-commit usage: ``python -m tools.graftlint --changed`` (or
 ``tools/precommit.sh``) lints only files modified vs. HEAD (plus
@@ -88,7 +97,10 @@ def _list_rules(as_json: bool) -> int:
         json.dumps(
             {
                 "rules": rules,
-                "stages": ["ast", "wire-contract", "audit", "native-san"],
+                "stages": [
+                    "ast", "wire-contract", "audit", "dataflow",
+                    "native-san",
+                ],
                 "suppression":
                     "# graftlint: disable=<rule>[,<rule>] -- <reason>",
             },
@@ -110,11 +122,54 @@ def _pin_jax_env() -> None:
         ).strip()
 
 
-def _run_audit(write: bool) -> int:
+def _run_suppressions(as_json: bool) -> int:
+    """The --suppressions inventory report (jax-free)."""
+    from tools.graftlint import claims as claims_mod
+
+    records = claims_mod.inventory()
+    if as_json:
+        payload = []
+        for r in records:
+            claim = None
+            if r.claim is not None:
+                claim = {"kind": r.claim.kind, "axis": r.claim.axis}
+            payload.append(
+                {
+                    "path": r.path,
+                    "line": r.line,
+                    "comment_line": r.comment_line,
+                    "rules": list(r.rules),
+                    "reason": r.reason,
+                    "claim": claim,
+                }
+            )
+        print(json.dumps({"suppressions": payload}, indent=2,
+                         sort_keys=True))
+        return 0
+    for r in records:
+        rules = ",".join(r.rules)
+        line = f"{r.path}:{r.line}: {rules}"
+        if r.claim is not None:
+            line += f" [claim: {r.claim.kind}"
+            if r.claim.axis:
+                line += f" over {r.claim.axis}"
+            line += "]"
+        if r.reason:
+            line += f" -- {r.reason}"
+        print(line)
+    n = len(records)
+    print(
+        f"graftlint: {n} suppression{'s' if n != 1 else ''}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def _run_audit(write: bool, names=None) -> int:
     from tools.graftlint.jaxpr_audit import audit
 
     rc = 0
-    results = audit(write=write)
+    results = audit(names=names, write=write)
     for name, res in sorted(results.items()):
         line = f"audit {name}: {res['status']}"
         if res.get("cost"):
@@ -141,11 +196,50 @@ def _run_audit(write: bool) -> int:
     return rc
 
 
-def _run_report_unverified() -> int:
+def _run_verify(write: bool, names=None) -> int:
+    """The dataflow stage (jaxpr_verify.py), run after the inventory
+    audit under --audit."""
+    from tools.graftlint.jaxpr_verify import verify
+
+    results, findings, claim_summary = verify(names=names, write=write)
+    rc = 0
+    for f in findings:
+        print(str(f))
+        rc = 1
+    for name, res in sorted(results.items()):
+        line = f"verify {name}: {res['status']}"
+        if res.get("detail"):
+            line += f" — {res['detail']}"
+        print(line, file=sys.stderr)
+        if res["status"] in ("mismatch", "error"):
+            rc = 1
+        if res["status"] == "unpinned":
+            print(
+                f"verify {name}: no dataflow pin recorded; run with "
+                "--audit-write to record it",
+                file=sys.stderr,
+            )
+            rc = 1
+    cs = claim_summary
+    print(
+        "verify claims: "
+        f"{cs['verified']} verified, {cs['untraceable']} untraceable, "
+        f"{cs['unparseable']} unparseable, "
+        f"{cs['contradicted']} contradicted",
+        file=sys.stderr,
+    )
+    for d in cs["details"]:
+        print(f"verify claims: {d}", file=sys.stderr)
+    return rc
+
+
+def _run_report_unverified(names=None) -> int:
     from tools.graftlint.jaxpr_audit import report_unverified
 
     rc = 0
     report = report_unverified()
+    if names is not None:
+        report = {k: v for k, v in report.items() if k in names}
     if not report:
         print("report-unverified: every pinned entry is verified")
         return 0
@@ -172,9 +266,10 @@ def _run_native() -> Tuple[int, List[str]]:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="AST + wire-contract + jaxpr + sanitizer static "
-        "analysis for this repo's SPMD, wire-format, concurrency, and "
-        "dependency invariants (docs/static_analysis.md).",
+        description="AST + wire-contract + jaxpr audit + dataflow "
+        "verify + sanitizer static analysis for this repo's SPMD, "
+        "wire-format, concurrency, and dependency invariants "
+        "(docs/static_analysis.md).",
     )
     ap.add_argument("paths", nargs="*",
                     help="files to lint (default: %s)"
@@ -194,6 +289,16 @@ def main(argv=None) -> int:
                     help="regenerate audit_expected.json (collective "
                     "inventories AND the wire-contract pin) from the "
                     "observed state (implies --audit)")
+    ap.add_argument("--entry", action="append", default=None,
+                    metavar="NAME",
+                    help="with --audit/--audit-write/"
+                    "--report-unverified: restrict the trace stages to "
+                    "the named entry point (repeatable); the wire "
+                    "contract pin is left untouched under a filter")
+    ap.add_argument("--suppressions", action="store_true",
+                    help="print the inline-suppression inventory "
+                    "(rule, reason, file:line, parsed claim) and exit; "
+                    "imports no jax")
     ap.add_argument("--report-unverified", action="store_true",
                     help="list every verified:false shim-pinned audit "
                     "entry with its provenance, and try a live "
@@ -206,6 +311,32 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         return _list_rules(args.json)
+
+    if args.suppressions:
+        return _run_suppressions(args.json)
+
+    entry_names = None
+    if args.entry:
+        from tools.graftlint.jaxpr_audit import ENTRY_POINTS
+
+        unknown = [n for n in args.entry if n not in ENTRY_POINTS]
+        if unknown:
+            print(
+                f"unknown entry point(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(ENTRY_POINTS))})",
+                file=sys.stderr,
+            )
+            return 2
+        if not (
+            args.audit or args.audit_write or args.report_unverified
+        ):
+            print(
+                "--entry needs --audit, --audit-write, or "
+                "--report-unverified",
+                file=sys.stderr,
+            )
+            return 2
+        entry_names = list(dict.fromkeys(args.entry))
 
     rules = None
     if args.rules:
@@ -281,18 +412,27 @@ def main(argv=None) -> int:
 
     if args.audit or args.audit_write:
         _pin_jax_env()
-        if args.audit_write:
+        if args.audit_write and entry_names is None:
             pin_findings = wire_contract.write_pin()
             for f in pin_findings:
                 print(str(f))
                 rc = 1
             if not pin_findings:
                 print("audit wire_contract: pin written", file=sys.stderr)
-        rc = max(rc, _run_audit(write=args.audit_write))
+        elif args.audit_write:
+            print(
+                "audit wire_contract: pin left untouched "
+                "(--entry filter)",
+                file=sys.stderr,
+            )
+        rc = max(rc, _run_audit(write=args.audit_write,
+                                names=entry_names))
+        rc = max(rc, _run_verify(write=args.audit_write,
+                                 names=entry_names))
 
     if args.report_unverified:
         _pin_jax_env()
-        rc = max(rc, _run_report_unverified())
+        rc = max(rc, _run_report_unverified(names=entry_names))
 
     if args.native:
         native_rc, _detail = _run_native()

@@ -1,0 +1,169 @@
+"""Suppression inventory + the raw-collective claim taxonomy.
+
+Every reasoned ``# graftlint: disable=...`` comment is a *claim* about
+the suppressed line; for ``raw-collective-in-shard-map`` the reason
+must name the SPMD invariant the raw collective implements (core.py's
+``requires_reason`` contract).  This module makes that debt machine
+readable:
+
+* :func:`inventory` walks the scanned roots and lists every inline
+  disable (rule set, reason, file:line) — the ``--suppressions``
+  report and the dataflow verifier's input surface.
+* :func:`parse_claim` maps a raw-collective reason onto the small
+  claim taxonomy the verifier can check against the traced program
+  (docs/static_analysis.md §Stage 5):
+
+  - ``vma-cast`` — the line is a ``pvary``/``pcast(..., to="varying")``
+    bookkeeping cast, not traffic (the training/pp.py head_seed
+    pcast-before-local-cotangent rule).  Keyed on "vma cast"/"pcast".
+  - ``statistic`` — the collective's reduction IS the quantity being
+    computed (a residual, telemetry mean, mixing fixed point), not a
+    sharded-compute exit.  Keyed on "statistic", "telemetry",
+    "fixed point", "by definition", "update rule", "IS the".
+  - ``exit`` — a Megatron-style f/g exit: partial results totaled at
+    a region boundary, the psum result flowing to a region output
+    that is axis-invariant after it (training/tp.py NOTE).  Keyed on
+    "exit".
+
+  ``vma-cast`` is matched first (a cast reason may mention the
+  cotangent rule), then ``statistic`` (several statistic reasons say
+  "not a TP exit"), then ``exit``.  The claimed axis is read from an
+  ``... over <axis>`` phrase when present; a token that is not a real
+  mesh-axis name at trace time (e.g. a variable like ``tp_axis``)
+  stays symbolic and is never checked against the wrong axis.
+
+This module imports no jax — it is part of the bare-run-safe surface
+(``--suppressions`` works on a box with no accelerator stack at all).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from tools.graftlint.core import (
+    DEFAULT_ROOTS,
+    REPO_ROOT,
+    Suppressions,
+    iter_python_files,
+)
+
+#: The rule whose suppression reasons carry checkable program claims.
+RAW_COLLECTIVE_RULE = "raw-collective-in-shard-map"
+
+_VMA_CAST_RE = re.compile(
+    r"\bvma[ -]cast\b|\bpcast\b|to=.varying", re.IGNORECASE
+)
+_STATISTIC_RE = re.compile(
+    r"\bstatistics?\b|\btelemetry\b|\bby definition\b|\bfixed point\b"
+    r"|\bupdate rule\b|\bIS the\b"
+)
+_EXIT_RE = re.compile(r"\bexits?\b", re.IGNORECASE)
+#: "... psum over (the) agents (axis)" -> claimed axis token "agents".
+_AXIS_RE = re.compile(r"\bover (?:the )?([A-Za-z_][A-Za-z0-9_]*)")
+
+#: Tokens _AXIS_RE can catch that are prose, never an axis name.
+_AXIS_STOPWORDS = frozenset(
+    {"a", "an", "all", "both", "each", "it", "its", "the", "them", "this"}
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Claim:
+    """A parsed raw-collective suppression reason."""
+
+    kind: str  # "exit" | "vma-cast" | "statistic"
+    #: axis token from an "over <axis>" phrase, or None.  Symbolic until
+    #: the verifier sees it among the traced mesh axes.
+    axis: Optional[str]
+
+
+def parse_claim(reason: Optional[str]) -> Optional[Claim]:
+    """Map a suppression reason onto the claim taxonomy (None when the
+    reason names no recognizable invariant — reported, never passed)."""
+    if not reason:
+        return None
+    if _VMA_CAST_RE.search(reason):
+        kind = "vma-cast"
+    elif _STATISTIC_RE.search(reason):
+        kind = "statistic"
+    elif _EXIT_RE.search(reason):
+        kind = "exit"
+    else:
+        return None
+    axis = None
+    m = _AXIS_RE.search(reason)
+    if m and m.group(1) not in _AXIS_STOPWORDS:
+        axis = m.group(1)
+    return Claim(kind=kind, axis=axis)
+
+
+@dataclasses.dataclass(frozen=True)
+class SuppressionRecord:
+    """One inline disable: where it sits and what it claims."""
+
+    path: str  # repo-relative
+    line: int  # the CODE line the suppression covers
+    comment_line: int  # where the comment itself sits
+    rules: Tuple[str, ...]
+    reason: Optional[str]
+    #: parsed claim when the record covers RAW_COLLECTIVE_RULE (None
+    #: for other rules, and for unparseable raw-collective reasons).
+    claim: Optional[Claim]
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+def inventory(
+    paths: Optional[Sequence[str]] = None,
+    repo_root: str = REPO_ROOT,
+    roots: Sequence[str] = DEFAULT_ROOTS,
+) -> List[SuppressionRecord]:
+    """Every inline suppression under the scanned roots (or the given
+    files), sorted by (path, line)."""
+    files = list(paths) if paths else iter_python_files(
+        roots=roots, repo_root=repo_root
+    )
+    out: List[SuppressionRecord] = []
+    for path in files:
+        rel = os.path.relpath(os.path.abspath(path), repo_root).replace(
+            os.sep, "/"
+        )
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        sups = Suppressions(source)
+        for target_line, sup in sorted(sups.by_line.items()):
+            claim = (
+                parse_claim(sup.reason)
+                if RAW_COLLECTIVE_RULE in sup.rules
+                else None
+            )
+            out.append(
+                SuppressionRecord(
+                    path=rel,
+                    line=target_line,
+                    comment_line=sup.comment_line,
+                    rules=tuple(sorted(sup.rules)),
+                    reason=sup.reason,
+                    claim=claim,
+                )
+            )
+    return sorted(out, key=lambda r: (r.path, r.line))
+
+
+def raw_collective_records(
+    repo_root: str = REPO_ROOT,
+) -> List[SuppressionRecord]:
+    """The subset of :func:`inventory` carrying raw-collective claims."""
+    return [
+        r
+        for r in inventory(repo_root=repo_root)
+        if RAW_COLLECTIVE_RULE in r.rules
+    ]

@@ -148,7 +148,10 @@ COST_RTOL = 0.05
 class EntryPoint:
     def __init__(self, name: str, kind: str, requires: Tuple[str, ...],
                  build: Callable[[], Counter],
-                 cost_build: Optional[Callable[[], dict]] = None):
+                 cost_build: Optional[Callable[[], dict]] = None,
+                 trace_build: Optional[Callable[[], object]] = None,
+                 donate_build: Optional[
+                     Callable[[], Tuple[str, int]]] = None):
         self.name = name
         self.kind = kind  # "jaxpr" | "hlo"
         self.requires = requires
@@ -157,6 +160,14 @@ class EntryPoint:
         #: COMPILED entry point (obs/cost.py extraction); shares the
         #: entry's feature requirements.
         self.cost_build = cost_build
+        #: optional () -> ClosedJaxpr: the SAME trace the inventory
+        #: builder counts, exposed whole so the dataflow verifier
+        #: (jaxpr_verify.py) walks one program, not a re-trace.
+        self.trace_build = trace_build
+        #: optional () -> (lowered_text, n_state_leaves) for the
+        #: donation-alias lint: the entry lowered with
+        #: donate_argnums=(0,) (tests/test_trainer.py guard).
+        self.donate_build = donate_build
 
     def missing_features(self) -> List[str]:
         feats = _features()
@@ -179,6 +190,29 @@ def cost_entry(name: str):
 
     def deco(fn):
         ENTRY_POINTS[name].cost_build = fn
+        return fn
+
+    return deco
+
+
+def trace_entry(name: str):
+    """Attach a jaxpr trace builder to an already-registered entry
+    point (the dataflow verifier's input; shares the entry's feature
+    requirements)."""
+
+    def deco(fn):
+        ENTRY_POINTS[name].trace_build = fn
+        return fn
+
+    return deco
+
+
+def donate_entry(name: str):
+    """Attach a donation-lowering builder (() -> (lowered_text,
+    n_state_leaves)) to an already-registered entry point."""
+
+    def deco(fn):
+        ENTRY_POINTS[name].donate_build = fn
         return fn
 
     return deco
@@ -265,6 +299,12 @@ def _pp_1f1b_head_fn() -> Counter:
     transpose hazard motivated the audit — an implicit invariant->
     varying cast inside the head vjp would add a psum over the stage
     axis to this inventory."""
+    return collect_collectives(_pp_1f1b_head_fn_trace().jaxpr)
+
+
+@trace_entry("pp_1f1b_head_fn")
+@functools.lru_cache(maxsize=1)
+def _pp_1f1b_head_fn_trace():
     import jax
     import jax.numpy as jnp
 
@@ -289,8 +329,7 @@ def _pp_1f1b_head_fn() -> Counter:
     )
     mbs = jax.random.normal(key, (M, MB, D), jnp.float32)
     labels = jnp.zeros((M, MB, 1), jnp.float32)
-    jx = jax.make_jaxpr(step)(stage_params, head_params, mbs, labels)
-    return collect_collectives(jx.jaxpr)
+    return jax.make_jaxpr(step)(stage_params, head_params, mbs, labels)
 
 
 def _mix_until_fixture():
@@ -329,11 +368,16 @@ def _consensus_mix_until() -> Counter:
     (8 ppermutes, 8 psums); a pin drift back to leaf-proportional counts
     means the fused layout silently stopped engaging.
     """
+    return collect_collectives(_consensus_mix_until_trace().jaxpr)
+
+
+@trace_entry("consensus_mix_until")
+@functools.lru_cache(maxsize=1)
+def _consensus_mix_until_trace():
     import jax
 
     fn, x = _mix_until_fixture()
-    jx = jax.make_jaxpr(fn)(x)
-    return collect_collectives(jx.jaxpr)
+    return jax.make_jaxpr(fn)(x)
 
 
 @cost_entry("consensus_mix_until")
@@ -344,6 +388,73 @@ def _consensus_mix_until_cost() -> dict:
 
     fn, x = _mix_until_fixture()
     return _compiled_cost(jax.jit(fn).lower(x).compile())
+
+
+@functools.lru_cache(maxsize=2)
+def _superstep_fixture(sharded: bool):
+    """(trainer, superstep_args, k) for the K-epoch superstep — ONE
+    fixture shared by the inventory, cost, dataflow-trace, and
+    donation builders (it was previously duplicated per builder).
+    ``sharded=True`` is the ring(8) agent-mesh program (needs
+    jax.shard_map); ``sharded=False`` is the dense (mesh=None) trainer
+    on 3 nodes, traceable on any jax — the dataflow stage's live
+    entry on 0.4.x environments."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_tpu.parallel.consensus import make_agent_mesh
+    from distributed_learning_tpu.parallel.topology import Topology
+    from distributed_learning_tpu.training.trainer import GossipTrainer
+
+    n, k = (8, 3) if sharded else (3, 2)
+    rng = np.random.default_rng(0)
+    train = {
+        i: (
+            rng.normal(size=(32, 6)).astype(np.float32),
+            rng.integers(0, 3, size=(32,)).astype(np.int32),
+        )
+        for i in range(n)
+    }
+    tr = GossipTrainer(
+        node_names=list(range(n)),
+        model="mlp",
+        model_kwargs={"hidden_dim": 8, "output_dim": 3},
+        weights=Topology.ring(n),
+        train_data=train,
+        batch_size=8,
+        epoch_len=2,
+        mix_times=2,
+        dropout=False,
+        mesh=make_agent_mesh(n) if sharded else None,
+        superstep=k,
+    )
+    tr.initialize_nodes()
+    idx = tr._superstep_indices(0, k)
+    modes = jnp.asarray(
+        [tr._epoch_mode(j) for j in range(k)], dtype=jnp.int32
+    )
+    args = (tr.state, tr._Xs, tr._ys, idx, modes)
+    return tr, args, k
+
+
+def _superstep_trace(sharded: bool):
+    import jax
+
+    tr, args, k = _superstep_fixture(sharded)
+    return jax.make_jaxpr(tr._make_superstep_fn(k))(*args)
+
+
+@functools.lru_cache(maxsize=2)
+def _superstep_donation(sharded: bool) -> Tuple[str, int]:
+    """(lowered_text, n_state_leaves) of the superstep under
+    donate_argnums=(0,) — the tests/test_trainer.py donation-guard
+    lowering, shared with the dataflow stage's donation-alias lint."""
+    import jax
+
+    tr, args, k = _superstep_fixture(sharded)
+    fn = tr._make_superstep_fn(k)
+    lowered = jax.jit(fn, donate_argnums=(0,)).lower(*args)
+    return lowered.as_text(), len(jax.tree_util.tree_leaves(tr.state))
 
 
 @entry("gossip_superstep", kind="jaxpr", requires=("shard_map",))
@@ -361,44 +472,18 @@ def _gossip_superstep() -> Counter:
     collective OUTSIDE the scan means it was hoisted — either fails
     tier-1 with the op and axis named.
     """
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    return collect_collectives(_gossip_superstep_trace().jaxpr)
 
-    from distributed_learning_tpu.parallel.consensus import make_agent_mesh
-    from distributed_learning_tpu.parallel.topology import Topology
-    from distributed_learning_tpu.training.trainer import GossipTrainer
 
-    n, k = 8, 3
-    rng = np.random.default_rng(0)
-    train = {
-        i: (
-            rng.normal(size=(32, 6)).astype(np.float32),
-            rng.integers(0, 3, size=(32,)).astype(np.int32),
-        )
-        for i in range(n)
-    }
-    tr = GossipTrainer(
-        node_names=list(range(n)),
-        model="mlp",
-        model_kwargs={"hidden_dim": 8, "output_dim": 3},
-        weights=Topology.ring(n),
-        train_data=train,
-        batch_size=8,
-        epoch_len=2,
-        mix_times=2,
-        dropout=False,
-        mesh=make_agent_mesh(n),
-        superstep=k,
-    )
-    tr.initialize_nodes()
-    idx = tr._superstep_indices(0, k)
-    modes = jnp.asarray(
-        [tr._epoch_mode(j) for j in range(k)], dtype=jnp.int32
-    )
-    fn = tr._make_superstep_fn(k)
-    jx = jax.make_jaxpr(fn)(tr.state, tr._Xs, tr._ys, idx, modes)
-    return collect_collectives(jx.jaxpr)
+@trace_entry("gossip_superstep")
+@functools.lru_cache(maxsize=1)
+def _gossip_superstep_trace():
+    return _superstep_trace(True)
+
+
+@donate_entry("gossip_superstep")
+def _gossip_superstep_donate() -> Tuple[str, int]:
+    return _superstep_donation(True)
 
 
 @cost_entry("gossip_superstep")
@@ -407,35 +492,7 @@ def _gossip_superstep_cost() -> dict:
     ``cost_profile(k)`` extraction on the same fixture the inventory
     pin traces — a fusion regression that re-dispatches per epoch
     leaves the collectives flat but moves these numbers."""
-    import numpy as np
-
-    from distributed_learning_tpu.parallel.consensus import make_agent_mesh
-    from distributed_learning_tpu.parallel.topology import Topology
-    from distributed_learning_tpu.training.trainer import GossipTrainer
-
-    n, k = 8, 3
-    rng = np.random.default_rng(0)
-    train = {
-        i: (
-            rng.normal(size=(32, 6)).astype(np.float32),
-            rng.integers(0, 3, size=(32,)).astype(np.int32),
-        )
-        for i in range(n)
-    }
-    tr = GossipTrainer(
-        node_names=list(range(n)),
-        model="mlp",
-        model_kwargs={"hidden_dim": 8, "output_dim": 3},
-        weights=Topology.ring(n),
-        train_data=train,
-        batch_size=8,
-        epoch_len=2,
-        mix_times=2,
-        dropout=False,
-        mesh=make_agent_mesh(n),
-        superstep=k,
-    )
-    tr.initialize_nodes()
+    tr, _args, k = _superstep_fixture(True)
     prof = tr.cost_profile(k)
     out = {}
     if prof.flops is not None:
@@ -443,6 +500,28 @@ def _gossip_superstep_cost() -> dict:
     if prof.peak_bytes is not None:
         out["peak_bytes"] = int(prof.peak_bytes)
     return out
+
+
+@entry("gossip_superstep_dense", kind="jaxpr")
+def _gossip_superstep_dense() -> Counter:
+    """The SAME superstep program on the dense (mesh=None) 3-node
+    trainer: no mesh, no collectives — the inventory pins empty, and
+    the entry exists so the dataflow stage (branch structure of the
+    mode switch, scan ordering, donation aliasing) has a live trace on
+    every environment, including jax 0.4.x where the shard_map entries
+    skip."""
+    return collect_collectives(_gossip_superstep_dense_trace().jaxpr)
+
+
+@trace_entry("gossip_superstep_dense")
+@functools.lru_cache(maxsize=1)
+def _gossip_superstep_dense_trace():
+    return _superstep_trace(False)
+
+
+@donate_entry("gossip_superstep_dense")
+def _gossip_superstep_dense_donate() -> Tuple[str, int]:
+    return _superstep_donation(False)
 
 
 @entry("choco_run_fused", kind="jaxpr", requires=("shard_map",))
@@ -464,6 +543,12 @@ def _choco_run_fused() -> Counter:
     pinned by the dense jaxpr proof in ``tests/test_graftlint.py``,
     which runs on any jax.
     """
+    return collect_collectives(_choco_run_fused_trace().jaxpr)
+
+
+@trace_entry("choco_run_fused")
+@functools.lru_cache(maxsize=1)
+def _choco_run_fused_trace():
     import jax
     import jax.numpy as jnp
 
@@ -486,8 +571,7 @@ def _choco_run_fused() -> Counter:
     }
     st = eng.init(x)
     layout = mixing_ops.fused_layout(st.x)
-    jx = jax.make_jaxpr(eng._fused_program(layout, rounds=2))(st)
-    return collect_collectives(jx.jaxpr)
+    return jax.make_jaxpr(eng._fused_program(layout, rounds=2))(st)
 
 
 @entry("async_stale_mix", kind="jaxpr", requires=("shard_map",))
@@ -508,6 +592,12 @@ def _async_stale_mix() -> Counter:
     all_gathers (4 = the leaf count) mean the double buffer stopped
     fusing per bucket and pays per leaf.
     """
+    return collect_collectives(_async_stale_mix_trace().jaxpr)
+
+
+@trace_entry("async_stale_mix")
+@functools.lru_cache(maxsize=1)
+def _async_stale_mix_trace():
     import jax
     import jax.numpy as jnp
 
@@ -533,8 +623,7 @@ def _async_stale_mix() -> Counter:
     program = engine.async_gossip_program(
         tau=1, periods=(1,) * 7 + (2,), times=2
     )
-    jx = jax.make_jaxpr(program)(x, st)
-    return collect_collectives(jx.jaxpr)
+    return jax.make_jaxpr(program)(x, st)
 
 
 def _cost_drift(exp_cost: Optional[dict],

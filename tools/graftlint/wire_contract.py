@@ -25,7 +25,12 @@ unless every shared constant matches exactly:
 * the ABI version (``dlt_abi.h`` vs ``native/__init__.py``);
 * transport framing header/version/cap and message TYPE_CODEs
   (Python-only authorities, guarded against silent renumbering by the
-  pin below).
+  pin below);
+* the obs-delta payload surface (``OBS_PAYLOAD_KIND``/
+  ``OBS_PAYLOAD_VERSION``): authority ``obs/aggregate.py``, declared
+  wire surface through the ``comm/protocol.py`` re-export — the
+  re-export itself is checked (a restated copy would drift silently)
+  and the kind/version pair is pinned.
 
 The merged contract is additionally PINNED in ``audit_expected.json``
 (key ``wire_contract``, next to the collective pins): an intentional
@@ -70,6 +75,9 @@ CONTRACT_FILES = (
     "distributed_learning_tpu/comm/tensor_codec.py",
     "distributed_learning_tpu/comm/protocol.py",
     "distributed_learning_tpu/comm/framing.py",
+    # Appended (ISSUE 12): the obs-delta payload authority — its
+    # kind/version are declared wire surface re-exported by protocol.py.
+    "distributed_learning_tpu/obs/aggregate.py",
 )
 
 
@@ -251,6 +259,40 @@ def _module_int_consts(tree: ast.Module) -> Dict[str, Tuple[int, int]]:
     return out
 
 
+def _module_str_consts(tree: ast.Module) -> Dict[str, Tuple[str, int]]:
+    """name -> (value, line) for top-level string assignments."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        if not isinstance(value, ast.Constant) or not isinstance(
+            value.value, str
+        ):
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = (value.value, node.lineno)
+    return out
+
+
+def _reexports(tree: ast.Module, module_suffix: str,
+               *names: str) -> bool:
+    """True when the tree `from ...<module_suffix> import` ALL names."""
+    got = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith(module_suffix)
+        ):
+            got.update(a.name for a in node.names)
+    return all(n in got for n in names)
+
+
 def _dotted(node: ast.AST) -> str:
     parts = []
     while isinstance(node, ast.Attribute):
@@ -412,6 +454,14 @@ def _py_side(repo_root: str, ex: _Extract) -> Dict[str, object]:
         ex.fail(framing_rel, 1, '_HEADER = struct.Struct("<...") not found')
     out["type_codes"] = _type_codes(proto)
     out["proto_rel"] = proto_rel
+    agg_src, agg_rel = _read(repo_root, CONTRACT_FILES[8])
+    agg = ast.parse(agg_src)
+    out["obs_int"] = _module_int_consts(agg)
+    out["obs_str"] = _module_str_consts(agg)
+    out["obs_rel"] = agg_rel
+    out["obs_reexported"] = _reexports(
+        proto, "obs.aggregate", "OBS_PAYLOAD_KIND", "OBS_PAYLOAD_VERSION"
+    )
     return out
 
 
@@ -620,6 +670,29 @@ def extract(repo_root: str = REPO_ROOT) -> Tuple[dict, List[Finding]]:
         contract["max_ndim"] = ent[0]
     contract["type_codes"] = {
         name: code for name, (code, _line) in sorted(py["type_codes"].items())
+    }
+
+    # Obs-delta payload surface: authority obs/aggregate.py, declared
+    # wire surface via the comm/protocol.py re-export.
+    obs_kind = py["obs_str"].get("OBS_PAYLOAD_KIND")
+    obs_ver = py["obs_int"].get("OBS_PAYLOAD_VERSION")
+    if obs_kind is None:
+        ex.fail(py["obs_rel"], 1,
+                "OBS_PAYLOAD_KIND not found in obs/aggregate.py")
+    if obs_ver is None:
+        ex.fail(py["obs_rel"], 1,
+                "OBS_PAYLOAD_VERSION not found in obs/aggregate.py")
+    if not py["obs_reexported"]:
+        ex.fail(
+            py["proto_rel"], 1,
+            "comm/protocol.py no longer re-exports OBS_PAYLOAD_KIND/"
+            "OBS_PAYLOAD_VERSION from obs.aggregate — the obs-delta "
+            "payload is declared wire surface and must come from the "
+            "single authority, not a restated copy",
+        )
+    contract["obs_payload"] = {
+        "kind": obs_kind[0] if obs_kind else None,
+        "version": obs_ver[0] if obs_ver else None,
     }
     return contract, ex.findings
 
