@@ -1,0 +1,177 @@
+"""Robust-mixing cost and byzantine containment (ISSUE 13).
+
+Two records:
+
+* ``robust_mix_rounds_per_sec`` — the overhead of the robust estimators
+  (adaptive clip / trimmed mean / coordinate median,
+  ``parallel/robust.py``) over the plain fused ``ConsensusEngine.mix``
+  on the same two-bucket (f32 + bf16) flat buffer: every variant runs
+  ``times=rounds`` fused into one dispatch, so the ratio measures the
+  device-side estimator cost, not host dispatch.
+
+* ``robust_async_byzantine_honest_error`` — convergence of the
+  stale-weighted async path (``mix_async_robust``) under a seeded
+  persistent byzantine peer (agent ``n-1`` publishes a constant 1e3
+  poison vector every round) versus the undefended ``mix_async``:
+  plain weighted averaging has breakdown point zero, so the honest
+  agents' error versus their own initial mean blows up to the poison
+  scale; the clipped/trimmed runs contain it.  **Gate: defended error
+  <= undefended / 50**, with the redirected-mass detection signal
+  strictly positive.  The whole run is seed-deterministic
+  (``np.random.default_rng``), matching the replayability contract of
+  the fault harness (``comm/faults.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks import common
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+
+SPECS = {
+    "clip": {"kind": "clip", "radius": 2.0, "adaptive": True},
+    "trim": {"kind": "trim", "trim": 1},
+    "median": "median",
+}
+
+
+def _state(n: int, dim: int, seed: int = 0) -> Dict[str, jnp.ndarray]:
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(n, dim)).astype(np.float32)),
+        "h": jnp.asarray(
+            rng.normal(size=(n, max(dim // 2, 1))).astype(np.float32)
+        ).astype(jnp.bfloat16),
+    }
+
+
+def run_overhead(
+    n: int = 8,
+    dim: Optional[int] = None,
+    rounds: Optional[int] = None,
+    reps: int = 3,
+) -> dict:
+    """Rounds/sec of each robust estimator vs the plain fused mix."""
+    if dim is None:
+        dim = 1 << 12 if not common.full_scale() else 1 << 18
+    if rounds is None:
+        rounds = 20 if common.smoke() else 200
+    eng = ConsensusEngine(Topology.complete(n).metropolis_weights())
+    x = _state(n, dim)
+
+    def timed(fn) -> float:
+        common.sync(fn())  # warmup: compile outside the timed region
+        best = float("inf")
+        for _ in range(reps):
+            with common.stopwatch() as t:
+                common.sync(fn())
+            best = min(best, t["s"])
+        return rounds / best
+
+    plain = timed(lambda: eng.mix(x, times=rounds))
+    rates = {
+        name: timed(lambda s=spec: eng.mix_robust(x, s, times=rounds)[0])
+        for name, spec in SPECS.items()
+    }
+    return common.emit(
+        {
+            "metric": "robust_mix_rounds_per_sec",
+            "value": rates["clip"],
+            "unit": "rounds/s",
+            "vs_baseline": None,
+            "bench": "robust_gossip_overhead",
+            "rounds_per_sec_plain": plain,
+            **{f"rounds_per_sec_{k}": v for k, v in rates.items()},
+            **{f"overhead_{k}": plain / v for k, v in rates.items()},
+            "n_agents": n,
+            "dim": dim,
+            "rounds": rounds,
+        }
+    )
+
+
+def run_byzantine(
+    n: int = 8,
+    dim: int = 256,
+    iters: Optional[int] = None,
+    poison: float = 1e3,
+    seed: int = 0,
+    gate: float = 50.0,
+) -> dict:
+    """Async honest-agent error under one byzantine peer, defended vs
+    not; the defended runs must contain the error by ``gate``x."""
+    if iters is None:
+        iters = 60 if common.smoke() else 400
+    liar = n - 1
+    honest = [i for i in range(n) if i != liar]
+    topo = Topology.complete(n).metropolis_weights()
+    x0 = np.random.default_rng(seed).normal(size=(n, dim)).astype(np.float32)
+    target = x0[honest].mean(axis=0)
+    # One slow honest publisher + the liar: the straggler model the
+    # async path exists for, so staleness decay is exercised too.
+    periods = (1,) * (n - 2) + (2, 1)
+
+    def mode(spec):
+        eng = ConsensusEngine(topo)
+        x, st, total = {"w": jnp.asarray(x0)}, None, 0.0
+        for _ in range(iters):
+            arr = np.array(x["w"])  # copy: jax buffers are read-only
+            arr[liar] = poison  # constant poison vector, every round
+            x = {"w": jnp.asarray(arr)}
+            if spec is None:
+                x, st = eng.mix_async(x, st, tau=2, periods=periods, times=1)
+            else:
+                x, st, mass = eng.mix_async_robust(
+                    x, st, spec=spec, tau=2, periods=periods, times=1
+                )
+                total += float(mass)
+        err = float(np.abs(np.asarray(x["w"])[honest] - target).max())
+        return err, total
+
+    un_err, _ = mode(None)
+    cl_err, cl_mass = mode(SPECS["clip"])
+    tr_err, tr_mass = mode(SPECS["trim"])
+    contained = bool(cl_err <= un_err / gate and tr_err <= un_err / gate)
+    return common.emit(
+        {
+            "metric": "robust_async_byzantine_honest_error",
+            "value": cl_err,
+            "unit": "max|x - honest_mean|",
+            "vs_baseline": None,
+            "bench": "robust_gossip_byzantine_async",
+            "undefended_error": un_err,
+            "clipped_error": cl_err,
+            "trimmed_error": tr_err,
+            "containment_clipped": un_err / cl_err,
+            "containment_trimmed": un_err / tr_err,
+            "redirected_mass_clipped": cl_mass,
+            "redirected_mass_trimmed": tr_mass,
+            "gate": gate,
+            "gate_passed": contained,
+            "iters": iters,
+            "poison_scale": poison,
+            "n_agents": n,
+            "dim": dim,
+            "seed": seed,
+        }
+    )
+
+
+def run(**kwargs) -> dict:
+    return {
+        "overhead": run_overhead(
+            **{k: v for k, v in kwargs.items() if k in ("n", "dim", "rounds")}
+        ),
+        "byzantine": run_byzantine(
+            **{k: v for k, v in kwargs.items() if k in ("n", "iters", "seed")}
+        ),
+    }
+
+
+if __name__ == "__main__":
+    run()

@@ -26,6 +26,14 @@ from distributed_learning_tpu.comm.agent import (
 from distributed_learning_tpu.comm.async_runtime import (
     AsyncGossipRunner,
     AsyncRoundStats,
+    QUARANTINE_PAYLOAD_KIND,
+)
+from distributed_learning_tpu.comm.faults import (
+    FaultPlan,
+    FaultyStream,
+    inject_neighbor_faults,
+    lying_fields_mutator,
+    poison_value_mutator,
 )
 from distributed_learning_tpu.comm.framing import FramedStream, FrameError, open_framed_connection
 from distributed_learning_tpu.comm.master import ConsensusMaster
@@ -70,8 +78,14 @@ __all__ = [
     "AsyncRoundStats",
     "ConsensusAgent",
     "ConsensusMaster",
+    "FaultPlan",
+    "FaultyStream",
     "FramedStream",
     "FrameError",
+    "QUARANTINE_PAYLOAD_KIND",
+    "inject_neighbor_faults",
+    "lying_fields_mutator",
+    "poison_value_mutator",
     "RoundAbortedError",
     "ShutdownError",
     "StreamMultiplexer",

@@ -65,7 +65,19 @@ from distributed_learning_tpu.comm.agent import (
     ShutdownError,
 )
 
-__all__ = ["AsyncGossipRunner", "AsyncRoundStats"]
+__all__ = [
+    "AsyncGossipRunner",
+    "AsyncRoundStats",
+    "QUARANTINE_PAYLOAD_KIND",
+]
+
+#: ``payload["kind"]`` marking a Telemetry payload as a quarantine report
+#: (runner -> master): ``{"kind": ..., "accused": token, "violations": n,
+#: "round": r, "generation": g}``.  The master accumulates accusers per
+#: accused token and, at quorum, evicts the peer and (with
+#: ``regenerate=True``) excludes it from the next membership generation
+#: (docs/robustness.md §Quarantine).
+QUARANTINE_PAYLOAD_KIND = "robust.quarantine"
 
 
 @dataclasses.dataclass
@@ -88,7 +100,10 @@ class _Inbox:
     """Per-neighbor receive state: the FIFO of unconsumed frames plus
     the standing (last mixed) value and its reuse count."""
 
-    __slots__ = ("queue", "last", "times_mixed", "dropped", "choco_lag")
+    __slots__ = (
+        "queue", "last", "times_mixed", "dropped", "choco_lag",
+        "violations", "seen_gen", "seen_round", "seen_stale",
+    )
 
     def __init__(self):
         self.queue: deque = deque()  # (value, sender_round, staleness)
@@ -96,6 +111,14 @@ class _Inbox:
         self.times_mixed = 0  # rounds `last` was already mixed
         self.dropped = False  # sticky: dropped until a fresh arrival
         self.choco_lag = 0  # consecutive rounds without a correction
+        # Wire-field validation state (docs/robustness.md §Validation):
+        # violation tally + the last accepted (generation, round,
+        # staleness) — round ids must be monotone per neighbor within a
+        # generation, staleness monotone within a round (re-pushes age).
+        self.violations = 0
+        self.seen_gen: Optional[int] = None
+        self.seen_round = -1
+        self.seen_stale = -1
 
 
 class AsyncGossipRunner:
@@ -117,6 +140,25 @@ class AsyncGossipRunner:
         Cap on any blocking wait for a required-fresh neighbor; expiry
         drops it for this round (sticky) and pokes it.  None = wait
         forever (pure bounded-staleness mode).
+    validate_wire:
+        Validate the protocol fields of every incoming
+        :class:`~distributed_learning_tpu.comm.protocol.AsyncValue`
+        (round ids monotone per neighbor within a generation, staleness
+        monotone within a round, both non-negative and within
+        ``round_slack`` of this runner's own round).  An honest runtime
+        never trips these, so the default is on; a violating frame is
+        dropped unmixed and the peer poked for a well-formed push.
+    quarantine_after:
+        Violations (per neighbor) before the peer is QUARANTINED: its
+        stream is evicted, its edge weight renormalizes to self, and the
+        master is notified via a :data:`QUARANTINE_PAYLOAD_KIND`
+        telemetry payload so regeneration can exclude it.
+    round_slack:
+        Bound on how far ahead of this runner's own round counter a
+        claimed ``round_id``/``staleness`` may run.  Generous on purpose
+        — honest peers legitimately run ahead in bounded-staleness mode;
+        the bound only has to catch absurd claims (a lying peer
+        advertising round 10**18 to poison staleness accounting).
     """
 
     def __init__(
@@ -125,21 +167,32 @@ class AsyncGossipRunner:
         *,
         staleness_bound: int = 0,
         deadline_s: Optional[float] = None,
+        validate_wire: bool = True,
+        quarantine_after: int = 3,
+        round_slack: int = 100_000,
     ):
         if staleness_bound < 0:
             raise ValueError(
                 f"staleness_bound must be >= 0, got {staleness_bound}"
+            )
+        if quarantine_after < 1:
+            raise ValueError(
+                f"quarantine_after must be >= 1, got {quarantine_after}"
             )
         self.agent = agent
         self.tau = int(staleness_bound)
         self.deadline_s = (
             None if deadline_s is None else float(deadline_s)
         )
+        self.validate_wire = bool(validate_wire)
+        self.quarantine_after = int(quarantine_after)
+        self.round_slack = int(round_slack)
         self._round = 0
         self._inbox: Dict[str, _Inbox] = {}
         self._pub_value: Optional[np.ndarray] = None
         self._pub_round = 0
         self._poked: set = set()
+        self._quarantined: set = set()
         self.last_stats = AsyncRoundStats()
 
     # ------------------------------------------------------------------ #
@@ -154,12 +207,104 @@ class AsyncGossipRunner:
             box = self._inbox[token] = _Inbox()
         return box
 
+    @property
+    def quarantined(self) -> frozenset:
+        """Tokens this runner has quarantined (their edges renormalize
+        to self until the master regenerates the topology without them)."""
+        return frozenset(self._quarantined)
+
     def _active(self) -> List[str]:
         """Weighted neighbors with a live stream, sorted (mixing
         accumulates in this order on every agent — deterministic, and
-        the tau=0 oracle against the lock-step path can be bit-exact)."""
+        the tau=0 oracle against the lock-step path can be bit-exact).
+        Quarantined peers are excluded even if a replacement stream
+        reappears: only a membership regeneration can readmit them."""
         a = self.agent
-        return sorted(t for t in a._weights if t in a._neighbors)
+        return sorted(
+            t for t in a._weights
+            if t in a._neighbors and t not in self._quarantined
+        )
+
+    # ------------------------------------------------------------------ #
+    # Wire-field validation + quarantine (docs/robustness.md)            #
+    # ------------------------------------------------------------------ #
+    def _validate_async_fields(self, token: str, msg: Any) -> bool:
+        """Check an AsyncValue's protocol fields against the per-neighbor
+        history: non-negative, round ids monotone within a generation
+        (an honest peer's counter never runs backwards; a rejoin resets
+        it WITH a generation bump), staleness monotone for re-pushes of
+        the same round, and both within ``round_slack`` of our own round
+        (arrival-anchored staleness never needs alignment, so the bound
+        only rejects absurd claims).  Accepting updates the history."""
+        box = self._box(token)
+        if box.seen_gen != msg.generation:
+            # New membership generation: the peer's counter legitimately
+            # restarts (rejoin/replacement); reset the monotonicity base.
+            box.seen_gen = msg.generation
+            box.seen_round = -1
+            box.seen_stale = -1
+        bound = self._round + self.round_slack
+        ok = (
+            msg.round_id >= 0
+            and msg.staleness >= 0
+            and msg.round_id >= box.seen_round
+            and not (
+                msg.round_id == box.seen_round
+                and msg.staleness < box.seen_stale
+            )
+            and msg.round_id <= bound
+            and msg.staleness <= bound
+        )
+        if ok:
+            box.seen_round = msg.round_id
+            box.seen_stale = msg.staleness
+        return ok
+
+    def _on_violation(self, token: str) -> None:
+        """One protocol violation from ``token``: the frame was already
+        dropped unmixed; tally it, poke for a well-formed push
+        (drop-and-poke), and quarantine at the threshold."""
+        a = self.agent
+        box = self._box(token)
+        box.violations += 1
+        a._count("async_field_violations")
+        if box.violations >= self.quarantine_after:
+            self._quarantine(token)
+        else:
+            task = asyncio.ensure_future(self._poke(token))
+            task.add_done_callback(a._silence)
+
+    def _quarantine(self, token: str) -> None:
+        """Evict a repeatedly-violating peer: purge its inbox (its edge
+        weight renormalizes to self exactly like a dropped straggler's),
+        close its stream, and notify the master with a
+        :data:`QUARANTINE_PAYLOAD_KIND` telemetry payload so
+        regeneration can exclude it from the next generation."""
+        a = self.agent
+        if token in self._quarantined:
+            return
+        self._quarantined.add(token)
+        box = self._box(token)
+        box.queue.clear()
+        box.last = None
+        box.dropped = True
+        a._mux.remove(token)
+        stream = a._neighbors.pop(token, None)
+        if stream is not None:
+            stream.close()
+        a._count("async_quarantines")
+        task = asyncio.ensure_future(
+            a.send_telemetry(
+                {
+                    "kind": QUARANTINE_PAYLOAD_KIND,
+                    "accused": token,
+                    "violations": box.violations,
+                    "round": self._round,
+                    "generation": a._generation,
+                }
+            )
+        )
+        task.add_done_callback(a._silence)
 
     # ------------------------------------------------------------------ #
     # Wire I/O (the dispatch loop; graftlint host-sync-in-hot-path       #
@@ -279,8 +424,16 @@ class AsyncGossipRunner:
             self._box(token).dropped = True
             return
         if isinstance(msg, P.AsyncValue):
+            if token in self._quarantined:
+                a._count("async_quarantined_dropped")
+                return
             if msg.generation != a._generation:
                 a._count("async_gen_dropped")
+                return
+            if self.validate_wire and not self._validate_async_fields(
+                token, msg
+            ):
+                self._on_violation(token)
                 return
             box = self._box(token)
             box.queue.append(
@@ -291,6 +444,9 @@ class AsyncGossipRunner:
             self._poked.discard(token)
             a._count("async_values_received")
         elif isinstance(msg, P.AsyncPoke):
+            if token in self._quarantined:
+                a._count("async_quarantined_dropped")
+                return
             a._count("pokes_received")
             # Answer at this service point (we are inside the dispatch
             # loop already): schedule the re-push.

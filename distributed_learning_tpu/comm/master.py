@@ -64,6 +64,7 @@ class ConsensusMaster:
         flight: Optional[FlightRecorder] = None,
         round_deadline_s: Optional[float] = None,
         enforce_round_deadline: bool = False,
+        quarantine_quorum: int = 1,
     ):
         self.topology = (
             topology
@@ -173,6 +174,20 @@ class ConsensusMaster:
         # their neighbors themselves, so everyone else sees port 0.
         self._dialing_in: set = set()
         self._down: set = set()
+
+        # Quarantine bookkeeping (docs/robustness.md §Quarantine): async
+        # runners report repeatedly-violating peers via Telemetry
+        # payloads of kind QUARANTINE_PAYLOAD_KIND; when quorum DISTINCT
+        # accusers agree on a token it is evicted (Shutdown + stream
+        # closed), barred from re-registering, and — with regenerate=True
+        # — excluded from the next membership generation.  quorum
+        # defaults to 1: a single honest detector suffices because the
+        # accusation is of objectively-checkable protocol violations, not
+        # of value quality; raise it if byzantine agents might accuse
+        # honest ones.
+        self.quarantine_quorum = max(1, int(quarantine_quorum))
+        self._accusations: Dict[str, set] = {}
+        self._quarantined: set = set()
 
         # Observability: named logger + round/telemetry counters (the
         # gossip-round accounting the reference's _debug prints threw
@@ -321,6 +336,16 @@ class ConsensusMaster:
             stream.close()
             return
         token = msg.token
+        if token in self._quarantined:
+            # A quarantined token stays out until an operator clears it:
+            # letting it re-register would hand the violator a fresh
+            # violation budget every time it reconnects.
+            self._count("quarantine_rejections")
+            await stream.send(
+                P.ErrorException(message=f"token {token!r} is quarantined")
+            )
+            stream.close()
+            return
         joining = False
         if token not in self._index:
             # Elastic membership: an unknown token may JOIN a running
@@ -518,6 +543,10 @@ class ConsensusMaster:
                     await self._on_status(token, msg)
                 elif isinstance(msg, P.Telemetry):
                     self._count("telemetry_payloads")
+                    if self._is_quarantine_report(msg.payload):
+                        await self._on_quarantine_report(
+                            msg.token or token, msg.payload
+                        )
                     if self.aggregator is not None:
                         # The run-wide plane: obs.delta payloads merge
                         # into the run registry (+ flight rings); other
@@ -542,6 +571,74 @@ class ConsensusMaster:
             await self._broadcast(P.Shutdown(reason=repr(e)))
         finally:
             self._stopped.set()
+
+    # ------------------------------------------------------------------ #
+    # Quarantine (docs/robustness.md §Quarantine)                        #
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _is_quarantine_report(payload) -> bool:
+        from distributed_learning_tpu.comm.async_runtime import (
+            QUARANTINE_PAYLOAD_KIND,
+        )
+
+        return (
+            isinstance(payload, dict)
+            and payload.get("kind") == QUARANTINE_PAYLOAD_KIND
+        )
+
+    async def _on_quarantine_report(self, accuser: str, payload) -> None:
+        """One runner's quarantine report: tally the DISTINCT accusers of
+        the accused token; at quorum, evict it (Shutdown, stream closed,
+        registration barred) and — under elastic membership — regenerate
+        the topology without it."""
+        accused = str(payload.get("accused", ""))
+        self._count("quarantine_reports")
+        if not accused or accused == accuser:
+            return  # malformed or self-accusation: recorded, not acted on
+        if self.flight is not None:
+            self.flight.note(
+                "<master>", "quarantine_report",
+                accuser=accuser, accused=accused,
+                violations=payload.get("violations"),
+            )
+        accusers = self._accusations.setdefault(accused, set())
+        accusers.add(accuser)
+        if accused in self._quarantined:
+            return
+        if len(accusers) < self.quarantine_quorum:
+            return
+        self._quarantined.add(accused)
+        self._count("agents_quarantined")
+        self._debug(
+            "quarantining %s (accused by %s)", accused, sorted(accusers)
+        )
+        # The black box records the detection even when the accused is
+        # not currently connected (it may be mid-rejoin).
+        self._flight_dump(
+            "quarantine", token=accused, accusers=sorted(accusers),
+            violations=payload.get("violations"),
+        )
+        stream = self._control.pop(accused, None)
+        self._mux.remove(accused)
+        self._down.discard(accused)  # not coming back: barred below
+        self._round_weights.pop(accused, None)
+        self._round_arrivals.pop(accused, None)
+        if stream is not None:
+            try:
+                await stream.send(P.Shutdown(reason="quarantined"))
+            except (ConnectionError, OSError):
+                pass
+            stream.close()
+        if self._round_running:
+            self._round_running = False
+            self._cancel_deadline()
+            self._count("rounds_aborted")
+            await self._broadcast_round(
+                P.Done(round_id=self._round_id, aborted=True)
+            )
+        if self.regenerate and self._all_registered.is_set():
+            await self._regenerate("quarantine", accused)
+            await self._maybe_start_round()
 
     def _flight_dump(self, reason: str, **context) -> None:
         """Trigger a flight-recorder dump (counted, never fatal — the

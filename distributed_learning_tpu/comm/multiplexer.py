@@ -14,6 +14,8 @@ from typing import AsyncIterator, Dict, Hashable, Optional, Tuple
 
 from distributed_learning_tpu.comm.framing import FramedStream
 from distributed_learning_tpu.comm.protocol import Message
+from distributed_learning_tpu.comm.tensor_codec import CodecError
+from distributed_learning_tpu.obs import get_registry
 
 __all__ = ["StreamMultiplexer"]
 
@@ -101,6 +103,17 @@ class StreamMultiplexer:
                     del self._pending[token]
                     try:
                         msg = task.result()
+                    except CodecError:
+                        # Checksum-clean frame whose body failed the
+                        # codec's validate-before-scatter checks: the
+                        # framing consumed the whole frame before decode,
+                        # so the stream is still aligned — drop the frame
+                        # with a counter and keep the peer (its next push
+                        # is independently validated).  Torn/corrupt
+                        # frames (crc, version) raise FrameError instead,
+                        # a ConnectionError: eviction below.
+                        get_registry().inc("comm.frames_rejected")
+                        continue
                     except (asyncio.IncompleteReadError, ConnectionError, OSError):
                         # Evict only if the erroring stream is still the
                         # registered one (not an already-replaced corpse).

@@ -22,7 +22,11 @@ from typing import Any, ClassVar, Dict, List, Optional, Tuple, Type
 
 import numpy as np
 
-from distributed_learning_tpu.comm.tensor_codec import decode_tensor, encode_tensor
+from distributed_learning_tpu.comm.tensor_codec import (
+    CodecError,
+    decode_tensor,
+    encode_tensor,
+)
 # The run-wide observability plane's structured Telemetry payload: a
 # per-agent registry delta, marked by payload["kind"] ==
 # OBS_PAYLOAD_KIND and versioned by payload["v"] == OBS_PAYLOAD_VERSION.
@@ -616,4 +620,17 @@ def unpack_message(type_code: int, body: bytes) -> Message:
     cls = _REGISTRY.get(type_code)
     if cls is None:
         raise ValueError(f"unknown message type code {type_code}")
-    return cls._unpack(body)
+    try:
+        return cls._unpack(body)
+    except CodecError:
+        raise
+    except (struct.error, ValueError, IndexError) as exc:
+        # A checksum-clean frame whose body fails structural unpack
+        # (e.g. truncated inside a fixed prefix) is the same class of
+        # fault as a corrupt tensor section: surface it uniformly as
+        # CodecError so receive paths drop-and-count instead of
+        # crashing on a struct.error (validate-before-scatter is a
+        # whole-body contract, not just the tensor payload's).
+        raise CodecError(
+            f"malformed {cls.__name__} body: {exc}"
+        ) from None

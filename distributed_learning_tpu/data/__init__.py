@@ -12,6 +12,10 @@ from distributed_learning_tpu.data.prefetch import (
     epoch_batches,
     prefetch_to_device,
 )
+from distributed_learning_tpu.data.partition import (
+    label_skew_shards,
+    size_skew_shards,
+)
 from distributed_learning_tpu.data.cifar import (
     CIFAR_MEAN,
     CIFAR_STD,
@@ -40,4 +44,6 @@ __all__ = [
     "synthetic_cifar",
     "epoch_batches",
     "prefetch_to_device",
+    "label_skew_shards",
+    "size_skew_shards",
 ]

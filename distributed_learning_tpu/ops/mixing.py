@@ -42,6 +42,12 @@ __all__ = [
     "stale_weight_matrix",
     "presence_weight_matrix",
     "stale_weighted_mix",
+    "pairwise_sq_dists",
+    "clip_weight_matrix",
+    "adaptive_clip_radius",
+    "clipped_mix",
+    "trim_counts",
+    "trimmed_mix",
 ]
 
 
@@ -370,6 +376,255 @@ def stale_weighted_mix(
         return out.reshape(xv.shape).astype(xv.dtype)
 
     return jax.tree.map(leaf, stacked, published)
+
+
+# --------------------------------------------------------------------- #
+# Byzantine-robust aggregation kernels (clipped / trimmed / median)     #
+# --------------------------------------------------------------------- #
+# The robust family follows the effective-matrix discipline of
+# :func:`stale_weight_matrix`: each defense is expressed as either an
+# effective mixing matrix (clipping) or a zero-at-neutral additive
+# correction on top of the plain GEMM (trimming), so that at the neutral
+# knobs — ``radius=inf`` / ``trim=0`` — the computation runs the exact
+# same ops as :func:`dense_mix` / :func:`stale_weighted_mix` and the
+# result is bitwise identical.  All kernels are layout-agnostic: they
+# serve the stacked tree and the fused ``{dtype: (N, P)}`` buffer dict
+# alike, and the clipping radius is measured over the agent's WHOLE
+# flattened parameter vector (summed across leaves/buckets).
+
+
+def pairwise_sq_dists(
+    stacked: Pytree,
+    neighbors: Pytree | None = None,
+    *,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+) -> jax.Array:
+    """(N, N) squared L2 distances between agents' full parameter vectors.
+
+    ``sq[i, j] = || row_i(stacked) - row_j(neighbors or stacked) ||^2``
+    summed over every leaf — computed per leaf/bucket as one Gram GEMM
+    (``X Y^T``) plus rank-1 corrections, so the fused layout pays
+    O(dtype-buckets) GEMMs, never materializing an (N, N, P) tensor.
+    ``neighbors`` defaults to ``stacked`` (synchronous gossip); the async
+    double-buffer path passes the *published* buffers so ``sq[i, j]`` is
+    the distance from agent i's live value to agent j's publication.
+    """
+    xs = jax.tree.leaves(stacked)
+    ys = xs if neighbors is None else jax.tree.leaves(neighbors)
+    total = None
+    for xv, yv in zip(xs, ys):
+        xf = xv.reshape(xv.shape[0], -1).astype(jnp.float32)
+        yf = yv.reshape(yv.shape[0], -1).astype(jnp.float32)
+        g = jnp.matmul(xf, yf.T, precision=precision)
+        sx = jnp.sum(xf * xf, axis=1)
+        sy = jnp.sum(yf * yf, axis=1)
+        sq = sx[:, None] + sy[None, :] - 2.0 * g
+        total = sq if total is None else total + sq
+    return jnp.maximum(total, 0.0)
+
+
+def clip_weight_matrix(
+    W: jax.Array, sq_dists: jax.Array, radius
+) -> Tuple[jax.Array, jax.Array]:
+    """Effective mixing matrix with neighbor deltas clipped at ``radius``.
+
+    Clipped gossip rewrites ``x_i + sum_j W_ij * clip_r(x_j - x_i)`` as a
+    row-stochastic GEMM: scaling a neighbor delta by
+    ``s_ij = min(1, r_i / ||x_j - x_i||)`` is exactly the edge reweighting
+    ``W_ij <- W_ij * s_ij`` with the lost mass moved onto the self edge —
+    so one clipped round is :func:`dense_mix` under this matrix, and a
+    lying agent's arbitrarily large pull is bounded by ``r_i * W_ij``
+    (the Gorbunov/Karimireddy clipped-gossip estimator family).
+
+    ``radius`` is a scalar or per-receiver ``(N,)`` vector (see
+    :func:`adaptive_clip_radius`).  NaN distances (a poisoned payload)
+    clip to zero weight.  With ``radius=inf`` the scale is exactly 1.0
+    and the result is bitwise ``W`` — the robust-with-neutral-knobs
+    oracle rides on this, same discipline as :func:`stale_weight_matrix`.
+    Returns ``(W_eff, clipped_mass)`` where ``clipped_mass`` is the total
+    absolute edge weight moved onto self edges (0.0 when nothing
+    clipped) — the obs plane's detection signal.
+    """
+    W = jnp.asarray(W, jnp.float32)
+    n = W.shape[0]
+    r = jnp.broadcast_to(jnp.asarray(radius, jnp.float32), (n,))
+    norm = jnp.sqrt(sq_dists)
+    norm = jnp.where(jnp.isnan(norm), jnp.inf, norm)
+    s = jnp.where(
+        norm <= r[:, None],
+        jnp.float32(1.0),
+        r[:, None] / jnp.maximum(norm, jnp.float32(1e-30)),
+    )
+    # A non-finite or negative radius row clips everything to self-hold.
+    s = jnp.where(jnp.isnan(s) | (s < 0.0), jnp.float32(0.0), s)
+    eye = jnp.eye(n, dtype=bool)
+    off = jnp.where(eye, 0.0, W)
+    off_eff = jnp.where(eye, 0.0, W * s)
+    dropped = jnp.sum(off - off_eff, axis=1)
+    # where-placement (not addition) keeps surviving off-diagonal
+    # entries bitwise untouched (stale_weight_matrix discipline).
+    W_eff = jnp.where(eye, (jnp.diagonal(W) + dropped)[:, None], off_eff)
+    clipped_mass = jnp.sum(jnp.abs(off) - jnp.abs(off_eff))
+    return W_eff, clipped_mass
+
+
+def adaptive_clip_radius(
+    W: jax.Array, sq_dists: jax.Array, multiplier
+) -> jax.Array:
+    """Per-receiver adaptive clipping radius: ``multiplier`` times the
+    median neighbor-delta norm.
+
+    A fixed radius must be tuned to the (drifting) scale of honest
+    disagreement; anchoring it to each receiver's *median* incident delta
+    norm keeps honest edges unclipped (s=1 for at least half the
+    neighborhood) while an outlier sits far above the median and gets
+    clipped to median-scale pull — robust as long as the honest
+    neighbors are the majority, the same f < n/2 breakdown point as
+    trimming.  ``multiplier=inf`` returns ``inf`` rows exactly (the
+    neutral knob survives the composition), and an isolated agent's
+    radius is 0.
+    """
+    W = jnp.asarray(W, jnp.float32)
+    n = W.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+    support = jnp.logical_and(W != 0.0, ~eye)
+    norm = jnp.sqrt(jnp.maximum(sq_dists, 0.0))
+    norm = jnp.where(jnp.isnan(norm), jnp.inf, norm)
+    med = jnp.nanmedian(jnp.where(support, norm, jnp.nan), axis=1)
+    med = jnp.where(jnp.isnan(med), jnp.float32(0.0), med)
+    mult = jnp.asarray(multiplier, jnp.float32)
+    return jnp.where(
+        jnp.isinf(mult), jnp.float32(jnp.inf), mult * med
+    ) * jnp.ones((n,), jnp.float32)
+
+
+def clipped_mix(
+    stacked: Pytree,
+    W: jax.Array,
+    radius,
+    *,
+    adaptive: bool = False,
+    published: Pytree | None = None,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+) -> Tuple[Pytree, jax.Array]:
+    """One clipped-gossip round; returns ``(mixed, clipped_mass)``.
+
+    ``published=None`` is the synchronous round (:func:`dense_mix` under
+    the clipped matrix); passing the async double buffer composes with
+    staleness — hand the *stale-decayed* ``W_eff`` in as ``W`` and the
+    clip applies on top of the decay, measuring each delta from the
+    receiver's live value to the neighbor's publication.  ``adaptive``
+    reinterprets ``radius`` as the :func:`adaptive_clip_radius`
+    multiplier.  With ``radius=inf`` (adaptive or not) the effective
+    matrix is bitwise ``W`` and the round is bitwise the plain one.
+    """
+    sq = pairwise_sq_dists(
+        stacked, published, precision=precision
+    )
+    r = adaptive_clip_radius(W, sq, radius) if adaptive else radius
+    W_eff, mass = clip_weight_matrix(W, sq, r)
+    if published is None:
+        return dense_mix(stacked, W_eff, precision=precision), mass
+    return (
+        stale_weighted_mix(stacked, published, W_eff, precision=precision),
+        mass,
+    )
+
+
+def trim_counts(W, trim) -> jax.Array:
+    """Per-receiver trim depth ``t_i`` for :func:`trimmed_mix`.
+
+    An integer ``trim`` applies uniformly; ``trim="median"`` picks the
+    maximal depth ``(deg_i - 1) // 2`` that still keeps the central one
+    (odd degree) or two (even degree) neighbor contributions — the
+    coordinate-wise median aggregator as the extreme of the trimmed-mean
+    family (degree 2 keeps both neighbors: the median of two values IS
+    their mean, so a ring is already at its breakdown point).
+    """
+    W = jnp.asarray(W, jnp.float32)
+    n = W.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+    deg = jnp.sum(
+        jnp.logical_and(W != 0.0, ~eye).astype(jnp.int32), axis=1
+    )
+    if isinstance(trim, str):
+        if trim != "median":
+            raise ValueError(
+                f"trim must be an int or 'median', got {trim!r}"
+            )
+        return jnp.maximum((deg - 1) // 2, 0)
+    return jnp.full((n,), int(trim), jnp.int32)
+
+
+def trimmed_mix(
+    stacked: Pytree,
+    W: jax.Array,
+    trim: jax.Array,
+    *,
+    published: Pytree | None = None,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGHEST,
+) -> Tuple[Pytree, jax.Array]:
+    """One coordinate-wise trimmed-mean gossip round; returns
+    ``(mixed, trimmed_mass)``.
+
+    For each receiver i and coordinate p, the ``t_i`` highest and ``t_i``
+    lowest neighbor contributions (ranked per coordinate among i's
+    in-neighbors, index tie-break) are redirected onto the self edge —
+    rows stay stochastic, and with ``f <= t_i`` liars per neighborhood
+    every adversarial coordinate is discarded (the Yin et al. 2018
+    coordinate-trimmed-mean estimator on gossip weights).  Computed as
+    the plain GEMM plus a correction
+    ``sum_j W_ij m_ijp (x_i[p] - nb_j[p])`` that is exactly 0.0 at
+    ``trim=0`` — the round is then bitwise :func:`dense_mix` (sync) /
+    :func:`stale_weighted_mix` (async, via ``published``).  ``trim`` is
+    the per-receiver ``(N,)`` depth from :func:`trim_counts` (pass
+    ``trim_counts(W, "median")`` for the median aggregator).  Cost is
+    O(N^2 P) comparisons per bucket — the price of per-coordinate ranks;
+    N is the agent count, so the constant is small.
+
+    ``trimmed_mass`` is the average per-coordinate edge weight redirected
+    (summed over leaves; 0.0 when nothing trimmed).
+    """
+    W = jnp.asarray(W, jnp.float32)
+    n = W.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+    support = jnp.logical_and(W != 0.0, ~eye)
+    supf = support.astype(jnp.float32)
+    deg = jnp.sum(supf, axis=1)
+    tf = jnp.asarray(trim, jnp.int32).astype(jnp.float32)
+    W_off = jnp.where(support, W, 0.0)
+    d = jnp.diagonal(W)
+    idx = jnp.arange(n)
+    tie_lo = (idx[:, None] < idx[None, :])[:, :, None]
+
+    xs, treedef = jax.tree_util.tree_flatten(stacked)
+    ps = xs if published is None else jax.tree.leaves(published)
+    outs = []
+    mass = jnp.float32(0.0)
+    for xv, pv in zip(xs, ps):
+        xf = xv.reshape(n, -1).astype(jnp.float32)
+        pf = pv.reshape(n, -1).astype(jnp.float32)
+        base = jnp.matmul(W, pf, precision=precision)
+        if published is not None:
+            base = base + d[:, None] * (xf - pf)
+        # rank[i, j, p]: how many of receiver i's neighbors sort strictly
+        # below contribution j at coordinate p (index tie-break keeps the
+        # ranking a permutation under duplicates).
+        lt = pf[:, None, :] < pf[None, :, :]
+        tie = jnp.logical_and(pf[:, None, :] == pf[None, :, :], tie_lo)
+        cmp = jnp.logical_or(lt, tie).astype(jnp.float32)
+        rank = jnp.einsum("ik,kjp->ijp", supf, cmp)
+        m = support[:, :, None] & (
+            (rank < tf[:, None, None])
+            | (rank >= (deg - tf)[:, None, None])
+        )
+        delta = xf[:, None, :] - pf[None, :, :]
+        corr = jnp.einsum("ij,ijp->ip", W_off, jnp.where(m, delta, 0.0))
+        mass = mass + jnp.einsum(
+            "ij,ijp->", W_off, m.astype(jnp.float32)
+        ) / jnp.float32(pf.shape[1])
+        outs.append((base + corr).reshape(xv.shape).astype(xv.dtype))
+    return jax.tree_util.tree_unflatten(treedef, outs), mass
 
 
 def _sq_dev_from_mean(stacked: Pytree) -> jax.Array:

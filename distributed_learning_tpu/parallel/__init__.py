@@ -25,6 +25,10 @@ from distributed_learning_tpu.parallel.consensus import (
     Mixer,
     make_agent_mesh,
 )
+from distributed_learning_tpu.parallel.robust import (
+    RobustConfig,
+    as_robust_config,
+)
 from distributed_learning_tpu.parallel.compression import (
     ChocoGossipEngine,
     top_k,
@@ -38,6 +42,8 @@ __all__ = [
     "ChocoGossipEngine",
     "ConsensusEngine",
     "Mixer",
+    "RobustConfig",
+    "as_robust_config",
     "make_agent_mesh",
     "ExtraEngine",
     "ExtraState",

@@ -1192,6 +1192,81 @@ class ConsensusEngine:
         with get_tracer().span("consensus.mix_async"):
             return self._jit_cache[key](stacked, state)
 
+    # ------------------------------------------------------------------ #
+    # Byzantine-robust variants (parallel/robust.py)                     #
+    # ------------------------------------------------------------------ #
+    def robust_mix_program(self, spec, times: int = 1):
+        """Traceable ``state -> (state, mass)`` robust-mixing body — the
+        clipped / trimmed-mean / coordinate-median counterpart of
+        :meth:`mix_program`; see :mod:`..parallel.robust`."""
+        from distributed_learning_tpu.parallel import robust
+
+        return robust.robust_mix_program(self, spec, times)
+
+    def mix_robust(self, stacked: Pytree, spec, times: int = 1):
+        """Run ``times`` robust gossip rounds; returns ``(mixed, mass)``
+        where ``mass`` is the total edge weight the defense redirected to
+        self edges (0.0 at the neutral knobs, where the result is
+        bit-identical to :meth:`mix`)."""
+        from distributed_learning_tpu.parallel import robust
+
+        cfg = robust.as_robust_config(spec)
+        key = ("mix_robust", cfg, int(times))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                robust.robust_mix_program(self, cfg, times)
+            )
+        self._count_rounds(times)
+        self._note_layout(stacked, rounds=times)
+        with get_tracer().span("consensus.mix_robust"):
+            mixed, mass = self._jit_cache[key](stacked)
+        get_registry().inc("consensus.robust.rounds", int(times))
+        return mixed, mass
+
+    def robust_async_gossip_program(
+        self, spec, *, tau: int, periods, times: int = 1
+    ):
+        """Traceable robust counterpart of :meth:`async_gossip_program`
+        (``(stacked, state) -> (stacked, state, mass)``); see
+        :mod:`..parallel.robust`."""
+        from distributed_learning_tpu.parallel import robust
+
+        return robust.robust_async_gossip_program(
+            self, spec, tau=tau, periods=periods, times=times
+        )
+
+    def mix_async_robust(
+        self,
+        stacked: Pytree,
+        state: Optional[AsyncGossipState] = None,
+        *,
+        spec,
+        tau: int,
+        periods,
+        times: int = 1,
+    ) -> Tuple[Pytree, AsyncGossipState, jax.Array]:
+        """Robust :meth:`mix_async`: stale-weighted double-buffered
+        rounds with the robust estimator applied on top of the
+        stale-decayed matrix.  Returns ``(mixed, carry, mass)``; at the
+        neutral knobs bit-identical to :meth:`mix_async`."""
+        from distributed_learning_tpu.parallel import robust
+
+        cfg = robust.as_robust_config(spec)
+        periods = self._normalize_periods(periods)
+        key = ("mix_async_robust", cfg, int(tau), periods, int(times))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                robust.robust_async_gossip_program(
+                    self, cfg, tau=tau, periods=periods, times=times
+                )
+            )
+        if state is None:
+            state = self.init_async_state(stacked)
+        self._count_rounds(times)
+        self._note_layout(stacked, rounds=times)
+        with get_tracer().span("consensus.mix_async_robust"):
+            return self._jit_cache[key](stacked, state)
+
     def cost_profile(self, stacked: Pytree, *, times: int = 1,
                      name: str = "consensus.mix"):
         """:class:`~distributed_learning_tpu.obs.cost.CostProfile` of

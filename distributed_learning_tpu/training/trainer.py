@@ -306,6 +306,7 @@ class GossipTrainer:
         fused_consensus: bool = True,
         superstep: int = 1,
         async_gossip: Any = None,
+        robust_mixing: Any = None,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         obs: Any = None,
@@ -481,6 +482,37 @@ class GossipTrainer:
                 "periods": async_gossip.get("publish_period", 1),
             }
         self._async_state = None
+        # Byzantine-robust mixing (docs/robustness.md): route the gossip
+        # phase through parallel/robust.py's clipped / trimmed / median
+        # consensus programs.  Accepts anything as_robust_config does —
+        # a kind string ("clip" / "trim" / "median"), a mapping
+        # ({"kind": "clip", "radius": 2.0, "adaptive": True}), or a
+        # RobustConfig.  Neutral knobs (radius=inf, trim=0) are
+        # bit-identical to the plain mix / mix_async path.  Composes
+        # with async_gossip (the stale-weighted robust programs).
+        self._robust_cfg = None
+        if robust_mixing is not None and robust_mixing is not False:
+            from distributed_learning_tpu.parallel.robust import (
+                as_robust_config,
+            )
+
+            self._robust_cfg = as_robust_config(robust_mixing)
+            if (
+                self.chebyshev
+                or mix_eps is not None
+                or topology_schedule is not None
+                or global_avg_every is not None
+                or compression is not None
+            ):
+                raise ValueError(
+                    "robust_mixing applies to the plain-mix (optionally "
+                    "async_gossip) config only; it is mutually exclusive "
+                    "with chebyshev, mix_eps, topology_schedule, "
+                    "global_avg_every, and compression"
+                )
+        # Redirected-mass device scalar from the epoch's robust gossip;
+        # materialized at the chunk flush boundary (one sync per epoch).
+        self._robust_mass = None
         if compression is not None:
             if self.chebyshev or topology_schedule is not None or mix_eps is not None:
                 raise ValueError(
@@ -830,6 +862,7 @@ class GossipTrainer:
         )
         self._choco_xhat = None  # fresh run: CHOCO estimates restart at 0
         self._async_state = None  # fresh run: async publish buffer restarts
+        self._robust_mass = None
         return self
 
     # ------------------------------------------------------------------ #
@@ -899,12 +932,36 @@ class GossipTrainer:
             # round counter) threads across epochs so a straggler's
             # publish cadence is continuous over the whole run.  With
             # neutral knobs this is bit-identical to engine.mix.
-            params, self._async_state = self.engine.mix_async(
-                params,
-                self._async_state,
-                tau=self._async_sim["tau"],
-                periods=self._async_sim["periods"],
-                times=mix_times,
+            if self._robust_cfg is not None:
+                # Robust estimator on the stale-weighted neighbor set
+                # (docs/robustness.md); the redirected-mass device scalar
+                # joins ``rounds`` at the chunk-flush sync boundary.
+                params, self._async_state, self._robust_mass = (
+                    self.engine.mix_async_robust(
+                        params,
+                        self._async_state,
+                        spec=self._robust_cfg,
+                        tau=self._async_sim["tau"],
+                        periods=self._async_sim["periods"],
+                        times=mix_times,
+                    )
+                )
+            else:
+                params, self._async_state = self.engine.mix_async(
+                    params,
+                    self._async_state,
+                    tau=self._async_sim["tau"],
+                    periods=self._async_sim["periods"],
+                    times=mix_times,
+                )
+            return params, rounds
+        if self._robust_cfg is not None:
+            # Byzantine-robust synchronous gossip: clipped / trimmed /
+            # median mixing (parallel/robust.py).  Mutually exclusive
+            # with every other special-mix config (constructor check),
+            # so this dispatch owns the epoch.
+            params, self._robust_mass = self.engine.mix_robust(
+                params, self._robust_cfg, times=mix_times
             )
             return params, rounds
         if (
@@ -1089,6 +1146,12 @@ class GossipTrainer:
                 accs = arrs["acc"]
                 gnorms = arrs["grad_norm"]
                 mix_rounds = int(np.asarray(rounds))
+                # Robust gossip's redirected-mass scalar shares the same
+                # single per-epoch sync region (see _gossip docstring).
+                robust_mass = None
+                if self._robust_mass is not None:
+                    robust_mass = float(np.asarray(self._robust_mass))
+                    self._robust_mass = None
                 if sampled:
                     # The declared 1-in-N chunk-boundary sample: drain
                     # the (possibly still in-flight) state and record
@@ -1152,6 +1215,17 @@ class GossipTrainer:
             )
             if mixed:
                 self._obs_registry.inc("consensus.rounds_run", mix_rounds)
+            if robust_mass is not None:
+                # Cumulative redirected edge mass — the defense's
+                # detection signal (docs/robustness.md): ~0 in honest
+                # runs, grows whenever a peer is being clipped/trimmed.
+                self._obs_registry.inc(
+                    "consensus.robust.clipped_mass", robust_mass
+                )
+                self._obs_registry.observe(
+                    "consensus.robust.mass", robust_mass,
+                    step=self._global_step,
+                )
             if test_accs is not None:
                 self._obs_registry.observe(
                     "eval.test_acc", float(np.mean(test_accs)),
@@ -1218,15 +1292,17 @@ class GossipTrainer:
     def _superstep_supported(self) -> bool:
         """Whether this config's gossip compiles into the superstep.
         ``mix_times_schedule`` / ``topology_schedule`` / compression /
-        async gossip run host logic between epochs (per-epoch python
-        schedules, CHOCO's and the async carry's cross-epoch
-        bookkeeping) — inherently chunk-hostile, so they keep the
-        per-epoch path rather than silently changing semantics."""
+        async gossip / robust mixing run host logic between epochs
+        (per-epoch python schedules, CHOCO's and the async carry's
+        cross-epoch bookkeeping, the robust redirected-mass flush) —
+        inherently chunk-hostile, so they keep the per-epoch path
+        rather than silently changing semantics."""
         return (
             self.mix_times_schedule is None
             and self.topology_schedule is None
             and self._choco is None
             and self._async_sim is None
+            and self._robust_cfg is None
         )
 
     def _make_superstep_fn(self, k: int):
@@ -1330,7 +1406,8 @@ class GossipTrainer:
                 self._superstep_warned = True
                 warnings.warn(
                     "superstep: mix_times_schedule/topology_schedule/"
-                    "compression/async_gossip configs run per-epoch host "
+                    "compression/async_gossip/robust_mixing configs run "
+                    "per-epoch host "
                     "logic between epochs and cannot be fused into one "
                     "dispatch; "
                     "falling back to K=1 (the per-epoch path, unchanged "

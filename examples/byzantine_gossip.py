@@ -1,0 +1,123 @@
+"""Byzantine-robust gossip demo: one lying agent on Titanic.
+
+Eight agents run gossip logistic-regression GD on IID Titanic shards
+over a complete graph; agent ``7`` is byzantine — every round it
+publishes a constant poisoned weight vector (all coordinates at 1e3)
+instead of its local iterate.  The same attack runs three times:
+
+* **undefended** — plain ``ConsensusEngine.mix``: weighted averaging
+  has breakdown point zero, so the honest agents are dragged to the
+  poison scale and test accuracy collapses to coin-flipping;
+* **clipped**  — ``mix_robust`` with an adaptive clip radius (each
+  receiver clips neighbor deltas at its median neighbor-delta norm);
+* **trimmed**  — ``mix_robust`` with per-coordinate trimmed mean
+  (``trim=1``: the one most extreme contribution per side discarded).
+
+Convergence evidence comes FROM THE OBS REGISTRY: the per-round honest
+test accuracy series (``byzantine.honest_acc.<mode>``), the engine's
+``consensus.robust.rounds`` counter, and the redirected-mass total
+(``consensus.robust.clipped_mass``) — the defense's detection signal,
+~0 in honest runs and large under attack.
+
+    python -m examples.byzantine_gossip [--iters 300]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_tpu.data import load_titanic, shard_dataset
+from distributed_learning_tpu.models import logreg_loss
+from distributed_learning_tpu.models.logreg import accuracy as logreg_accuracy
+from distributed_learning_tpu.obs import MetricsRegistry, use_registry
+from distributed_learning_tpu.parallel import Topology
+from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+
+N, LIAR, POISON_SCALE = 8, 7, 1e3
+ALPHA, TAU = 0.5, 1e-2  # constant step + ridge: the dsgt_titanic recipe
+SPECS = {
+    "undefended": None,
+    "clipped": {"kind": "clip", "adaptive": True, "radius": 1.0},
+    "trimmed": {"kind": "trim", "trim": 1},
+}
+
+
+def _shards():
+    X_tr, y_tr, X_te, y_te = load_titanic()
+    shards = shard_dataset(X_tr, y_tr, N, seed=0)
+    m = min(len(shards[i][0]) for i in range(N))
+    Xs = jnp.stack([jnp.asarray(shards[i][0][:m], jnp.float32) for i in range(N)])
+    ys = jnp.stack([jnp.asarray(shards[i][1][:m], jnp.float32) for i in range(N)])
+    return Xs, ys, jnp.asarray(X_te), jnp.asarray(y_te, jnp.float32)
+
+
+def run(mode, spec, iters, Xs, ys, Xte, yte, reg):
+    dim = Xs.shape[-1]
+    engine = ConsensusEngine(Topology.complete(N).metropolis_weights())
+    grad = jax.grad(logreg_loss)
+    vstep = jax.jit(
+        jax.vmap(
+            lambda w, X, y: w - ALPHA * grad(w, X, y, TAU),
+            in_axes=(0, 0, 0),
+        )
+    )
+    honest = np.array([i for i in range(N) if i != LIAR])
+    w = jnp.zeros((N, dim), jnp.float32)
+    total_mass = 0.0
+    for r in range(iters):
+        w = vstep(w, Xs, ys)
+        # The byzantine publish: the liar ships a constant poison
+        # vector at 1e3 scale instead of its local iterate, every
+        # round (a persistent attacker, not a one-shot glitch).
+        arr = np.array(w)
+        arr[LIAR] = POISON_SCALE
+        x = {"w": jnp.asarray(arr)}
+        if spec is None:
+            x = engine.mix(x, times=1)
+        else:
+            x, mass = engine.mix_robust(x, spec, times=1)
+            total_mass += float(mass)
+        w = x["w"]
+        if r % 20 == 0 or r == iters - 1:
+            acc = float(
+                logreg_accuracy(jnp.mean(w[honest], axis=0), Xte, yte)
+            )
+            reg.observe(f"byzantine.honest_acc.{mode}", acc, step=r)
+    reg.inc("consensus.robust.clipped_mass", total_mass)
+    drift = float(jnp.abs(w[honest]).max())
+    return drift
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=300)
+    args = ap.parse_args()
+    Xs, ys, Xte, yte = _shards()
+
+    for mode, spec in SPECS.items():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            drift = run(mode, spec, args.iters, Xs, ys, Xte, yte, reg)
+        # Report from the registry — the same channel the obs plane
+        # aggregates — not from script-local state.
+        accs = [v for _, v in reg.series[f"byzantine.honest_acc.{mode}"]]
+        mass = reg.counters.get("consensus.robust.clipped_mass", 0.0)
+        rounds = int(reg.counters.get("consensus.robust.rounds", 0))
+        print(
+            f"{mode:11s} honest test acc {accs[-1]:.4f}  "
+            f"param scale {drift:9.3e}  "
+            f"robust rounds {rounds:4d}  redirected mass {mass:10.2f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

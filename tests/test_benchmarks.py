@@ -438,3 +438,34 @@ def test_bench_async_gossip_straggler_gate(capsys):
         if l.startswith("{")
     ]
     assert any(r.get("bench") == "async_gossip_straggler" for r in line)
+
+
+def test_bench_robust_gossip_smoke(capsys):
+    """ISSUE 13 gate at smoke width: every robust estimator's fused
+    rounds/sec is positive (overhead reported, not gated — estimator
+    cost is real and disclosed), and the async byzantine run shows the
+    breakdown picture: the undefended honest error reaches the poison
+    scale while clip/trim contain it by the 50x acceptance gate with a
+    strictly positive redirected-mass detection signal."""
+    from benchmarks import bench_robust_gossip
+
+    out = bench_robust_gossip.run()
+    ov = out["overhead"]
+    assert ov["rounds_per_sec_plain"] > 0
+    for k in ("clip", "trim", "median"):
+        assert ov[f"rounds_per_sec_{k}"] > 0, ov
+        assert np.isfinite(ov[f"overhead_{k}"]), ov
+    byz = out["byzantine"]
+    assert byz["gate_passed"], byz
+    assert byz["undefended_error"] > 50.0, byz
+    assert byz["clipped_error"] <= byz["undefended_error"] / 50, byz
+    assert byz["trimmed_error"] <= byz["undefended_error"] / 50, byz
+    assert byz["redirected_mass_clipped"] > 0, byz
+    assert byz["redirected_mass_trimmed"] > 0, byz
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    metrics = {r["metric"] for r in lines}
+    assert {"robust_mix_rounds_per_sec",
+            "robust_async_byzantine_honest_error"} <= metrics
+    for r in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(r)

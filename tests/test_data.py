@@ -199,3 +199,109 @@ def test_prefetch_releases_producer_on_early_break():
     while threading.active_count() > before and time.time() < deadline:
         time.sleep(0.05)
     assert threading.active_count() <= before, "producer thread leaked"
+
+
+# ---------------------------------------------------------------------------
+# Non-IID partitioners (data/partition.py): seeded label-skew / size-skew.
+
+
+def _partition_fixture(n=240, classes=4, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    return X, y
+
+
+def _assert_disjoint_cover(shards, X, y):
+    from collections import Counter
+
+    rows = [tuple(np.round(xs_row, 6)) for _, (xs, _) in sorted(
+        shards.items(), key=lambda kv: str(kv[0])
+    ) for xs_row in xs]
+    assert len(rows) == len(X)
+    assert Counter(rows) == Counter(tuple(np.round(r, 6)) for r in X)
+    for xs, ys in shards.values():
+        assert len(xs) == len(ys)
+
+
+def test_label_skew_shards_deterministic_and_covering():
+    from distributed_learning_tpu.data import label_skew_shards
+
+    X, y = _partition_fixture()
+    a = label_skew_shards(X, y, ["A", "B", "C"], alpha=0.3, seed=7)
+    b = label_skew_shards(X, y, ["A", "B", "C"], alpha=0.3, seed=7)
+    assert set(a) == {"A", "B", "C"}
+    for tok in a:
+        np.testing.assert_array_equal(a[tok][0], b[tok][0])
+        np.testing.assert_array_equal(a[tok][1], b[tok][1])
+    _assert_disjoint_cover(a, X, y)
+    # A different seed deals a different partition.
+    c = label_skew_shards(X, y, ["A", "B", "C"], alpha=0.3, seed=8)
+    assert any(
+        a[t][0].shape != c[t][0].shape or not np.array_equal(a[t][0], c[t][0])
+        for t in a
+    )
+
+
+def test_label_skew_small_alpha_concentrates_classes():
+    from distributed_learning_tpu.data import label_skew_shards
+
+    X, y = _partition_fixture(n=2000, classes=4, seed=0)
+    skewed = label_skew_shards(X, y, 4, alpha=0.05, seed=1)
+    iid = label_skew_shards(X, y, 4, alpha=1e4, seed=1)
+
+    def max_class_frac(shards):
+        fracs = []
+        for _, ys in shards.values():
+            counts = np.bincount(ys, minlength=4)
+            fracs.append(counts.max() / max(1, counts.sum()))
+        return float(np.mean(fracs))
+
+    # Small alpha -> shards dominated by one class; huge alpha -> ~uniform.
+    assert max_class_frac(skewed) > 0.6
+    assert max_class_frac(iid) < 0.4
+
+
+def test_label_skew_rejects_empty_agent():
+    from distributed_learning_tpu.data import label_skew_shards
+
+    X, y = _partition_fixture(n=12, classes=2)
+    with pytest.raises(ValueError, match="min_per_agent|examples"):
+        # 40 examples demanded per agent from 12 rows: must raise, not
+        # silently hand back an undersized shard.
+        label_skew_shards(X, y, 3, alpha=0.5, seed=0, min_per_agent=40)
+
+
+def test_size_skew_shards_geometric_sizes_and_determinism():
+    from distributed_learning_tpu.data import size_skew_shards
+
+    X, y = _partition_fixture(n=210)
+    a = size_skew_shards(X, y, 3, ratio=2.0, seed=5)
+    b = size_skew_shards(X, y, 3, ratio=2.0, seed=5)
+    for tok in a:
+        np.testing.assert_array_equal(a[tok][0], b[tok][0])
+        np.testing.assert_array_equal(a[tok][1], b[tok][1])
+    _assert_disjoint_cover(a, X, y)
+    sizes = [len(a[t][0]) for t in range(3)]
+    assert sizes == sorted(sizes)  # geometric: later agents data-rich
+    assert sizes[2] >= 3 * sizes[0]  # ratio 2 over 3 agents: 1:2:4
+    # ratio=1 recovers the near-equal deal.
+    eq = size_skew_shards(X, y, 3, ratio=1.0, seed=5)
+    eq_sizes = sorted(len(eq[t][0]) for t in range(3))
+    assert eq_sizes[-1] - eq_sizes[0] <= 1
+
+
+def test_partitioners_batch_size_truncation():
+    from distributed_learning_tpu.data import (
+        label_skew_shards,
+        size_skew_shards,
+    )
+
+    X, y = _partition_fixture(n=300)
+    for shards in (
+        label_skew_shards(X, y, 3, alpha=0.5, seed=2, batch_size=16),
+        size_skew_shards(X, y, 3, ratio=1.5, seed=2, batch_size=16),
+    ):
+        for xs, ys in shards.values():
+            assert len(xs) % 16 == 0
+            assert len(xs) == len(ys)

@@ -348,6 +348,31 @@ def test_async_gossip_example():
     assert fast > lock, out
 
 
+def test_byzantine_gossip_example():
+    """ISSUE 13 demo guard: the COMPUTED breakdown picture — undefended
+    averaging is dragged to the poison scale while the clipped/trimmed
+    runs keep honest accuracy, with the redirected-mass detection signal
+    (read back from the obs registry) strictly positive."""
+    out = _run("byzantine_gossip", "--iters", "120", timeout=300.0)
+    rows = {
+        m.group(1): (float(m.group(2)), float(m.group(3)), float(m.group(4)))
+        for m in re.finditer(
+            r"(\w+) +honest test acc ([\d.]+) +param scale ([\d.e+-]+) +"
+            r"robust rounds +\d+ +redirected mass +([\d.]+)",
+            out,
+        )
+    }
+    assert set(rows) == {"undefended", "clipped", "trimmed"}, out
+    un_acc, un_scale, un_mass = rows["undefended"]
+    assert un_scale > 100.0, out        # dragged to the poison scale
+    assert un_mass == 0.0, out          # plain mix has no detection signal
+    for mode in ("clipped", "trimmed"):
+        acc, scale, mass = rows[mode]
+        assert acc >= 0.70, (mode, out)             # honest accuracy kept
+        assert scale < un_scale / 100.0, (mode, out)
+        assert mass > 0.0, (mode, out)              # attack was detected
+
+
 def test_tcp_consensus_async_flags(tmp_path):
     """The --async/--staleness-bound/--deadline-s flags on the
     tcp_consensus example run push-based async rounds end to end: each

@@ -1,0 +1,467 @@
+"""Deterministic fault injection (comm/faults.py) + the wire defenses
+it drives end-to-end.
+
+Three claims pinned here:
+
+* **Determinism** — a :class:`FaultPlan` is a pure function of
+  ``(seed, frame index)``: the same seed replays the identical fault
+  schedule, in any evaluation order (ISSUE 13 acceptance).
+* **Layered rejection** — every injected corruption is rejected BEFORE
+  any payload reaches a consumer: post-crc byte flips fail the frame
+  checksum (``FrameError``, stream evicted), pre-crc truncation arrives
+  checksum-clean and fails the codec's validate-before-scatter checks
+  (``CodecError``, frame dropped + counted, stream KEPT — the framing
+  consumed the body before decode, so alignment survives).
+* **Detection** — protocol-field lies (byzantine mutation) trip the
+  async runtime's wire validation: repeat offenders are quarantined by
+  their neighbors, the master tallies accusations, evicts the peer, and
+  regenerates the topology without it (counters + flight dump recorded).
+
+Also here: the FramedStream adversarial-retry satellite — injected
+transient errnos drive the send-retry loop (``comm.agent.retries``),
+and a rejoin after death drives ``comm.agent.reconnects``.
+"""
+
+import asyncio
+import errno
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.comm import (
+    AsyncGossipRunner,
+    ConsensusAgent,
+    ConsensusMaster,
+    FaultPlan,
+    FaultyStream,
+    inject_neighbor_faults,
+    lying_fields_mutator,
+    poison_value_mutator,
+)
+from distributed_learning_tpu.comm import protocol as P
+from distributed_learning_tpu.comm.framing import (
+    FramedStream,
+    FrameError,
+    FrameTimeout,
+)
+from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
+from distributed_learning_tpu.comm.tensor_codec import CodecError
+from distributed_learning_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    use_registry,
+)
+
+TRIANGLE = [("A", "B"), ("B", "C"), ("C", "A")]
+
+
+# --------------------------------------------------------------------- #
+# FaultPlan: seeded, replayable schedule                                #
+# --------------------------------------------------------------------- #
+def test_fault_plan_schedule_is_seed_deterministic():
+    kw = dict(
+        drop_p=0.1, corrupt_p=0.1, truncate_p=0.1, dup_p=0.1,
+        reorder_p=0.1, byzantine_p=0.1, delay_p=0.3, delay_max_s=0.01,
+    )
+    a = FaultPlan(42, **kw).schedule(300)
+    b = FaultPlan(42, **kw).schedule(300)
+    assert a == b  # identical replay across plan instances
+    # Order independence: decide(i) out of order matches the schedule.
+    plan = FaultPlan(42, **kw)
+    for i in (250, 3, 77, 0, 299):
+        assert plan.decide(i) == a[i]
+    # A different seed deals a different schedule.
+    c = FaultPlan(43, **kw).schedule(300)
+    assert a != c
+    # Every kind actually occurs at these rates over 300 frames.
+    kinds = {d.kind for d in a}
+    assert {"drop", "corrupt", "truncate", "dup", "reorder",
+            "byzantine"} <= kinds
+    assert any(d.delay_s > 0 for d in a)
+    # Deterministic byte mutations too.
+    body = bytes(range(64))
+    assert plan.corrupt_bytes(5, body) == plan.corrupt_bytes(5, body)
+    assert plan.truncate_bytes(5, body) == plan.truncate_bytes(5, body)
+    assert plan.corrupt_bytes(5, body) != body
+    assert 1 <= len(plan.truncate_bytes(5, body)) < len(body)
+
+
+def test_fault_plan_validates_probabilities():
+    with pytest.raises(ValueError, match="must be in"):
+        FaultPlan(0, drop_p=1.5)
+    with pytest.raises(ValueError, match="sum"):
+        FaultPlan(0, drop_p=0.6, corrupt_p=0.6)
+    with pytest.raises(ValueError, match="delay_p"):
+        FaultPlan(0, delay_p=-0.1)
+
+
+def test_fault_plan_crash_at_overrides():
+    plan = FaultPlan(0, drop_p=0.5, crash_at=3)
+    sched = plan.schedule(6)
+    assert all(d.kind != "crash" for d in sched[:3])
+    assert all(d.kind == "crash" for d in sched[3:])
+
+
+def test_byzantine_mutators():
+    val = P.AsyncValue(
+        round_id=7, staleness=1, value=np.ones(4, np.float32)
+    )
+    # Field lies rotate through the three violation arms.
+    assert lying_fields_mutator(0, val).round_id == 2 ** 40
+    assert lying_fields_mutator(1, val).round_id == -1
+    assert lying_fields_mutator(2, val).staleness == -7
+    ok = P.Ok()
+    assert lying_fields_mutator(0, ok) is ok  # non-AsyncValue untouched
+    # Value poison keeps fields legal but scales the payload.
+    poisoned = poison_value_mutator(scale=100.0)(0, val)
+    assert poisoned.round_id == 7 and poisoned.staleness == 1
+    np.testing.assert_array_equal(
+        np.asarray(poisoned.value), np.full(4, 100.0, np.float32)
+    )
+
+
+# --------------------------------------------------------------------- #
+# Wire loopback: the two rejection layers + delivery faults             #
+# --------------------------------------------------------------------- #
+async def _tcp_pair():
+    server_streams = []
+
+    async def on_conn(reader, writer):
+        server_streams.append(FramedStream(reader, writer))
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    client = FramedStream(reader, writer)
+    await asyncio.sleep(0.05)
+    (srv,) = server_streams
+    return server, client, srv
+
+
+def test_corrupt_fails_crc_truncate_fails_codec_stream_survives():
+    async def main():
+        # Post-crc byte flip -> FrameError (a ConnectionError).
+        server, client, srv = await _tcp_pair()
+        faulty = FaultPlan(0, corrupt_p=1.0).wrap(client)
+        await faulty.send(P.Telemetry(token="t", payload={"k": 1}))
+        with pytest.raises(FrameError):
+            await srv.recv(timeout=5.0)
+        assert faulty.counters == {"corrupt": 1}
+        client.close(); srv.close(); server.close()
+
+        # Pre-crc truncation -> checksum-clean frame, CodecError at
+        # decode — and the stream stays ALIGNED: the next clean frame
+        # (sent via the unwrapped inner stream) arrives intact.
+        server, client, srv = await _tcp_pair()
+        faulty = FaultPlan(1, truncate_p=1.0).wrap(client)
+        await faulty.send(
+            P.AsyncValue(round_id=1, staleness=0,
+                         value=np.arange(8, dtype=np.float32))
+        )
+        with pytest.raises(CodecError):
+            await srv.recv(timeout=5.0)
+        await faulty.inner.send(P.Telemetry(token="t", payload={"k": 2}))
+        msg = await srv.recv(timeout=5.0)
+        assert isinstance(msg, P.Telemetry) and msg.payload == {"k": 2}
+        client.close(); srv.close(); server.close()
+        await server.wait_closed()
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_multiplexer_counts_codec_rejection_and_keeps_stream():
+    """The service-point contract: a truncated (checksum-clean) frame is
+    dropped with ``comm.frames_rejected`` bumped, and the SAME stream's
+    next frame is still delivered — no eviction, no desync."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            server, client, srv = await _tcp_pair()
+            mux = StreamMultiplexer({"peer": srv})
+            faulty = FaultPlan(2, truncate_p=1.0).wrap(client)
+            await faulty.send(
+                P.AsyncValue(round_id=1, staleness=0,
+                             value=np.arange(32, dtype=np.float32))
+            )
+            await faulty.inner.send(
+                P.Telemetry(token="t", payload={"ok": True})
+            )
+            token, msg, stream = await asyncio.wait_for(
+                mux.__anext__(), 10.0
+            )
+            # The rejected frame was consumed silently; the first YIELD
+            # is the clean follow-up on the still-registered stream.
+            assert token == "peer" and isinstance(msg, P.Telemetry)
+            assert reg.counters.get("comm.frames_rejected") == 1
+            assert "peer" in mux.tokens()
+            mux.close()
+            client.close(); srv.close(); server.close()
+            await server.wait_closed()
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_drop_dup_reorder_delivery_semantics():
+    async def main():
+        # Drop: nothing arrives (FrameTimeout, stream usable after).
+        server, client, srv = await _tcp_pair()
+        faulty = FaultPlan(3, drop_p=1.0).wrap(client)
+        await faulty.send(P.Ok(info="gone"))
+        with pytest.raises(FrameTimeout):
+            await srv.recv(timeout=0.1)
+        await faulty.inner.send(P.Ok(info="kept"))
+        assert (await srv.recv(timeout=5.0)).info == "kept"
+        client.close(); srv.close(); server.close()
+
+        # Dup: one send, two identical frames.
+        server, client, srv = await _tcp_pair()
+        faulty = FaultPlan(4, dup_p=1.0).wrap(client)
+        await faulty.send(P.Ok(info="twice"))
+        first = await srv.recv(timeout=5.0)
+        second = await srv.recv(timeout=5.0)
+        assert first.info == second.info == "twice"
+        client.close(); srv.close(); server.close()
+
+        # Reorder: frame 0 held, frame 1 jumps the queue.
+        server, client, srv = await _tcp_pair()
+        faulty = FaultPlan(5, reorder_p=1.0).wrap(client)
+        await faulty.send(P.Ok(info="first"))
+        await faulty.send(P.Ok(info="second"))
+        assert (await srv.recv(timeout=5.0)).info == "second"
+        assert (await srv.recv(timeout=5.0)).info == "first"
+        client.close(); srv.close(); server.close()
+        await server.wait_closed()
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_crash_tears_down_transport_abruptly():
+    async def main():
+        server, client, srv = await _tcp_pair()
+        faulty = FaultPlan(6, crash_at=0).wrap(client)
+        with pytest.raises(ConnectionResetError):
+            await faulty.send(P.Ok())
+        with pytest.raises((ConnectionError, asyncio.IncompleteReadError)):
+            await srv.recv(timeout=5.0)
+        srv.close(); server.close()
+        await server.wait_closed()
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+# --------------------------------------------------------------------- #
+# FramedStream adversarial retry / reconnect counters                   #
+# --------------------------------------------------------------------- #
+def test_agent_stream_retries_under_injected_transient_errnos():
+    """Transient errnos injected into a DEPLOYED agent's neighbor
+    stream drive the send-retry loop and land in the agent's counter
+    (``comm.agent.retries``), and the push still completes."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            master = ConsensusMaster(TRIANGLE, convergence_eps=1e-7)
+            host, port = await master.start()
+            agents = {t: ConsensusAgent(t, host, port) for t in "ABC"}
+            await asyncio.gather(*(a.start() for a in agents.values()))
+
+            stream = agents["A"]._neighbors["B"]
+            real_drain = stream.writer.drain
+            failures = [2]
+
+            async def flaky_drain():
+                if failures[0] > 0:
+                    failures[0] -= 1
+                    raise OSError(errno.EAGAIN, "injected")
+                await real_drain()
+
+            stream.writer.drain = flaky_drain
+            before = agents["A"].counters.get("retries", 0)
+            await stream.send(P.Ok(info="through"))
+            assert agents["A"].counters.get("retries", 0) - before == 2
+            assert reg.counters.get("comm.agent.retries", 0) >= 2
+            assert failures[0] == 0  # retried exactly past the faults
+
+            await master.shutdown()
+            for a in agents.values():
+                await a.close(drain=0.1)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_reconnects_counter_after_neighbor_death_and_rejoin():
+    """A fault-injected crash kills B; a replacement rejoins and dials
+    back in — the survivor's ``comm.agent.reconnects`` counter records
+    the healed edge."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            master = ConsensusMaster(
+                TRIANGLE, convergence_eps=1e-7, elastic=True
+            )
+            host, port = await master.start()
+            agents = {t: ConsensusAgent(t, host, port) for t in "ABC"}
+            await asyncio.gather(*(a.start() for a in agents.values()))
+
+            # B's outgoing edge to A crashes on the next push, tearing
+            # its transport; then B's process dies entirely.
+            inject_neighbor_faults(agents["B"], "A", FaultPlan(7, crash_at=0))
+            with pytest.raises(ConnectionResetError):
+                await agents["B"]._neighbors["A"].send(P.Ok())
+            await agents["B"].close()
+            await asyncio.sleep(0.05)
+
+            b2 = ConsensusAgent("B", host, port, rejoin=True)
+            await b2.start()
+            agents["B"] = b2
+            await agents["A"].wait_neighbors(timeout=20.0)
+            assert agents["A"].counters.get("reconnects", 0) >= 1
+            assert reg.counters.get("comm.agent.reconnects", 0) >= 1
+
+            await master.shutdown()
+            for a in agents.values():
+                await a.close(drain=0.1)
+
+    asyncio.run(asyncio.wait_for(main(), 90))
+
+
+# --------------------------------------------------------------------- #
+# Quarantine: lying peer detected, evicted, topology regenerated        #
+# --------------------------------------------------------------------- #
+def test_lying_peer_is_quarantined_and_evicted(tmp_path):
+    """The detection pipeline end-to-end over real TCP: C's pushes carry
+    field lies -> both neighbors hit the violation threshold and
+    quarantine C (drop + counters) -> the master collects the
+    accusations, evicts C, dumps the flight recorder, and regenerates
+    the membership without it."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            flight = FlightRecorder(str(tmp_path))
+            master = ConsensusMaster(
+                TRIANGLE, convergence_eps=1e-7, regenerate=True,
+                flight=flight,
+            )
+            host, port = await master.start()
+            agents = {t: ConsensusAgent(t, host, port) for t in "ABC"}
+            await asyncio.gather(*(a.start() for a in agents.values()))
+
+            runners = {
+                t: AsyncGossipRunner(
+                    agents[t], staleness_bound=1, deadline_s=0.3,
+                    quarantine_after=3,
+                )
+                for t in "ABC"
+            }
+            wA = inject_neighbor_faults(
+                agents["C"], "A", FaultPlan(0, byzantine_p=1.0)
+            )
+            inject_neighbor_faults(
+                agents["C"], "B", FaultPlan(1, byzantine_p=1.0)
+            )
+
+            rng = np.random.default_rng(0)
+            xs = {t: rng.normal(size=8).astype(np.float32) for t in "ABC"}
+            live = ["A", "B", "C"]
+            for _ in range(8):
+                outs = await asyncio.gather(
+                    *(runners[t].run_async_round(xs[t]) for t in live),
+                    return_exceptions=True,
+                )
+                for t, o in zip(list(live), outs):
+                    if isinstance(o, Exception):
+                        live.remove(t)  # C: shutdown / aborted round
+                    else:
+                        xs[t] = o
+                await asyncio.sleep(0.05)
+                if master.counters.get("agents_quarantined"):
+                    break
+
+            # Neighbors detected and cut the liar locally...
+            assert "C" in runners["A"].quarantined
+            assert "C" in runners["B"].quarantined
+            assert agents["A"].counters.get("async_field_violations", 0) >= 3
+            assert agents["A"].counters.get("async_quarantines", 0) == 1
+            # ...the fault log shows the lies that triggered it...
+            assert wA.counters.get("byzantine", 0) >= 3
+            # ...and the master evicted + regenerated without C.
+            assert master.counters.get("quarantine_reports", 0) >= 2
+            assert master.counters.get("agents_quarantined", 0) == 1
+            assert master.counters.get("generations", 0) >= 1
+            dumps = glob.glob(os.path.join(str(tmp_path), "*quarantine*"))
+            assert dumps, "flight recorder dump on quarantine is mandatory"
+            # Registry mirrors (the obs satellite's counter names).
+            assert reg.counters.get("comm.agent.async_quarantines", 0) >= 2
+            assert reg.counters.get("comm.master.agents_quarantined") == 1
+
+            await master.shutdown()
+            for a in agents.values():
+                await a.close(drain=0.1)
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_quarantined_token_cannot_reregister():
+    """Eviction is durable: a process re-presenting the quarantined
+    token is refused at registration (counter: quarantine_rejections)."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            master = ConsensusMaster(
+                TRIANGLE, convergence_eps=1e-7, regenerate=True
+            )
+            host, port = await master.start()
+            agents = {t: ConsensusAgent(t, host, port) for t in "ABC"}
+            await asyncio.gather(*(a.start() for a in agents.values()))
+            runners = {
+                t: AsyncGossipRunner(
+                    agents[t], staleness_bound=1, deadline_s=0.3,
+                    quarantine_after=2,
+                )
+                for t in "AB"
+            }
+            inject_neighbor_faults(
+                agents["C"], "A", FaultPlan(0, byzantine_p=1.0)
+            )
+            inject_neighbor_faults(
+                agents["C"], "B", FaultPlan(1, byzantine_p=1.0)
+            )
+            # C pushes lies directly (no round needed on its side).
+            from distributed_learning_tpu.comm.async_runtime import (
+                AsyncGossipRunner as _R,
+            )
+            liar = _R(agents["C"], staleness_bound=1)
+            rng = np.random.default_rng(0)
+            xs = {t: rng.normal(size=8).astype(np.float32) for t in "ABC"}
+            for _ in range(10):
+                try:
+                    await liar._push(xs["C"])
+                except (ConnectionError, KeyError, RuntimeError):
+                    break
+                await asyncio.gather(
+                    *(runners[t].run_async_round(xs[t]) for t in "AB"),
+                    return_exceptions=True,
+                )
+                await asyncio.sleep(0.02)
+                if master.counters.get("agents_quarantined"):
+                    break
+            assert master.counters.get("agents_quarantined", 0) == 1
+
+            # The evicted token is barred from re-registering.
+            c2 = ConsensusAgent("C", host, port, rejoin=True)
+            with pytest.raises(Exception):
+                await asyncio.wait_for(c2.start(), 10.0)
+            assert master.counters.get("quarantine_rejections", 0) >= 1
+            await c2.close(drain=0.05)
+
+            await master.shutdown()
+            for a in agents.values():
+                await a.close(drain=0.1)
+
+    asyncio.run(asyncio.wait_for(main(), 120))

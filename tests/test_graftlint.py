@@ -793,7 +793,8 @@ def test_report_unverified_cli_smoke():
     out = _cli("--report-unverified", "--rules", "no-pickle")
     # rc 1 is reserved for a live re-verify MISMATCH — a real defect.
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-500:])
-    for name in ("async_stale_mix", "choco_run_fused", "pp_1f1b_head_fn"):
+    for name in ("async_stale_mix", "choco_run_fused", "pp_1f1b_head_fn",
+                 "robust_mix"):
         assert f"unverified pin: {name}" in out.stdout
     assert "provenance:" in out.stdout and "re-verify:" in out.stdout
 

@@ -298,6 +298,38 @@ def test_fused_fuzz_corruption_never_scatters(wire_path):
     assert rejected >= 150, (rejected, cases)
 
 
+def _faultplan_mutants(seed=4242):
+    """The fault harness's two deterministic wire mutations
+    (``comm/faults.py``) applied to every fuzz-corpus base frame:
+    post-crc byte flip (``corrupt_bytes``) and pre-crc truncation
+    re-stamped checksum-clean (``truncate_bytes`` + ``_recrc``).
+    Shared with the ``--native`` sanitizer replay
+    (``tools/graftlint/native_san.py``), so the same mutants that prove
+    semantic rejection here prove memory-safe rejection there."""
+    from distributed_learning_tpu.comm.faults import FaultPlan
+
+    plan = FaultPlan(seed=seed)
+    out = []
+    for i, (frame, flat) in enumerate(_base_frames()):
+        out.append((plan.corrupt_bytes(i, frame), flat.size))
+        out.append((_recrc(plan.truncate_bytes(i, frame[:-4])), flat.size))
+    return out
+
+
+def test_faultplan_corruptions_rejected_before_scatter(wire_path):
+    """ISSUE 13: every corruption the fault-injection harness can put on
+    the wire — the crc-dirty flip AND the crc-clean structural
+    truncation — must raise CodecError before any scatter, on both
+    engines, and the seeded mutant set must replay bit-identically
+    (the FaultPlan determinism contract at the codec boundary)."""
+    mutants = _faultplan_mutants()
+    assert len(mutants) == 2 * len(_base_frames())
+    assert mutants == _faultplan_mutants()  # seeded: replay-identical
+    for mutant, _total in mutants:
+        with pytest.raises((CodecError, ValueError)):
+            decode_fused_sparse(mutant)
+
+
 def test_fused_adversarial_sections_raise_bounds_not_write(wire_path):
     """Targeted adversarial section headers with VALID checksums: the
     bounds check (not the crc) must reject every one before scatter."""

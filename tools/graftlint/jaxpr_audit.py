@@ -626,6 +626,86 @@ def _async_stale_mix_trace():
     return jax.make_jaxpr(program)(x, st)
 
 
+@entry("robust_mix_dense", kind="jaxpr")
+def _robust_mix_dense() -> Counter:
+    """The dense (mesh=None) robust gossip program
+    (``ConsensusEngine.robust_mix_program``, ``parallel/robust.py``) —
+    adaptive clip, 2 rounds — on the FOUR-leaf two-dtype-bucket state:
+    no mesh, no collectives, so the inventory pins empty.  The entry
+    exists so the dataflow stage has a live trace of the robust round
+    (the clip's nanmedian/select structure, the per-round mass
+    accumulation) on every environment, including jax 0.4.x where the
+    shard_map entry below skips."""
+    return collect_collectives(_robust_mix_dense_trace().jaxpr)
+
+
+@trace_entry("robust_mix_dense")
+@functools.lru_cache(maxsize=1)
+def _robust_mix_dense_trace():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    engine = ConsensusEngine(Topology.ring(8).metropolis_weights())
+    x = {
+        "w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        "b": jnp.ones((8, 2), jnp.float32),
+        "s": jnp.zeros((8,), jnp.float32),
+        "h": jnp.ones((8, 3), jnp.bfloat16),
+    }
+    program = engine.robust_mix_program(
+        {"kind": "clip", "radius": 2.0, "adaptive": True}, times=2
+    )
+    return jax.make_jaxpr(program)(x)
+
+
+@entry("robust_mix", kind="jaxpr", requires=("shard_map",))
+def _robust_mix() -> Counter:
+    """The sharded robust gossip round (``robust_mix_program``,
+    trimmed-mean ``trim=1``) on a ring(8) agent mesh over the FOUR-leaf,
+    two-dtype-bucket state, ``times=1``.
+
+    Pin: one round moves the PLAIN round's matching-schedule ppermutes
+    (2 matchings x 2 dtype buckets = 4 — the trimmed round accumulates
+    the plain round bitwise and then corrects it), plus ONE all_gather
+    per dtype BUCKET for the coordinate ranks (2), plus exactly ONE psum
+    — the redirected-mass statistic summed over agents (the suppression
+    claim on its ``lax.psum`` in ``parallel/robust.py``).  Extra
+    all_gathers (4 = the leaf count) mean the rank pass stopped running
+    on the fused buffers and pays per leaf; a second psum means the
+    trim correction itself silently went collective (it must be local
+    arithmetic on the gathered ranks).
+    """
+    return collect_collectives(_robust_mix_trace().jaxpr)
+
+
+@trace_entry("robust_mix")
+@functools.lru_cache(maxsize=1)
+def _robust_mix_trace():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+    from distributed_learning_tpu.parallel.topology import Topology
+
+    mesh = _mesh((8,), ("agents",))
+    engine = ConsensusEngine(
+        Topology.ring(8).metropolis_weights(), mesh=mesh
+    )
+    x = {
+        "w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        "b": jnp.ones((8, 2), jnp.float32),
+        "s": jnp.zeros((8,), jnp.float32),
+        "h": jnp.ones((8, 3), jnp.bfloat16),
+    }
+    program = engine.robust_mix_program(
+        {"kind": "trim", "trim": 1}, times=1
+    )
+    return jax.make_jaxpr(program)(x)
+
+
 def _cost_drift(exp_cost: Optional[dict],
                 obs_cost: Optional[dict]) -> List[str]:
     """Human-readable drifts of the pinned cost columns beyond their

@@ -304,6 +304,25 @@ def _replay() -> int:
             continue
         assert out.shape == (flat.size,)
 
+    # --- FaultPlan harness mutants (ISSUE 13): the fault injector's
+    # deterministic corrupt/truncate mutations must reject with
+    # CodecError under the instrumented engine too, then ride the raw
+    # redzoned replay below with the rest of the corpus. ------------- #
+    fault_mutants = corpus._faultplan_mutants()
+    fault_cases = 0
+    for mutant, _total in fault_mutants:
+        try:
+            tc.decode_fused_sparse(mutant)
+        except (tc.CodecError, ValueError):
+            fault_cases += 1
+            continue
+        print(
+            "native-san-replay: faultplan mutant decoded instead of "
+            "rejecting", file=sys.stderr,
+        )
+        return 4
+    mutants.extend(fault_mutants)
+
     # --- Raw C entry points on sanitizer-malloc'd (redzoned) buffers -- #
     import ctypes
 
@@ -367,7 +386,7 @@ def _replay() -> int:
     print(
         "native-san-replay: ok "
         f"(oracle={oracle_cases} fuzz={fuzz_cases} rejected={rejected} "
-        f"raw={raw_cases})"
+        f"fault={fault_cases} raw={raw_cases})"
     )
     return 0
 
