@@ -26,6 +26,14 @@ injected sleeps).  Also recorded: the straggler's own completed rounds
 and the staleness counters (``comm.agent.async_stale_mixed`` /
 ``async_stale_dropped`` / ``pokes_sent``) — the observability the
 convergence-vs-staleness analysis reads.
+
+**Trace-plane gate (ISSUE 14): tracing ON costs <= 5% rounds/sec.**
+The async measurement repeats with ``ConsensusAgent(trace=True)`` —
+every frame stamped with a wire ``TraceContext`` and the full
+encode/send/recv/decode/mix flow-event chain emitted per frame.  Both
+modes take the best of ``repeats`` runs (noise pushes rates DOWN, so
+max-of-N is the stable estimator for a sleep-dominated workload), and
+``trace_overhead_pct`` must stay within ``trace_gate`` (5%).
 """
 
 from __future__ import annotations
@@ -48,10 +56,13 @@ TOKENS = ("1", "2", "3", "4")
 SLOW = "4"
 
 
-async def _deploy():
+async def _deploy(trace: bool = False):
     master = ConsensusMaster(RING4, convergence_eps=1e-6)
     host, port = await master.start()
-    agents = {t: ConsensusAgent(t, host, port) for t in TOKENS}
+    agents = {
+        t: ConsensusAgent(t, host, port, trace=trace, trace_run_id=14)
+        for t in TOKENS
+    }
     await asyncio.gather(*(a.start() for a in agents.values()))
     return master, agents
 
@@ -88,9 +99,9 @@ async def _lockstep(rounds: int, base_s: float, slow_s: float) -> float:
 
 async def _async_mode(
     rounds: int, base_s: float, slow_s: float,
-    tau: int, deadline_s: float,
+    tau: int, deadline_s: float, trace: bool = False,
 ):
-    master, agents = await _deploy()
+    master, agents = await _deploy(trace=trace)
     runners = {
         t: AsyncGossipRunner(
             agents[t], staleness_bound=tau, deadline_s=deadline_s
@@ -141,23 +152,40 @@ def run(
     slow_s: float = 0.05,
     tau: int = 2,
     deadline_s: float = 0.01,
+    repeats: int = 2,
 ) -> dict:
     """Lock-step vs async rounds/sec with the 10x straggler; emits one
-    record with the >= 2x gate verdict."""
+    record with the >= 2x gate verdict and the trace-plane <= 5%
+    overhead verdict."""
     if rounds is None:
         rounds = 12 if common.smoke() else 40
 
     async def main():
         lock = await _lockstep(rounds, base_s, slow_s)
-        rate, slow_rounds, counters = await _async_mode(
-            rounds, base_s, slow_s, tau, deadline_s
-        )
-        return lock, rate, slow_rounds, counters
+        # Best-of-N per mode: the workload is sleep-dominated, so
+        # scheduling noise only ever DEPRESSES a measured rate — the max
+        # over repeats is the low-variance estimator for both modes.
+        rate = 0.0
+        slow_rounds, counters = 0, {}
+        for _ in range(max(1, repeats)):
+            r, sr, cs = await _async_mode(
+                rounds, base_s, slow_s, tau, deadline_s
+            )
+            if r > rate:
+                rate, slow_rounds, counters = r, sr, cs
+        traced = 0.0
+        for _ in range(max(1, repeats)):
+            r, _, _ = await _async_mode(
+                rounds, base_s, slow_s, tau, deadline_s, trace=True
+            )
+            traced = max(traced, r)
+        return lock, rate, slow_rounds, counters, traced
 
-    lock, rate, slow_rounds, counters = asyncio.run(
+    lock, rate, slow_rounds, counters, traced = asyncio.run(
         asyncio.wait_for(main(), 600)
     )
     speedup = rate / lock
+    trace_overhead_pct = (rate - traced) / rate * 100.0
     return common.emit(
         {
             "bench": "async_gossip_straggler",
@@ -166,6 +194,10 @@ def run(
             "async_speedup": speedup,
             "gate": 2.0,
             "gate_passed": bool(speedup >= 2.0),
+            "traced_rounds_per_sec": traced,
+            "trace_overhead_pct": trace_overhead_pct,
+            "trace_gate": 5.0,
+            "trace_gate_passed": bool(trace_overhead_pct <= 5.0),
             "rounds": rounds,
             "straggler_rounds": slow_rounds,
             "staleness_bound": tau,

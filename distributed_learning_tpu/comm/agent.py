@@ -23,6 +23,7 @@ bandwidth.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import enum
 import logging
 import time
@@ -36,6 +37,7 @@ from distributed_learning_tpu.comm import protocol as P
 from distributed_learning_tpu.obs import (
     MetricsRegistry,
     ObsDeltaSource,
+    emit_flow,
     get_registry,
 )
 
@@ -93,6 +95,8 @@ class ConsensusAgent:
         rejoin: bool = False,
         debug: bool = False,
         obs: Optional[MetricsRegistry] = None,
+        trace: bool = False,
+        trace_run_id: int = 0,
     ):
         if bf16_wire and int8_wire:
             raise ValueError("bf16_wire and int8_wire are mutually exclusive")
@@ -232,6 +236,24 @@ class ConsensusAgent:
         )
         self._obs_task: Optional[asyncio.Task] = None
         self._obs_period = 1.0
+        # Wire trace plane (docs/observability.md §Trace plane): when on,
+        # every outgoing value response carries a protocol.TraceContext
+        # (run_id, origin=token, seq, t_wall) and both ends of the edge
+        # emit paired ``trace.flow`` events — encode/send here,
+        # recv/decode/mix at the receiver — so the merged Perfetto trace
+        # arrow-links each frame's causal chain across process tracks.
+        # Off (the default) the trace trailer is absent on the wire and
+        # no flow events are emitted: the <=5% rounds/sec overhead gate
+        # (benchmarks/bench_async_gossip.py) measures exactly this flag.
+        self.trace = bool(trace)
+        self._trace_run_id = int(trace_run_id)
+        # One per-agent frame counter: (run_id, origin, seq) is then
+        # fleet-unique without per-edge bookkeeping.
+        self._trace_seq = 0
+        # Traces of the responses accepted by the exchange in flight,
+        # held until the mix step consumes them (the "mix" hop closes
+        # the frame's flow chain).
+        self._recv_traces: Dict[str, P.TraceContext] = {}
 
     # ------------------------------------------------------------------ #
     def _debug(self, msg: str, *args):
@@ -258,6 +280,63 @@ class ConsensusAgent:
         """FramedStream retry hook: a transient socket error was retried
         instead of aborting the round."""
         self._count("retries")
+
+    # ------------------------------------------------------------------ #
+    # Wire trace plane (docs/observability.md §Trace plane)              #
+    # ------------------------------------------------------------------ #
+    def _emit_flow(self, phase: str, tc: "P.TraceContext", edge: str,
+                   **fields) -> None:
+        """One frame-lifecycle hop into the default registry (and the
+        per-agent ``obs=`` registry) — the same dual-mirror discipline
+        as :meth:`_count`."""
+        emit_flow(
+            get_registry(), phase, origin=tc.origin, seq=tc.seq,
+            run_id=tc.run_id, edge=edge, **fields,
+        )
+        if self._obs is not None and self._obs is not get_registry():
+            emit_flow(
+                self._obs, phase, origin=tc.origin, seq=tc.seq,
+                run_id=tc.run_id, edge=edge, **fields,
+            )
+
+    def _stamp_trace(self, msg, dest: str):
+        """Attach a fresh :class:`~distributed_learning_tpu.comm.protocol.
+        TraceContext` to an outgoing value response and emit its
+        "encode" hop.  No-op when tracing is off (the trailer stays
+        absent on the wire — one sentinel byte)."""
+        if not self.trace:
+            return msg
+        self._trace_seq += 1
+        tc = P.TraceContext(
+            run_id=self._trace_run_id, origin=self.token,
+            seq=self._trace_seq, t_wall=time.time(),
+        )
+        msg = dataclasses.replace(msg, trace=tc)
+        self._emit_flow("encode", tc, f"{self.token}->{dest}")
+        return msg
+
+    def _note_recv_trace(self, token: str, tc: "P.TraceContext") -> None:
+        """Receiver half of a traced frame: emit the "recv" and "decode"
+        hops with the SENDER's trace fields (both ends must replay the
+        same (run_id, origin, seq) or the chain breaks) and observe the
+        edge's wall-clock transit latency into ``comm.edge.latency_s``."""
+        edge = f"{token}->{self.token}"
+        self._recv_traces[token] = tc
+        self._emit_flow("recv", tc, edge)
+        self._emit_flow("decode", tc, edge)
+        if tc.t_wall:
+            # graftlint: disable=wallclock-duration -- cross-process edge latency: t_wall is the SENDER's wall-clock send stamp; monotonic clocks cannot compare across processes
+            self._observe(f"comm.edge.latency_s/{edge}", time.time() - tc.t_wall)
+
+    def _emit_mix(self, tokens) -> None:
+        """Emit the "mix" hop for each traced frame this mix step
+        consumed — closing those frames' flow chains."""
+        if not self.trace:
+            return
+        for t in tokens:
+            tc = self._recv_traces.pop(t, None)
+            if tc is not None:
+                self._emit_flow("mix", tc, f"{t}->{self.token}")
 
     @property
     def generation(self) -> int:
@@ -481,6 +560,11 @@ class ConsensusAgent:
             self._tag_realigned = False
             self._count("reconnects")
         self._ever_connected.add(token)
+        # Edge observatory: label the stream with its directed edge so
+        # framing attributes bytes/frames/retries to ``comm.edge.*``
+        # per-edge counters (docs/observability.md §Per-edge observatory).
+        stream.edge = (self.token, token)
+        stream.obs = self._obs
         self._neighbors[token] = stream
         self._mux.add(token, stream)
 
@@ -510,9 +594,12 @@ class ConsensusAgent:
             self._count("stale_requests_dropped")
             return  # stale (finished op/iteration): drop
         self._count("responses_sent")
-        await self._neighbors[token].send(
-            self._make_response(req.round_id, req.iteration, value)
+        resp = self._stamp_trace(
+            self._make_response(req.round_id, req.iteration, value), token
         )
+        await self._neighbors[token].send(resp)
+        if resp.trace is not None:
+            self._emit_flow("send", resp.trace, f"{self.token}->{token}")
 
     def _sparse_wins(self, value) -> bool:
         """Whether the sparse wire beats dense for this value: its density
@@ -574,11 +661,15 @@ class ConsensusAgent:
             if stream is None:
                 continue  # edge removed by a membership generation
             self._count("responses_sent")
-            await stream.send(
+            resp = self._stamp_trace(
                 self._make_response(
                     self._op_id, self._iteration, self._iter_value
-                )
+                ),
+                token,
             )
+            await stream.send(resp)
+            if resp.trace is not None:
+                self._emit_flow("send", resp.trace, f"{self.token}->{token}")
         # Drop stale deferral keys from finished ops/iterations.
         for k in [k for k in self._deferred if k < key]:
             del self._deferred[k]
@@ -616,6 +707,7 @@ class ConsensusAgent:
         for token in sorted(set(self._weights) - set(values)):
             # Dropped-from-round neighbor: its mass renormalizes to self.
             out = out + self._weights[token] * y
+        self._emit_mix(sorted(values))
         return out
 
     async def _exchange_values(
@@ -626,6 +718,7 @@ class ConsensusAgent:
         if a master Done ended the round mid-exchange."""
         if active is None:
             active = self._active_tokens()
+        self._recv_traces = {}
         self._prev_value = self._iter_value
         self._prev_key = self._iter_key
         self._iter_value = y
@@ -689,6 +782,8 @@ class ConsensusAgent:
                     self._iteration,
                 ):
                     values[token] = msg.value
+                    if self.trace and msg.trace is not None:
+                        self._note_recv_trace(token, msg.trace)
                 # else stale response from an aborted iteration: drop.
             elif isinstance(msg, P.Done) and msg.round_id == self._round_id:
                 if msg.aborted:
@@ -925,6 +1020,7 @@ class ConsensusAgent:
                 self._choco_hat_nbrs[t] - self._choco_hat_self
             )
         # Self term of sum_j W_ij (xhat_j - xhat_i): j = i contributes 0.
+        self._emit_mix(sorted(neighbor_qs))
         return out
 
     async def run_choco_tree(

@@ -103,11 +103,16 @@ class _Inbox:
     __slots__ = (
         "queue", "last", "times_mixed", "dropped", "choco_lag",
         "violations", "seen_gen", "seen_round", "seen_stale",
+        "last_trace",
     )
 
     def __init__(self):
-        self.queue: deque = deque()  # (value, sender_round, staleness)
+        self.queue: deque = deque()  # (value, sender_round, staleness, trace)
         self.last: Optional[np.ndarray] = None
+        # TraceContext of the frame `last` came from (None untraced):
+        # consumed by the first mix of that frame — the "mix" hop that
+        # closes its flow chain in the merged trace.
+        self.last_trace = None
         self.times_mixed = 0  # rounds `last` was already mixed
         self.dropped = False  # sticky: dropped until a fresh arrival
         self.choco_lag = 0  # consecutive rounds without a correction
@@ -324,10 +329,16 @@ class AsyncGossipRunner:
         )
         a._count("async_pushes")
         for token in self._active():
+            # Trace stamping is per NEIGHBOR (the edge label and seq
+            # differ per destination): replace on the shared base frame.
+            out = a._stamp_trace(msg, token)
             try:
-                await a._neighbors[token].send(msg)
+                await a._neighbors[token].send(out)
             except (ConnectionError, OSError):
                 self._box(token).dropped = True
+                continue
+            if out.trace is not None:
+                a._emit_flow("send", out.trace, f"{a.token}->{token}")
 
     async def _answer_poke(self, token: str) -> None:
         """Re-send the standing published value to a poked-by neighbor
@@ -339,17 +350,21 @@ class AsyncGossipRunner:
         kind = P._ASYNC_SPARSE if (
             a.sparse_wire and a._sparse_wins(self._pub_value)
         ) else P._ASYNC_DENSE
+        msg = a._stamp_trace(
+            P.AsyncValue(
+                round_id=self._pub_round, generation=a._generation,
+                staleness=self._round - self._pub_round,
+                value=self._pub_value, kind=kind,
+                bf16_wire=a.bf16_wire, int8_wire=a._int8_active,
+            ),
+            token,
+        )
         try:
-            await a._neighbors[token].send(
-                P.AsyncValue(
-                    round_id=self._pub_round, generation=a._generation,
-                    staleness=self._round - self._pub_round,
-                    value=self._pub_value, kind=kind,
-                    bf16_wire=a.bf16_wire, int8_wire=a._int8_active,
-                )
-            )
+            await a._neighbors[token].send(msg)
         except (ConnectionError, OSError):
-            pass
+            return
+        if msg.trace is not None:
+            a._emit_flow("send", msg.trace, f"{a.token}->{token}")
 
     async def _poke(self, token: str) -> None:
         """The re-request half of drop-and-re-request: ask a
@@ -437,9 +452,20 @@ class AsyncGossipRunner:
                 return
             box = self._box(token)
             box.queue.append(
-                (msg.value, msg.round_id, msg.staleness)
+                (msg.value, msg.round_id, msg.staleness, msg.trace)
             )
             box.dropped = False
+            if a.trace and msg.trace is not None:
+                # Receiver half of the traced frame: recv+decode hops
+                # (the frame body was decoded by the recv that produced
+                # msg) plus the edge's wall-clock transit latency.
+                edge = f"{token}->{a.token}"
+                a._emit_flow("recv", msg.trace, edge)
+                a._emit_flow("decode", msg.trace, edge)
+                if msg.trace.t_wall:
+                    # graftlint: disable=wallclock-duration -- cross-process edge latency: t_wall is the SENDER's wall-clock send stamp; monotonic clocks cannot compare across processes
+                    lat = time.time() - msg.trace.t_wall
+                    a._observe(f"comm.edge.latency_s/{edge}", lat)
             # graftlint: disable=task-shared-mutation -- arrival-clears-excursion FIFO discipline: the discard runs at the single dispatch service point (inside the round task's _recv_step await), and _poke only re-adds after _collect has re-checked _needs_fresh on the post-arrival state
             self._poked.discard(token)
             a._count("async_values_received")
@@ -501,12 +527,13 @@ class AsyncGossipRunner:
         box = self._box(token)
         if box.queue:
             if self.tau == 0:
-                value, _, sent_stale = box.queue.popleft()
+                value, _, sent_stale, trace = box.queue.popleft()
             else:
                 stats.skipped += len(box.queue) - 1
-                value, _, sent_stale = box.queue[-1]
+                value, _, sent_stale, trace = box.queue[-1]
                 box.queue.clear()
             box.last = value
+            box.last_trace = trace
             box.times_mixed = 0
             box.dropped = False
         return box
@@ -540,11 +567,17 @@ class AsyncGossipRunner:
                 a._count("async_stale_mixed")
                 w_eff = w / (1.0 + s)
                 out = out + w_eff * box.last + (w - w_eff) * y
+            if usable and s == 0 and box.last_trace is not None:
+                # First mix of this frame closes its flow chain; stale
+                # re-mixes of the standing value don't re-emit.
+                a._emit_flow("mix", box.last_trace, f"{token}->{a.token}")
+                box.last_trace = None
             box.times_mixed += 1
+            stale_pt = float(s if usable else self.tau + 1)
+            a._observe("comm.agent.staleness", stale_pt, step=self._round)
             a._observe(
-                "comm.agent.staleness",
-                float(s if usable else self.tau + 1),
-                step=self._round,
+                f"comm.edge.staleness/{token}->{a.token}",
+                stale_pt, step=self._round,
             )
         return out
 
@@ -681,11 +714,16 @@ class AsyncGossipRunner:
                 else:
                     batch = list(box.queue)
                     box.queue.clear()
-                for qn, _, _ in batch:
+                for qn, _, _, qtrace in batch:
                     a._choco_hat_nbrs[token] = a._choco_hat_nbrs[
                         token
                     ] + np.asarray(qn, np.float32).ravel()
                     applied += 1
+                    if a.trace and qtrace is not None:
+                        # Applying the correction is this frame's mix hop.
+                        a._emit_flow(
+                            "mix", qtrace, f"{token}->{a.token}"
+                        )
                 box.choco_lag = 0
                 box.dropped = False
             else:
@@ -699,6 +737,10 @@ class AsyncGossipRunner:
             a._observe(
                 "comm.agent.staleness", float(box.choco_lag),
                 step=self._round,
+            )
+            a._observe(
+                f"comm.edge.staleness/{token}->{a.token}",
+                float(box.choco_lag), step=self._round,
             )
             out += gamma * a._weights[token] * (
                 a._choco_hat_nbrs[token] - a._choco_hat_self

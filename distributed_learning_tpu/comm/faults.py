@@ -176,8 +176,9 @@ class FaultPlan:
         keep = int(rng.integers(1, len(body)))
         return body[:keep]
 
-    def wrap(self, stream: FramedStream) -> "FaultyStream":
-        return FaultyStream(stream, self)
+    def wrap(self, stream: FramedStream, *, peer: str = "",
+             edge: str = "") -> "FaultyStream":
+        return FaultyStream(stream, self, peer=peer, edge=edge)
 
 
 def lying_fields_mutator(index: int, msg: Any) -> Any:
@@ -226,23 +227,37 @@ class FaultyStream:
     Visible state: ``send_index`` (frames offered so far), ``events``
     (``(index, kind)`` log, the replay-assertion surface), ``counters``
     (per-kind tallies, also mirrored into the obs registry as
-    ``comm.faults.<kind>``).
+    ``comm.faults.<kind>`` — plus ``comm.faults.<kind>/<edge>`` and a
+    ``comm.fault`` registry event carrying (fault, peer, frame_index,
+    round) when the wrapper knows its edge, so every injected decision
+    is attributable in the merged run log and the per-edge profile).
     """
 
-    def __init__(self, inner: FramedStream, plan: FaultPlan):
+    def __init__(self, inner: FramedStream, plan: FaultPlan, *,
+                 peer: str = "", edge: str = ""):
         self.inner = inner
         self.plan = plan
+        self.peer = peer
+        self.edge = edge  # directed "src->dst" label, "" when unknown
         self.send_index = 0
         self.events: List[Tuple[int, str]] = []
         self.counters: Dict[str, int] = {}
         self._held: Optional[bytes] = None  # reorder buffer (one frame)
+        self._round: Optional[int] = None  # round_id of the frame in flight
 
     def _note(self, index: int, kind: str) -> None:
         if kind == "none":
             return
         self.events.append((index, kind))
         self.counters[kind] = self.counters.get(kind, 0) + 1
-        get_registry().inc(f"comm.faults.{kind}")
+        reg = get_registry()
+        reg.inc(f"comm.faults.{kind}")
+        if self.edge:
+            reg.inc(f"comm.faults.{kind}/{self.edge}")
+        reg.event(
+            "comm.fault", fault=kind, peer=self.peer,
+            frame_index=index, round=self._round, edge=self.edge,
+        )
 
     def _encode(self, msg: Any, decision: FaultDecision, index: int) -> bytes:
         code, body = P.pack_message(msg)
@@ -265,6 +280,7 @@ class FaultyStream:
         index = self.send_index
         self.send_index += 1
         decision = self.plan.decide(index)
+        self._round = getattr(msg, "round_id", None)
         self._note(index, decision.kind)
         if decision.kind == "crash":
             # Mid-round agent crash: abrupt transport teardown — the
@@ -317,6 +333,8 @@ def inject_neighbor_faults(
     deployment into a byzantine one.  Returns the wrapper (its
     ``events``/``counters`` are the assertion surface)."""
     stream = agent._neighbors[token]
-    wrapped = plan.wrap(stream)
+    wrapped = plan.wrap(
+        stream, peer=token, edge=f"{agent.token}->{token}"
+    )
     agent._neighbors[token] = wrapped
     return wrapped

@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 import errno
 import struct
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 from distributed_learning_tpu import native
 from distributed_learning_tpu.comm.protocol import Message, pack_message, unpack_message
@@ -30,7 +30,13 @@ __all__ = [
     "open_framed_connection",
 ]
 
-WIRE_VERSION = 1
+#: v2: value-bearing bodies (ValueResponse*/AsyncValue/AsyncPoke) carry
+#: the trace-context trailer of ``protocol.TraceContext`` — a layout
+#: change, so v1 peers must be rejected at the frame header.
+#: Cross-checked against ``native/wire.cpp``'s ``kWireVersion`` and
+#: ``dlt_abi.h``'s ``DLT_WIRE_VERSION`` by graftlint's wire-contract
+#: stage — bump all three together, then repin with ``--audit-write``.
+WIRE_VERSION = 2
 _HEADER = struct.Struct("<IBBH")
 MAX_FRAME = 1 << 31  # 2 GiB: a full WRN-28-10 f32 vector is ~146 MB
 
@@ -64,7 +70,18 @@ class FramedStream:
     ``frames_received`` count whole frames (header + body + crc) — the
     "bytes framed" wire-volume metric; the totals also aggregate into
     the default obs registry (``comm.bytes_framed_out/in``,
-    ``comm.frames_out/in``)."""
+    ``comm.frames_out/in``).
+
+    When the owner labels the stream with its directed ``edge``
+    (``(local_token, peer_token)``, set by ``ConsensusAgent`` at
+    neighbor-install time), every frame is additionally attributed to
+    that edge: ``comm.edge.bytes_out/<local>-><peer>``,
+    ``comm.edge.frames_out/...``, the mirrored ``bytes_in``/
+    ``frames_in`` under the reverse direction, and
+    ``comm.edge.retries/...`` — the per-edge wire observatory
+    (``obs/aggregate.py:edge_profile_from_registry``).  ``obs`` is an
+    optional second registry (the owning agent's private one) the same
+    counters mirror into so they ride the agent's telemetry deltas."""
 
     def __init__(
         self,
@@ -89,6 +106,21 @@ class FramedStream:
         self.send_retries = int(send_retries)
         self.retry_base_s = float(retry_base_s)
         self.on_retry = on_retry
+        # Directed-edge attribution (set post-construction by the owner
+        # once the peer's token is known, e.g. after the Register
+        # handshake): (local_token, peer_token), plus an optional extra
+        # registry the edge counters mirror into.
+        self.edge: Optional[Tuple[str, str]] = None
+        self.obs = None
+
+    def _edge_inc(self, name: str, forward: bool, v: float = 1.0) -> None:
+        if self.edge is None:
+            return
+        a, b = self.edge if forward else (self.edge[1], self.edge[0])
+        full = f"{name}/{a}->{b}"
+        get_registry().inc(full, v)
+        if self.obs is not None:
+            self.obs.inc(full, v)
 
     @property
     def peername(self):
@@ -116,6 +148,7 @@ class FramedStream:
                     if not transient or attempt >= self.send_retries:
                         raise
                     get_registry().inc("comm.frame_retries")
+                    self._edge_inc("comm.edge.retries", forward=True)
                     if self.on_retry is not None:
                         self.on_retry()
                     await asyncio.sleep(self.retry_base_s * (2 ** attempt))
@@ -125,6 +158,8 @@ class FramedStream:
         reg = get_registry()
         reg.inc("comm.bytes_framed_out", nbytes)
         reg.inc("comm.frames_out")
+        self._edge_inc("comm.edge.bytes_out", forward=True, v=nbytes)
+        self._edge_inc("comm.edge.frames_out", forward=True)
 
     async def recv(self, timeout: Optional[float] = None) -> Message:
         if timeout is None:
@@ -160,6 +195,11 @@ class FramedStream:
         reg = get_registry()
         reg.inc("comm.bytes_framed_in", _HEADER.size + length + 4)
         reg.inc("comm.frames_in")
+        self._edge_inc(
+            "comm.edge.bytes_in", forward=False,
+            v=_HEADER.size + length + 4,
+        )
+        self._edge_inc("comm.edge.frames_in", forward=False)
         return unpack_message(code, body)
 
     def close(self) -> None:

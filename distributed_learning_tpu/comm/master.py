@@ -37,7 +37,12 @@ import numpy as np
 from distributed_learning_tpu.comm.framing import FramedStream
 from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
 from distributed_learning_tpu.comm import protocol as P
-from distributed_learning_tpu.obs import FlightRecorder, RunAggregator, get_registry
+from distributed_learning_tpu.obs import (
+    FlightRecorder,
+    HealthSentinel,
+    RunAggregator,
+    get_registry,
+)
 from distributed_learning_tpu.parallel.fast_averaging import solve_fastest_mixing
 from distributed_learning_tpu.parallel.topology import Topology
 from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
@@ -62,6 +67,7 @@ class ConsensusMaster:
         debug: bool = False,
         aggregator: Optional[RunAggregator] = None,
         flight: Optional[FlightRecorder] = None,
+        sentinel: Optional["HealthSentinel"] = None,
         round_deadline_s: Optional[float] = None,
         enforce_round_deadline: bool = False,
         quarantine_quorum: int = 1,
@@ -117,6 +123,17 @@ class ConsensusMaster:
         if (aggregator is not None and flight is not None
                 and aggregator.flight is None):
             aggregator.flight = flight  # merged events feed the rings
+        # Online health sentinel (docs/observability.md §Health
+        # sentinel): evaluated against the aggregator's merged registry
+        # after every telemetry batch, so a stalled residual, a
+        # staleness blow-up, or a wire error storm is detected DURING
+        # the run — breaches emit health.* events and trigger
+        # reason-tagged flight dumps.  Wired to the shared flight
+        # recorder when the caller left the sentinel's own unset.
+        self.sentinel = sentinel
+        if (sentinel is not None and flight is not None
+                and sentinel.flight is None):
+            sentinel.flight = flight
         self.round_deadline_s = (
             None if round_deadline_s is None else float(round_deadline_s)
         )
@@ -554,6 +571,15 @@ class ConsensusMaster:
                         self.aggregator.process(
                             msg.token or token, msg.payload
                         )
+                        if self.sentinel is not None:
+                            # Never-fatal, like _flight_dump: the health
+                            # plane must not crash the control plane.
+                            try:
+                                self.sentinel.evaluate()
+                            except Exception as exc:  # pragma: no cover
+                                self._debug(
+                                    "sentinel evaluate failed: %r", exc
+                                )
                     if self.telemetry is not None:
                         self.telemetry.process(msg.token or token, msg.payload)
                 elif isinstance(msg, P.ErrorException):

@@ -60,12 +60,73 @@ __all__ = [
     "ValueResponseFusedSparse",
     "AsyncValue",
     "AsyncPoke",
+    "TraceContext",
+    "TRACE_CTX_VERSION",
     "pack_message",
     "unpack_message",
     "OBS_PAYLOAD_KIND",
     "OBS_PAYLOAD_VERSION",
     "is_obs_payload",
 ]
+
+#: Version of the trace-context trailer carried by the value-bearing
+#: frames (ValueResponse*/AsyncValue/AsyncPoke).  Wire surface: the
+#: layout below is cross-checked against ``native/wire.cpp``'s
+#: ``kTraceCtxVersion`` and ``dlt_abi.h``'s ``DLT_TRACE_CTX_VERSION``
+#: by graftlint's wire-contract stage — bump all three together.
+TRACE_CTX_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Compact per-frame trace identity carried on the gossip wire.
+
+    ``(run_id, origin, seq)`` names one frame fleet-uniquely: ``origin``
+    is the sending agent's token and ``seq`` its per-edge frame counter,
+    so the obs plane can flow-link the sender's encode/send events to
+    the receiver's recv/decode/mix events for the same frame
+    (``obs/spans.py`` flow events -> ``RunAggregator.to_chrome_trace``
+    arrows).  ``t_wall`` is the sender's wall-clock send stamp, used by
+    the receiver for per-edge wire latency (wall clock on purpose: it is
+    the only clock two processes share).  Generation and round already
+    travel in the host messages (``AsyncValue.round_id/generation``,
+    ``ValueResponse.round_id/iteration``), so they are not duplicated
+    here.
+
+    Trailer layout (appended at the END of the host frame's body):
+    ``u8 present | u32 run_id | i64 seq | f64 t_wall | str origin``.
+    An absent context packs as the single byte 0, and a body with no
+    trailer at all unpacks as ``trace=None`` — both directions
+    round-trip ``None`` exactly.
+    """
+
+    run_id: int = 0
+    origin: str = ""
+    seq: int = 0
+    t_wall: float = 0.0
+
+
+_TRACE_FIXED = struct.Struct("<Iqd")
+
+
+def _pack_trace(tc: Optional[TraceContext]) -> bytes:
+    if tc is None:
+        return b"\x00"
+    return (
+        b"\x01"
+        + _TRACE_FIXED.pack(tc.run_id, tc.seq, tc.t_wall)
+        + _pack_str(tc.origin)
+    )
+
+
+def _unpack_trace(buf: bytes, off: int) -> Optional[TraceContext]:
+    if off >= len(buf) or buf[off] == 0:
+        return None
+    run_id, seq, t_wall = _TRACE_FIXED.unpack_from(buf, off + 1)
+    origin, _ = _unpack_str(buf, off + 1 + _TRACE_FIXED.size)
+    return TraceContext(
+        run_id=run_id, origin=origin, seq=seq, t_wall=t_wall
+    )
 
 
 def _pack_str(s: str) -> bytes:
@@ -301,18 +362,24 @@ class ValueResponse(Message):
     value: Optional[np.ndarray] = None
     bf16_wire: bool = False
     int8_wire: bool = False
+    trace: Optional[TraceContext] = None
 
     def _pack(self) -> bytes:
         v = self.value if self.value is not None else np.zeros(0, np.float32)
-        return struct.pack("<qq", self.round_id, self.iteration) + _pack_tensor(
-            np.asarray(v), self.bf16_wire, self.int8_wire
+        return (
+            struct.pack("<qq", self.round_id, self.iteration)
+            + _pack_tensor(np.asarray(v), self.bf16_wire, self.int8_wire)
+            + _pack_trace(self.trace)
         )
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "ValueResponse":
         r, i = struct.unpack_from("<qq", buf, 0)
-        value, _ = _unpack_tensor(buf, 16)
-        return cls(round_id=r, iteration=i, value=value)
+        value, off = _unpack_tensor(buf, 16)
+        return cls(
+            round_id=r, iteration=i, value=value,
+            trace=_unpack_trace(buf, off),
+        )
 
 
 @dataclasses.dataclass
@@ -427,6 +494,7 @@ class ValueResponseSparse(Message):
     value: Optional[np.ndarray] = None
     bf16_wire: bool = False
     int8_wire: bool = False
+    trace: Optional[TraceContext] = None
 
     def _pack(self) -> bytes:
         from distributed_learning_tpu.comm.tensor_codec import encode_sparse
@@ -434,14 +502,21 @@ class ValueResponseSparse(Message):
         v = self.value if self.value is not None else np.zeros(0, np.float32)
         t = encode_sparse(np.asarray(v), bf16_wire=self.bf16_wire,
                           int8_wire=self.int8_wire)
-        return struct.pack("<qqI", self.round_id, self.iteration, len(t)) + t
+        return (
+            struct.pack("<qqI", self.round_id, self.iteration, len(t))
+            + t
+            + _pack_trace(self.trace)
+        )
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "ValueResponseSparse":
         from distributed_learning_tpu.comm.tensor_codec import decode_sparse
 
         r, i, n = struct.unpack_from("<qqI", buf, 0)
-        return cls(round_id=r, iteration=i, value=decode_sparse(buf[20 : 20 + n]))
+        return cls(
+            round_id=r, iteration=i, value=decode_sparse(buf[20 : 20 + n]),
+            trace=_unpack_trace(buf, 20 + n),
+        )
 
 
 @dataclasses.dataclass
@@ -463,6 +538,7 @@ class ValueResponseFusedSparse(Message):
     buckets: Optional[Tuple] = None
     bf16_wire: bool = False
     int8_wire: bool = False
+    trace: Optional[TraceContext] = None
 
     def _pack(self) -> bytes:
         from distributed_learning_tpu.comm.tensor_codec import (
@@ -478,7 +554,11 @@ class ValueResponseFusedSparse(Message):
             np.asarray(v), buckets,
             bf16_wire=self.bf16_wire, int8_wire=self.int8_wire,
         )
-        return struct.pack("<qqI", self.round_id, self.iteration, len(t)) + t
+        return (
+            struct.pack("<qqI", self.round_id, self.iteration, len(t))
+            + t
+            + _pack_trace(self.trace)
+        )
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "ValueResponseFusedSparse":
@@ -490,6 +570,7 @@ class ValueResponseFusedSparse(Message):
         return cls(
             round_id=r, iteration=i,
             value=decode_fused_sparse(buf[20 : 20 + n]),
+            trace=_unpack_trace(buf, 20 + n),
         )
 
 
@@ -524,6 +605,7 @@ class AsyncValue(Message):
     buckets: Optional[Tuple] = None  # encode-side, fused kind only
     bf16_wire: bool = False
     int8_wire: bool = False
+    trace: Optional[TraceContext] = None
 
     def _pack(self) -> bytes:
         from distributed_learning_tpu.comm.tensor_codec import (
@@ -554,7 +636,7 @@ class AsyncValue(Message):
             "<qqqBI",
             self.round_id, self.generation, self.staleness,
             self.kind, len(t),
-        ) + t
+        ) + t + _pack_trace(self.trace)
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "AsyncValue":
@@ -573,7 +655,7 @@ class AsyncValue(Message):
             value = decode_tensor(body)
         return cls(
             round_id=r, generation=gen, staleness=stale,
-            value=value, kind=kind,
+            value=value, kind=kind, trace=_unpack_trace(buf, 29 + n),
         )
 
 
@@ -588,14 +670,20 @@ class AsyncPoke(Message):
     TYPE_CODE: ClassVar[int] = 17
     round_id: int = 0
     generation: int = 0
+    trace: Optional[TraceContext] = None
 
     def _pack(self) -> bytes:
-        return struct.pack("<qq", self.round_id, self.generation)
+        return (
+            struct.pack("<qq", self.round_id, self.generation)
+            + _pack_trace(self.trace)
+        )
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "AsyncPoke":
         r, gen = struct.unpack_from("<qq", buf, 0)
-        return cls(round_id=r, generation=gen)
+        return cls(
+            round_id=r, generation=gen, trace=_unpack_trace(buf, 16)
+        )
 
 
 _REGISTRY: Dict[int, Type[Message]] = {

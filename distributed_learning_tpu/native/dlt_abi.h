@@ -10,4 +10,13 @@
 
 #define DLT_ABI_VERSION 2u
 
+// Transport-frame and trace-context versions, restated here so the
+// native side carries the full wire identity in one header.  The
+// Python authorities are comm/framing.py (WIRE_VERSION) and
+// comm/protocol.py (TRACE_CTX_VERSION); graftlint's wire-contract
+// stage fails lint whenever the three statements of either version
+// (Python authority, wire.cpp constexpr, this define) disagree.
+#define DLT_WIRE_VERSION 2u
+#define DLT_TRACE_CTX_VERSION 1u
+
 #endif  // DLT_ABI_H_

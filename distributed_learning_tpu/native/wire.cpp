@@ -59,6 +59,14 @@ constexpr long long kErrNonFinite = -9;    // int8 wire over NaN/Inf values
 constexpr long long kErrInternal = -10;    // output capacity / pass-1 vs
                                            // pass-2 disagreement (a bug)
 
+// Transport constants shared with comm/framing.py / comm/protocol.py.
+// Mirror-only (the native engine codes payload sections, not transport
+// frames) but kept in lockstep by graftlint's wire-contract stage: v2
+// adds the TraceContext trailer (u8 present | u32 run_id | i64 seq |
+// f64 t_wall | u16-len origin) to the value-bearing message bodies.
+constexpr uint8_t kWireVersion = 2;
+constexpr uint8_t kTraceCtxVersion = 1;
+
 // Wire constants shared with comm/tensor_codec.py.
 constexpr uint8_t kFusedMagic = 0xFE;
 constexpr uint8_t kFusedVersion = 1;

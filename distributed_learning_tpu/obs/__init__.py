@@ -18,6 +18,13 @@ One import surface for the four pieces:
   with per-agent labels, straggler profiles, merged Perfetto traces)
   and `flight.py` (:class:`FlightRecorder` — per-agent event rings
   dumped to a JSONL black box on abort/death/deadline/shutdown);
+* the **trace plane + health sentinel** — wire-propagated frame flow
+  events (`spans.py` :func:`emit_flow` over the
+  ``protocol.TraceContext`` carried on the gossip wire, arrow-linked in
+  the merged trace), per-edge wire profiles
+  (:func:`edge_profile_from_registry`), and `health.py`
+  (:class:`HealthSentinel` — declarative live-run rules over the
+  merged registry, reason-tagged flight dumps on breach);
 * the **device-cost observatory** — `cost.py` (:class:`CostProfile`
   extracted from any compiled entry point: FLOPs, bytes, peak HBM,
   donation, collective inventory; :class:`SampledDispatchTimer`
@@ -59,14 +66,25 @@ from distributed_learning_tpu.obs.aggregate import (
     OBS_PAYLOAD_VERSION,
     ObsDeltaSource,
     RunAggregator,
+    edge_profile_from_registry,
     is_obs_payload,
     straggler_profile_from_registry,
 )
 from distributed_learning_tpu.obs.flight import FlightRecorder
+from distributed_learning_tpu.obs.health import (
+    HealthBreach,
+    HealthRule,
+    HealthSentinel,
+    default_rules,
+)
 from distributed_learning_tpu.obs.report import format_run_report, obs_report_main
 from distributed_learning_tpu.obs.spans import (
+    FLOW_EVENT,
+    FLOW_PHASES,
     Span,
     SpanTracer,
+    emit_flow,
+    flow_key,
     get_tracer,
     set_tracer,
     span,
@@ -109,4 +127,13 @@ __all__ = [
     "FlightRecorder",
     "is_obs_payload",
     "straggler_profile_from_registry",
+    "edge_profile_from_registry",
+    "FLOW_EVENT",
+    "FLOW_PHASES",
+    "emit_flow",
+    "flow_key",
+    "HealthBreach",
+    "HealthRule",
+    "HealthSentinel",
+    "default_rules",
 ]

@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from distributed_learning_tpu.obs.flight import FlightRecorder
 from distributed_learning_tpu.obs.registry import MetricsRegistry
+from distributed_learning_tpu.obs.spans import FLOW_EVENT, FLOW_PHASES
 from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
 
 __all__ = [
@@ -54,6 +55,7 @@ __all__ = [
     "ObsDeltaSource",
     "RunAggregator",
     "straggler_profile_from_registry",
+    "edge_profile_from_registry",
 ]
 
 #: ``payload["kind"]`` marking a Telemetry payload as a registry delta
@@ -153,13 +155,17 @@ class ObsDeltaSource:
 class _AgentView:
     """Per-agent merge state inside the aggregator."""
 
-    __slots__ = ("last_seq", "counters", "spans", "last_wall")
+    __slots__ = ("last_seq", "counters", "spans", "flows", "last_wall")
 
     def __init__(self, max_spans: int):
         self.last_seq = 0
         self.counters: Dict[str, float] = {}
         # (name, wall_t0, dur_s, depth) for the merged trace.
         self.spans: collections.deque = collections.deque(maxlen=max_spans)
+        # trace.flow frame-lifecycle events ({phase, origin, seq, run,
+        # edge, ts, ...}) — the arrow-linked causal chains of the
+        # merged trace.
+        self.flows: collections.deque = collections.deque(maxlen=max_spans)
         self.last_wall: Optional[float] = None
 
 
@@ -286,6 +292,13 @@ class RunAggregator(TelemetryProcessor):
             }
             self.registry.event(name, token=token,
                                 agent_ts=ev.get("ts"), **fields)
+            if name == FLOW_EVENT:
+                # Frame-lifecycle hop: keep it (with the emitting
+                # agent's wall stamp) for the merged trace's arrows.
+                flow = dict(fields)
+                flow["agent"] = token
+                flow["ts"] = ev.get("ts")
+                view.flows.append(flow)
         elif kind in ("counter", "gauge"):
             # Snapshot lines from a replayed dump file: totals already
             # merged through the counters/gauges maps — skip, or the
@@ -361,23 +374,44 @@ class RunAggregator(TelemetryProcessor):
         """See :func:`straggler_profile_from_registry`."""
         return straggler_profile_from_registry(self.registry)
 
+    def edge_profile(self) -> dict:
+        """See :func:`edge_profile_from_registry`."""
+        return edge_profile_from_registry(self.registry)
+
     # ------------------------------------------------------------------ #
     def to_chrome_trace(self) -> dict:
         """Merged Chrome/Perfetto trace: one track (pid) per agent,
         wall-clock-anchored span starts normalized to the earliest span
         (the shared timeline), ``process_name`` metadata naming each
-        track after its agent."""
+        track after its agent.
+
+        ``trace.flow`` frame-lifecycle events additionally render as
+        per-frame causal chains: each hop becomes a small anchor slice
+        (``frame.<phase>``, tid 2 — the "wire" lane of its agent's
+        track) and the hops sharing one wire-carried
+        ``(run, origin, seq)`` identity are linked with Chrome flow
+        arrows (``ph`` s/t/f, one id per frame), so
+        encode→send→recv→decode→mix reads as ONE arrow-linked path
+        across process tracks in Perfetto."""
         with self._lock:
             per_agent = {
-                token: list(view.spans)
+                token: (list(view.spans), list(view.flows))
                 for token, view in sorted(self._views.items())
-                if view.spans
+                if view.spans or view.flows
             }
         events: List[dict] = []
-        all_t0 = [t0 for spans in per_agent.values()
+        all_t0 = [t0 for spans, _flows in per_agent.values()
                   for (_n, t0, _d, _dep) in spans]
+        all_t0 += [
+            float(f["ts"]) for _spans, flows in per_agent.values()
+            for f in flows if f.get("ts") is not None
+        ]
         base = min(all_t0) if all_t0 else 0.0
-        for pid, (token, spans) in enumerate(per_agent.items(), start=1):
+        pids: Dict[str, int] = {}
+        for pid, (token, (spans, _flows)) in enumerate(
+            per_agent.items(), start=1
+        ):
+            pids[token] = pid
             events.append({
                 "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
                 "args": {"name": f"agent {token}"},
@@ -392,6 +426,60 @@ class RunAggregator(TelemetryProcessor):
                     "tid": 1,
                     "args": {"agent": token, "depth": depth},
                 })
+        # Frame chains: group hops by wire identity, order each chain
+        # by lifecycle phase (the wall stamps of two processes are only
+        # ~ms-aligned; the phase order is the causal truth).
+        chains: Dict[str, List[Tuple[int, float, int, dict]]] = {}
+        for token, (_spans, flows) in per_agent.items():
+            for f in flows:
+                ts, phase = f.get("ts"), f.get("phase")
+                if ts is None or phase not in FLOW_PHASES:
+                    continue
+                key = (
+                    f"{f.get('run', 0)}:{f.get('origin', '')}:"
+                    f"{f.get('seq', 0)}"
+                )
+                chains.setdefault(key, []).append(
+                    (FLOW_PHASES.index(phase), float(ts), pids[token], f)
+                )
+        flow_id = 0
+        for key in sorted(chains):
+            hops = sorted(chains[key], key=lambda h: (h[0], h[1]))
+            flow_id += 1
+            for _order, ts, pid, f in hops:
+                events.append({
+                    "name": f"frame.{f['phase']}",
+                    "ph": "X",
+                    "ts": round((ts - base) * 1e6, 3),
+                    "dur": 20.0,
+                    "pid": pid,
+                    "tid": 2,
+                    "args": {
+                        k: f[k]
+                        for k in ("origin", "seq", "run", "edge", "agent")
+                        if k in f
+                    },
+                })
+            if len(hops) < 2:
+                continue
+            for i, (_order, ts, pid, _f) in enumerate(hops):
+                ph = "s" if i == 0 else (
+                    "f" if i == len(hops) - 1 else "t"
+                )
+                arrow = {
+                    "name": "frame",
+                    "cat": FLOW_EVENT,
+                    "ph": ph,
+                    "id": flow_id,
+                    # +1us: strictly inside the anchor slice, so the
+                    # arrow binds to it on every Perfetto version.
+                    "ts": round((ts - base) * 1e6 + 1.0, 3),
+                    "pid": pid,
+                    "tid": 2,
+                }
+                if ph == "f":
+                    arrow["bp"] = "e"
+                events.append(arrow)
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
@@ -558,4 +646,121 @@ def straggler_profile_from_registry(
         "per_agent": per_agent,
         "skew": skew,
         "slowest_agent": slowest_agent,
+    }
+
+
+# ---------------------------------------------------------------------- #
+# Per-edge wire profile                                                  #
+# ---------------------------------------------------------------------- #
+#: profile field -> bare counter prefix (``FramedStream._edge_inc``).
+_EDGE_COUNTER_FIELDS = (
+    ("bytes_out", "comm.edge.bytes_out/"),
+    ("bytes_in", "comm.edge.bytes_in/"),
+    ("frames_out", "comm.edge.frames_out/"),
+    ("frames_in", "comm.edge.frames_in/"),
+    ("retries", "comm.edge.retries/"),
+)
+
+
+def _bare_edge(name: str, prefix: str) -> Optional[str]:
+    """The ``src->dst`` edge label of a BARE per-edge counter name
+    (``comm.edge.bytes_out/a->b``); labeled variants with a trailing
+    ``/token`` dimension (the aggregator's per-agent copies) return
+    None so totals are not double-counted."""
+    if not name.startswith(prefix):
+        return None
+    rest = name[len(prefix):]
+    if "->" in rest and "/" not in rest:
+        return rest
+    return None
+
+
+def edge_profile_from_registry(
+        registry: MetricsRegistry, *,
+        counters: Optional[Mapping[str, float]] = None) -> dict:
+    """The per-edge wire observatory: which directed link moved how
+    many bytes/frames, how slowly, and how unreliably — from a merged
+    run registry.
+
+    Volume/retry totals come from the bare ``comm.edge.*/<src>-><dst>``
+    counters every edge-labeled :class:`FramedStream` maintains;
+    latency from the ``comm.edge.latency_s/<edge>`` series (receiver
+    wall-clock minus the frame's wire-carried ``TraceContext.t_wall``
+    send stamp, so it needs tracing on); per-edge mix staleness from
+    ``comm.edge.staleness/<edge>``; injected-fault attribution from the
+    ``comm.faults.<kind>/<edge>`` counters.  ``counters`` overrides the
+    registry totals for replayed streams, exactly like
+    :func:`straggler_profile_from_registry`.  This is the measured
+    per-link cost picture topology/schedule choices key off
+    (arxiv.org/pdf/2002.01119 §3; the two-tier link split of
+    arxiv.org/pdf/2105.09080 needs per-edge latency as input).
+    """
+    if counters is None:
+        counters = registry.counters
+    edges: Dict[str, dict] = {}
+
+    def entry(edge: str) -> dict:
+        return edges.setdefault(edge, {
+            "bytes_out": 0.0, "bytes_in": 0.0,
+            "frames_out": 0, "frames_in": 0, "retries": 0,
+            "faults": {},
+        })
+
+    for name, total in counters.items():
+        for field, prefix in _EDGE_COUNTER_FIELDS:
+            edge = _bare_edge(name, prefix)
+            if edge is not None:
+                if field.startswith("bytes"):
+                    entry(edge)[field] = float(total)
+                else:
+                    entry(edge)[field] = int(total)
+        if name.startswith("comm.faults."):
+            rest = name[len("comm.faults."):]
+            kind, _slash, label = rest.partition("/")
+            if label and "->" in label and "/" not in label:
+                entry(label)["faults"][kind] = int(total)
+
+    lat: Dict[str, List[float]] = {}
+    stale: Dict[str, List[float]] = {}
+    for name, pts in registry.series.items():
+        for prefix, dest in (("comm.edge.latency_s/", lat),
+                             ("comm.edge.staleness/", stale)):
+            if name.startswith(prefix):
+                edge = name[len(prefix):].split("/", 1)[0]
+                if "->" in edge:
+                    dest.setdefault(edge, []).extend(v for _, v in pts)
+    for edge, vals in lat.items():
+        vals.sort()
+        entry(edge)["latency"] = {
+            "n": len(vals),
+            "p50_s": _pct(vals, 0.50),
+            "p95_s": _pct(vals, 0.95),
+            "max_s": vals[-1] if vals else 0.0,
+        }
+    for edge, vals in stale.items():
+        entry(edge)["staleness"] = {
+            "n": len(vals),
+            "mean": sum(vals) / len(vals) if vals else 0.0,
+            "max": max(vals) if vals else 0,
+        }
+
+    # Throughput window: the wall spread of the merged event stream
+    # (agents' own stamps when the events travelled a delta; the
+    # registry clock's otherwise).  Zero/one-stamp registries render
+    # totals only.
+    stamps: List[float] = []
+    for ev in registry.recent_events():
+        t = ev.get("agent_ts")
+        if t is None:
+            t = ev.get("ts")
+        if t:
+            stamps.append(float(t))
+    window = (max(stamps) - min(stamps)) if len(stamps) >= 2 else 0.0
+    for e in edges.values():
+        e["bytes_out_per_s"] = (
+            e["bytes_out"] / window if window > 0 else 0.0
+        )
+    return {
+        "edges": {k: edges[k] for k in sorted(edges)},
+        "window_s": window,
     }

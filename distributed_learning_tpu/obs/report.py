@@ -44,6 +44,7 @@ from distributed_learning_tpu.obs.registry import MetricsRegistry
 __all__ = [
     "format_run_report",
     "format_straggler_profile",
+    "format_edge_profile",
     "format_bench_trajectory",
     "obs_report_main",
     "obs_monitor_main",
@@ -165,6 +166,49 @@ def format_straggler_profile(profile: dict) -> str:
             )
     if profile.get("slowest_agent") is not None:
         lines.append(f"  slowest agent: {profile['slowest_agent']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------- #
+# Per-edge wire profile                                                  #
+# ---------------------------------------------------------------------- #
+def _ms(v: Optional[float]) -> str:
+    return "—" if v is None else f"{v * 1e3:.1f}"
+
+
+def format_edge_profile(profile: dict) -> str:
+    """Render :func:`~distributed_learning_tpu.obs.aggregate.
+    edge_profile_from_registry` output: one row per directed edge —
+    volume, throughput, retries, trace-derived latency percentiles,
+    mix staleness, and injected-fault attribution."""
+    edges = profile.get("edges") or {}
+    window = profile.get("window_s") or 0.0
+    head = f"edge profile — {len(edges)} directed edges"
+    if window:
+        head += f" over {window:.1f}s"
+    lines = [head]
+    if not edges:
+        return "\n".join(lines)
+    lines.append(
+        f"  {'edge':12s} {'frames':>7} {'KiB out':>9} {'KiB/s':>8} "
+        f"{'retry':>6} {'lat p50 ms':>11} {'p95 ms':>8} {'max ms':>8} "
+        f"{'stale mean':>11} {'faults':>7}"
+    )
+    for edge in sorted(edges):
+        e = edges[edge]
+        lat = e.get("latency") or {}
+        st = e.get("staleness") or {}
+        faults = int(sum((e.get("faults") or {}).values()))
+        stale_mean = f"{st['mean']:.2f}" if st else "—"
+        lines.append(
+            f"  {edge:12s} {int(e.get('frames_out', 0)):7d} "
+            f"{float(e.get('bytes_out', 0.0)) / 1024.0:9.2f} "
+            f"{float(e.get('bytes_out_per_s', 0.0)) / 1024.0:8.2f} "
+            f"{int(e.get('retries', 0)):6d} "
+            f"{_ms(lat.get('p50_s')):>11} {_ms(lat.get('p95_s')):>8} "
+            f"{_ms(lat.get('max_s')):>8} "
+            f"{stale_mean:>11} {faults:7d}"
+        )
     return "\n".join(lines)
 
 
@@ -328,16 +372,20 @@ def obs_report_main(argv: Optional[Sequence[str]] = None) -> int:
                 agg.export_chrome_trace(args.trace)
             report = agg.registry.run_report()
             profile = agg.straggler_profile()
+            edge_profile = agg.edge_profile()
+            payload = {"report": report, "straggler": profile}
+            text_parts = [
+                format_run_report(report),
+                format_straggler_profile(profile),
+            ]
+            if edge_profile["edges"]:
+                # Rendered only when edge-labeled streams ran: plain
+                # (pre-observatory) logs keep their exact report shape.
+                payload["edges"] = edge_profile
+                text_parts.append(format_edge_profile(edge_profile))
             text = (
-                json.dumps(
-                    {"report": report, "straggler": profile},
-                    indent=2, sort_keys=True,
-                )
-                if args.json else (
-                    format_run_report(report)
-                    + "\n\n"
-                    + format_straggler_profile(profile)
-                )
+                json.dumps(payload, indent=2, sort_keys=True)
+                if args.json else "\n\n".join(text_parts)
             )
         else:
             if len(args.paths) != 1:
@@ -511,7 +559,50 @@ def render_dashboard(registry: MetricsRegistry, *,
     lost = counters.get("obs.deltas_lost", 0)
     if lost:
         lines.append(f"obs: {int(lost)} telemetry deltas lost")
+    lines.extend(_health_lines(registry, counters, events))
     return "\n".join(lines)
+
+
+def _health_lines(registry: MetricsRegistry,
+                  counters: Dict[str, float],
+                  events: List[dict]) -> List[str]:
+    """The dashboard's live health section: rules breached by the run's
+    own sentinel (``health.breach`` events riding the stream) unioned
+    with a fresh evaluation over the replayed registry (catches
+    breaches a sentinel-less master never evaluated).  Empty when the
+    stream carries no health signal at all, so pre-sentinel streams
+    render unchanged."""
+    from distributed_learning_tpu.obs.health import HealthSentinel
+
+    # Signal detection BEFORE the fresh evaluation: evaluate() writes
+    # health.* gauges of its own, which must not count as "this stream
+    # already carried health data".
+    had_signal = any(k.startswith("health.") for k in counters) or any(
+        k.startswith("health.") for k in registry.gauges
+    )
+    live = sorted({
+        str(ev.get("rule")) for ev in events
+        if ev.get("kind") == "event" and ev.get("name") == "health.breach"
+        and ev.get("rule")
+    })
+    sentinel = HealthSentinel(registry)
+    try:
+        fresh = {
+            b.rule: b for b in sentinel.evaluate(counters=counters)
+        }
+    except Exception:  # pragma: no cover - render must never die
+        fresh = {}
+    names = sorted(set(live) | set(fresh))
+    if not (names or had_signal):
+        return []
+    if not names:
+        return [f"health: OK ({len(sentinel.rules)} rules)"]
+    lines = [f"health: BREACH — {', '.join(names)}"]
+    for name in names:
+        br = fresh.get(name)
+        if br is not None:
+            lines.append(f"  {name}: {br.detail}")
+    return lines
 
 
 def obs_monitor_main(argv: Optional[Sequence[str]] = None) -> int:

@@ -31,7 +31,55 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from distributed_learning_tpu.obs.registry import MetricsRegistry, get_registry
 
-__all__ = ["Span", "SpanTracer", "get_tracer", "set_tracer", "span"]
+__all__ = [
+    "Span",
+    "SpanTracer",
+    "get_tracer",
+    "set_tracer",
+    "span",
+    "FLOW_EVENT",
+    "FLOW_PHASES",
+    "emit_flow",
+    "flow_key",
+]
+
+# ---------------------------------------------------------------------- #
+# Frame flow events (the wire trace plane)                               #
+# ---------------------------------------------------------------------- #
+#: Registry event name every frame-lifecycle hop emits under.
+FLOW_EVENT = "trace.flow"
+
+#: The frame lifecycle, in causal order: the sender encodes and sends,
+#: the receiver recvs, decodes, and mixes.  A frame is identified
+#: across processes by its wire-carried
+#: :class:`~distributed_learning_tpu.comm.protocol.TraceContext`
+#: ``(run_id, origin, seq)`` triple, so the N processes' phase events
+#: chain into one arrow-linked flow in the merged Perfetto trace
+#: (``RunAggregator.to_chrome_trace``).
+FLOW_PHASES = ("encode", "send", "recv", "decode", "mix")
+
+
+def flow_key(run_id: int, origin: str, seq: int) -> str:
+    """The fleet-unique flow id shared by one frame's phase events."""
+    return f"{int(run_id)}:{origin}:{int(seq)}"
+
+
+def emit_flow(registry: MetricsRegistry, phase: str, *,
+              origin: str, seq: int, run_id: int = 0,
+              edge: str = "", **fields) -> None:
+    """Record one frame-lifecycle hop as a ``trace.flow`` registry
+    event.  ``phase`` is one of :data:`FLOW_PHASES`; ``origin``/``seq``/
+    ``run_id`` come from the frame's wire-carried ``TraceContext`` (the
+    sender stamps them, the receiver replays the received ones — both
+    sides of an edge MUST agree or the chain breaks); ``edge`` labels
+    the directed link ``src->dst`` when known.  Extra ``fields`` ride
+    along into the event (round, staleness, ...).  Cost when tracing is
+    on: one dict append into the registry's event ring — no clock
+    beyond the registry's own stamp, no device sync."""
+    registry.event(
+        FLOW_EVENT, phase=phase, origin=origin, seq=int(seq),
+        run=int(run_id), edge=edge, **fields,
+    )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -238,6 +238,76 @@ def test_drop_dup_reorder_delivery_semantics():
     asyncio.run(asyncio.wait_for(main(), 30))
 
 
+def test_fault_decisions_emit_attributed_registry_events():
+    """ISSUE 14 satellite: every injected-fault decision lands in the
+    registry as a ``comm.fault`` event carrying (kind, peer, frame
+    index, round) plus the per-edge fault counter — so the per-edge
+    observatory and the flight ring can attribute injected chaos."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            server, client, srv = await _tcp_pair()
+            faulty = FaultPlan(0, corrupt_p=1.0).wrap(
+                client, peer="B", edge="A->B"
+            )
+            await faulty.send(
+                P.AsyncValue(round_id=9, staleness=0,
+                             value=np.ones(4, np.float32))
+            )
+            with pytest.raises(FrameError):
+                await srv.recv(timeout=5.0)
+            client.close(); srv.close(); server.close()
+            await server.wait_closed()
+
+        (ev,) = [e for e in reg.recent_events()
+                 if e.get("name") == "comm.fault"]
+        assert ev["fault"] == "corrupt"
+        assert ev["peer"] == "B"
+        assert ev["frame_index"] == 0
+        assert ev["round"] == 9
+        assert ev["edge"] == "A->B"
+        # Bare + per-edge counters both tick.
+        assert reg.counters["comm.faults.corrupt"] == 1
+        assert reg.counters["comm.faults.corrupt/A->B"] == 1
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_inject_neighbor_faults_labels_the_directed_edge():
+    """``inject_neighbor_faults`` wires peer/edge attribution from the
+    agent's own token — the deployed-path guarantee the loopback
+    quarantine test's counters build on."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            master = ConsensusMaster(TRIANGLE, convergence_eps=1e-7)
+            host, port = await master.start()
+            agents = {t: ConsensusAgent(t, host, port) for t in "ABC"}
+            await asyncio.gather(*(a.start() for a in agents.values()))
+
+            wrapped = inject_neighbor_faults(
+                agents["A"], "B", FaultPlan(1, drop_p=1.0)
+            )
+            assert wrapped.peer == "B" and wrapped.edge == "A->B"
+            await agents["A"]._neighbors["B"].send(
+                P.AsyncValue(round_id=3, staleness=0,
+                             value=np.zeros(2, np.float32))
+            )
+            (ev,) = [e for e in reg.recent_events()
+                     if e.get("name") == "comm.fault"]
+            assert ev["fault"] == "drop" and ev["edge"] == "A->B"
+            assert ev["peer"] == "B" and ev["round"] == 3
+            assert reg.counters["comm.faults.drop/A->B"] == 1
+
+            await master.shutdown()
+            for a in agents.values():
+                await a.close(drain=0.1)
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
 def test_crash_tears_down_transport_abruptly():
     async def main():
         server, client, srv = await _tcp_pair()

@@ -1,0 +1,424 @@
+"""Fleet trace plane (ISSUE 14): wire-propagated trace context, the
+per-edge wire observatory, and the online health sentinel.
+
+The acceptance oracles pinned here:
+
+* a loopback 3-agent run with ``ConsensusAgent(trace=True)`` exports
+  ONE merged Chrome trace in which each wire frame's
+  encode→send→recv→decode→mix lifecycle is an arrow-linked flow chain
+  (``ph`` s/t/f) spanning the origin and destination process tracks;
+* the same run populates the per-edge observatory
+  (``edge_profile_from_registry``: bytes/frames per directed edge,
+  trace-derived latency percentiles) and ``obs-report --merge`` renders
+  the edge table (golden-pinned in ``tests/data/obs_edge_golden.txt``);
+* a seeded consensus-residual stall, flowing through the REAL master
+  telemetry path (``ConsensusMaster(sentinel=...)``), trips the named
+  ``consensus-stall`` rule and writes a reason-tagged flight dump
+  BEFORE shutdown;
+* bit-identity: tracing must observe, never perturb — the consensus
+  values of a traced run are bit-identical to the untraced run;
+* the wire layer: every value message round-trips its
+  :class:`~distributed_learning_tpu.comm.protocol.TraceContext`
+  trailer, and a trailer-less (pre-ISSUE-14) body still unpacks.
+"""
+
+import asyncio
+import dataclasses
+import itertools
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.comm import ConsensusAgent, ConsensusMaster
+from distributed_learning_tpu.comm import protocol as P
+from distributed_learning_tpu.obs import (
+    FlightRecorder,
+    HealthSentinel,
+    MetricsRegistry,
+    RunAggregator,
+    default_rules,
+    edge_profile_from_registry,
+)
+from distributed_learning_tpu.obs.health import ConsensusStallRule
+from distributed_learning_tpu.obs.spans import FLOW_EVENT, FLOW_PHASES
+
+TRIANGLE = [("a", "b"), ("b", "c"), ("c", "a")]
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+# ---------------------------------------------------------------------- #
+# Wire layer: TraceContext trailer on every value message                #
+# ---------------------------------------------------------------------- #
+def test_trace_context_roundtrips_on_every_value_message():
+    tc = P.TraceContext(run_id=9, origin="agent-7", seq=123,
+                        t_wall=1234.5)
+    msgs = [
+        P.ValueResponse(round_id=7, iteration=3,
+                        value=np.ones(4, np.float32), trace=tc),
+        P.ValueResponseSparse(
+            round_id=7, iteration=3,
+            value=np.array([0, 0, 2.5, 0, -1.0, 0], np.float32),
+            trace=tc,
+        ),
+        P.ValueResponseFusedSparse(
+            round_id=7, iteration=3,
+            value=np.array([0, 0, 2.5, 0, -1.0, 0], np.float32),
+            buckets=(("float32", ((0, 4),)), ("bfloat16", ((4, 2),))),
+            trace=tc,
+        ),
+        P.AsyncValue(round_id=4, generation=2, staleness=1,
+                     value=np.arange(6, dtype=np.float32), trace=tc),
+        P.AsyncPoke(round_id=5, generation=2, trace=tc),
+    ]
+    for msg in msgs:
+        code, body = P.pack_message(msg)
+        back = P.unpack_message(code, body)
+        assert back.trace == tc, type(msg).__name__
+        # trace=None costs exactly one absent-marker byte on the wire.
+        bare = P.pack_message(dataclasses.replace(msg, trace=None))[1]
+        assert P.unpack_message(code, bare).trace is None
+        # A pre-trace body (no trailer at all) still unpacks: the
+        # rolling-upgrade compatibility the versioned bump promises.
+        assert P.unpack_message(code, bare[:-1]).trace is None
+
+
+def test_trace_context_versions_are_pinned_cross_language():
+    from distributed_learning_tpu.comm.framing import WIRE_VERSION
+    from tools.graftlint import wire_contract as wc
+
+    assert P.TRACE_CTX_VERSION == 1
+    assert WIRE_VERSION == 2
+    contract, findings = wc.extract()
+    assert findings == [], [str(f) for f in findings]
+    assert contract["wire_version"] == WIRE_VERSION
+    assert contract["trace_ctx_version"] == P.TRACE_CTX_VERSION
+
+
+# ---------------------------------------------------------------------- #
+# Acceptance: merged flow-linked trace across process tracks             #
+# ---------------------------------------------------------------------- #
+def _run_traced_loopback(rounds=2, trace=True):
+    """Master + 3 traced agents, ``rounds`` sync gossip rounds; returns
+    (aggregator, final values dict)."""
+    agg = RunAggregator()
+
+    async def main():
+        master = ConsensusMaster(
+            TRIANGLE, convergence_eps=1e-9, aggregator=agg,
+        )
+        host, port = await master.start()
+        agents = {
+            t: ConsensusAgent(
+                t, host, port, obs=MetricsRegistry(),
+                trace=trace, trace_run_id=14,
+            )
+            for t in "abc"
+        }
+        await asyncio.gather(*(a.start() for a in agents.values()))
+        vals = {
+            t: np.full(8, float(i), np.float32)
+            for i, t in enumerate("abc")
+        }
+        for _ in range(rounds):
+            outs = await asyncio.gather(
+                *(a.run_round(vals[t], 1.0) for t, a in agents.items())
+            )
+            vals = dict(zip(agents, outs))
+        await asyncio.gather(
+            *(a.send_obs_delta() for a in agents.values())
+        )
+        await asyncio.sleep(0.2)  # master drains telemetry
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+        return vals
+
+    vals = asyncio.run(asyncio.wait_for(main(), 60))
+    return agg, vals
+
+
+def test_loopback_traced_run_exports_flow_linked_chains():
+    agg, _vals = _run_traced_loopback()
+    trace = agg.to_chrome_trace()
+    events = trace["traceEvents"]
+
+    pid_to_token = {
+        e["pid"]: e["args"]["name"].split(" ", 1)[1]
+        for e in events if e["ph"] == "M"
+    }
+    anchors = [e for e in events
+               if e["ph"] == "X" and e["name"].startswith("frame.")]
+    assert anchors, "traced run produced no frame anchors"
+    # Every lifecycle phase is present somewhere in the merged trace.
+    assert {a["name"] for a in anchors} == {
+        f"frame.{p}" for p in FLOW_PHASES
+    }
+
+    # Group anchors by wire identity; at least one frame must have the
+    # complete 5-phase chain.
+    chains = {}
+    for a in anchors:
+        key = (a["args"]["run"], a["args"]["origin"], a["args"]["seq"])
+        chains.setdefault(key, []).append(a)
+    complete = {
+        key: hops for key, hops in chains.items()
+        if {h["name"] for h in hops} == {f"frame.{p}" for p in FLOW_PHASES}
+    }
+    assert complete, "no frame carried a complete encode..mix chain"
+    for (run, origin, _seq), hops in complete.items():
+        assert run == 14  # the wire carried the run id
+        by_phase = {h["name"].split(".", 1)[1]: h for h in hops}
+        # encode/send live on the ORIGIN's track; recv/decode/mix on
+        # the destination's — the cross-process causal arrow.
+        src, dst = by_phase["mix"]["args"]["edge"].split("->")
+        assert src == origin
+        for phase in ("encode", "send"):
+            assert pid_to_token[by_phase[phase]["pid"]] == origin
+        for phase in ("recv", "decode", "mix"):
+            assert pid_to_token[by_phase[phase]["pid"]] == dst
+        assert by_phase["encode"]["pid"] != by_phase["mix"]["pid"]
+
+    # The chains are arrow-linked: Chrome flow events s -> t... -> f,
+    # terminal bound "e", one id per frame, spanning >= 2 pids.
+    arrows = {}
+    for e in events:
+        if e.get("cat") == FLOW_EVENT and e["ph"] in "stf":
+            arrows.setdefault(e["id"], []).append(e)
+    assert len(arrows) >= len(complete)
+    linked_cross_process = 0
+    for chain in arrows.values():
+        phs = [e["ph"] for e in chain]
+        assert phs[0] == "s" and phs[-1] == "f"
+        assert all(p == "t" for p in phs[1:-1])
+        assert chain[-1]["bp"] == "e"
+        if len({e["pid"] for e in chain}) >= 2:
+            linked_cross_process += 1
+    assert linked_cross_process >= len(complete)
+
+
+def test_tracing_is_bit_identical_to_untraced_run():
+    """The oracle that tracing observes without perturbing: same seed,
+    same topology, same rounds — the consensus values must be
+    bit-identical with the trace plane on and off."""
+    _agg_off, vals_off = _run_traced_loopback(trace=False)
+    _agg_on, vals_on = _run_traced_loopback(trace=True)
+    for t in "abc":
+        np.testing.assert_array_equal(vals_off[t], vals_on[t])
+
+
+def test_untraced_run_emits_no_flow_events():
+    agg, _vals = _run_traced_loopback(trace=False)
+    events = agg.to_chrome_trace()["traceEvents"]
+    assert not [e for e in events
+                if e["ph"] == "X" and e["name"].startswith("frame.")]
+    assert not [e for e in events if e.get("cat") == FLOW_EVENT]
+
+
+# ---------------------------------------------------------------------- #
+# Acceptance: the per-edge wire observatory                              #
+# ---------------------------------------------------------------------- #
+def test_loopback_traced_run_populates_edge_profile():
+    agg, _vals = _run_traced_loopback()
+    profile = agg.edge_profile()
+    edges = profile["edges"]
+    # The triangle's 6 directed edges all moved frames both ways.
+    expected = {f"{a}->{b}" for a, b in TRIANGLE} | {
+        f"{b}->{a}" for a, b in TRIANGLE
+    }
+    assert expected <= set(edges)
+    for name in expected:
+        e = edges[name]
+        assert e["frames_out"] >= 1
+        assert e["bytes_out"] > 0
+        # Trace-derived wall latency landed per edge.
+        assert e["latency"]["n"] >= 1
+        assert e["latency"]["max_s"] >= e["latency"]["p50_s"] >= 0
+
+
+def test_edge_profile_table_matches_golden(capsys):
+    """Deterministic registry -> ``format_edge_profile`` golden (the
+    ``obs-report --merge`` edge table)."""
+    from distributed_learning_tpu.obs.report import format_edge_profile
+
+    clock = itertools.count(1000)
+    reg = MetricsRegistry(clock=lambda: float(next(clock)))
+    for edge, frames, kib in (("a->b", 4, 64), ("b->a", 2, 8)):
+        reg.inc(f"comm.edge.frames_out/{edge}", frames)
+        reg.inc(f"comm.edge.bytes_out/{edge}", kib * 1024)
+    reg.inc("comm.edge.retries/a->b", 3)
+    reg.inc("comm.faults.drop/a->b", 2)
+    for i in range(4):
+        reg.observe("comm.edge.latency_s/a->b", 0.001 * (i + 1))
+        reg.observe("comm.edge.staleness/a->b", float(i % 2))
+    profile = edge_profile_from_registry(reg)
+    assert profile["window_s"] > 0
+    out = format_edge_profile(profile) + "\n"
+    golden_path = os.path.join(DATA, "obs_edge_golden.txt")
+    with open(golden_path, "r", encoding="utf-8") as fh:
+        golden = fh.read()
+    assert out == golden, (
+        "edge-profile table drifted from the golden file; if the change "
+        "is intentional, regenerate tests/data/obs_edge_golden.txt"
+    )
+
+
+def test_obs_report_merge_renders_edge_table(tmp_path, capsys):
+    """``obs-report --merge`` shows the edge section exactly when edge
+    data exists (absent -> byte-identical pre-observatory output,
+    pinned by test_obs_plane's golden)."""
+    from distributed_learning_tpu.cli import main
+
+    clock = itertools.count(1000)
+    reg = MetricsRegistry(clock=lambda: float(next(clock)))
+    reg.inc("comm.agent.rounds_run", 2)
+    reg.inc("comm.edge.frames_out/a->b", 2)
+    reg.inc("comm.edge.bytes_out/a->b", 2048)
+    reg.observe("comm.edge.latency_s/a->b", 0.002)
+    path = str(tmp_path / "a.jsonl")
+    reg.dump_jsonl(path)
+    assert main(["obs-report", "--merge", path]) == 0
+    out = capsys.readouterr().out
+    assert "edge profile — 1 directed edges" in out
+    assert "a->b" in out
+    assert main(["obs-report", "--merge", "--json", path]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["edges"]["edges"]["a->b"]["frames_out"] == 2
+
+
+# ---------------------------------------------------------------------- #
+# Acceptance: seeded stall trips the sentinel through the real master    #
+# ---------------------------------------------------------------------- #
+def test_seeded_stall_trips_sentinel_and_dumps_before_shutdown(tmp_path):
+    """Agents report a consensus residual that stops improving; the
+    telemetry flows through the REAL master (``sentinel=``), the
+    ``consensus-stall`` rule breaches, and the reason-tagged flight
+    dump is on disk BEFORE the master shuts down."""
+    flight = FlightRecorder(str(tmp_path / "flight"), capacity=64)
+    agg = RunAggregator(flight=flight)
+    sentinel = HealthSentinel(agg.registry, cooldown_s=0.0)
+    dumped_before_shutdown = []
+
+    async def main():
+        master = ConsensusMaster(
+            TRIANGLE, convergence_eps=1e-9, aggregator=agg,
+            flight=flight, sentinel=sentinel,
+        )
+        # The master auto-wires its flight recorder into a bare sentinel.
+        assert sentinel.flight is flight
+        host, port = await master.start()
+        agents = {
+            t: ConsensusAgent(t, host, port, obs=MetricsRegistry())
+            for t in "abc"
+        }
+        await asyncio.gather(*(a.start() for a in agents.values()))
+        # Seeded stall: the residual sits at 0.5 for > window rounds.
+        for r in range(8):
+            for a in agents.values():
+                a._obs.observe("consensus.residual", 0.5, step=r + 1)
+            await asyncio.gather(
+                *(a.send_obs_delta() for a in agents.values())
+            )
+            await asyncio.sleep(0.05)
+            if sentinel.breached_rules():
+                break
+        for _ in range(40):  # let the master finish draining telemetry
+            if flight.dumped:
+                break
+            await asyncio.sleep(0.05)
+        dumped_before_shutdown.extend(flight.dumped)
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+    assert "consensus-stall" in sentinel.breached_rules()
+    c = agg.registry.counters
+    assert c["health.breaches/consensus-stall"] >= 1
+    assert agg.registry.gauges["health.breached/consensus-stall"] == 1.0
+    dumps = [p for p in dumped_before_shutdown
+             if "health-consensus-stall" in p]
+    assert dumps, "reason-tagged dump must land before shutdown"
+    header, events = FlightRecorder.read_dump(dumps[0])
+    assert header["reason"] == "health-consensus-stall"
+    assert header["rule"] == "consensus-stall"
+    assert "consensus.residual" in header["detail"]
+    # The black box holds the agents' pre-breach history (the stalled
+    # residual deltas fed the rings before the rule tripped).
+    assert {"a", "b", "c"} <= {e["agent"] for e in events}
+    # And the breach is queryable live from the merged registry.
+    assert any(
+        e.get("name") == "health.breach"
+        for e in agg.registry.recent_events()
+    )
+
+
+def test_sentinel_rules_unit_behaviors(tmp_path):
+    """Rule-level semantics: priming (growth rules never fire on the
+    first batch), the stall floor (a converged residual is not a
+    stall), and the dump cooldown."""
+    reg = MetricsRegistry()
+    flight = FlightRecorder(str(tmp_path), capacity=16)
+    sentinel = HealthSentinel(reg, flight=flight, cooldown_s=3600.0)
+    assert [r.name for r in sentinel.rules] == [
+        "consensus-stall", "staleness-pressure",
+        "round-latency-regression", "wire-error-storm",
+        "eviction-pressure",
+    ]
+    # Priming: a huge error total on the FIRST evaluation is baseline,
+    # not growth.
+    reg.inc("comm.agent.frame_retries", 500)
+    assert sentinel.evaluate() == []
+    reg.inc("comm.agent.frame_retries", 500)
+    (br,) = sentinel.evaluate()
+    assert br.rule == "wire-error-storm" and br.value == 500.0
+    # One dump; the cooldown swallows the repeat breach's dump.
+    assert len(flight.dumped) == 1
+    reg.inc("comm.agent.frame_retries", 500)
+    assert sentinel.evaluate()[0].rule == "wire-error-storm"
+    assert len(flight.dumped) == 1
+    # Stall floor: a residual that already converged never breaches.
+    reg2 = MetricsRegistry()
+    for i in range(8):
+        reg2.observe("consensus.residual/a", 1e-9, step=i)
+    assert ConsensusStallRule().check(
+        HealthSentinel(reg2, rules=())
+    ) is None
+    assert len(default_rules()) == 5
+
+
+def test_obs_monitor_health_section_matches_golden(tmp_path, capsys):
+    """obs-monitor --once over a stream carrying a stalled residual
+    renders the live health section (golden-pinned); a healthy stream
+    with health gauges renders the OK line; a stream with no health
+    signal renders no section at all."""
+    from distributed_learning_tpu.cli import main
+
+    clock = itertools.count(1000)
+    reg = MetricsRegistry(clock=lambda: float(next(clock)))
+    reg.inc("comm.agent.rounds_run", 2)
+    for i in range(6):
+        reg.observe("consensus.residual/b", 0.5, step=i + 1)
+    stream = str(tmp_path / "aggregate.jsonl")
+    reg.dump_jsonl(stream)
+    assert main(["obs-monitor", stream, "--once"]) == 0
+    out = capsys.readouterr().out
+    health = [l for l in out.splitlines()
+              if l.startswith("health:") or l.startswith("  consensus-")]
+    golden_path = os.path.join(DATA, "obs_health_golden.txt")
+    with open(golden_path, "r", encoding="utf-8") as fh:
+        golden = fh.read()
+    assert "\n".join(health) + "\n" == golden, (
+        "obs-monitor health section drifted from the golden file; if "
+        "intentional, regenerate tests/data/obs_health_golden.txt"
+    )
+
+    # No health signal at all -> no section (pre-sentinel streams).
+    reg2 = MetricsRegistry(clock=lambda: 1000.0)
+    reg2.inc("comm.agent.rounds_run", 1)
+    stream2 = str(tmp_path / "plain.jsonl")
+    reg2.dump_jsonl(stream2)
+    assert main(["obs-monitor", stream2, "--once"]) == 0
+    assert "health:" not in capsys.readouterr().out

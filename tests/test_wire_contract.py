@@ -215,6 +215,70 @@ def test_intentional_bump_goes_through_audit_write(contract_tree):
 
 
 # --------------------------------------------------------------------- #
+# trace-context trailer surface (ISSUE 14): WIRE_VERSION and            #
+# TRACE_CTX_VERSION are 3-way constants (wire.cpp / dlt_abi.h / python) #
+# --------------------------------------------------------------------- #
+def test_real_tree_pins_the_trace_context_surface():
+    contract, findings = wc.extract()
+    assert findings == [], [str(f) for f in findings]
+    assert contract["wire_version"] == 2
+    assert contract["trace_ctx_version"] == 1
+
+
+def test_drift_trace_ctx_version_python_only_fails_cross_language(
+        contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/comm/protocol.py",
+        r"TRACE_CTX_VERSION = 1", "TRACE_CTX_VERSION = 2",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift, [str(f) for f in fs]
+    assert "kTraceCtxVersion" in drift[0].message
+    assert "TRACE_CTX_VERSION" in drift[0].message
+
+
+def test_drift_wire_version_cpp_only_fails_cross_language(contract_tree):
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"constexpr uint8_t kWireVersion = 2;",
+        "constexpr uint8_t kWireVersion = 3;",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]
+    assert drift, [str(f) for f in fs]
+    assert "kWireVersion" in drift[0].message
+    assert "WIRE_VERSION" in drift[0].message
+
+
+def test_intentional_trace_ctx_bump_goes_through_audit_write(
+        contract_tree):
+    """All three authorities bumped together: only the pin fails, and
+    --audit-write acknowledges the new trace-context version."""
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/comm/protocol.py",
+        r"TRACE_CTX_VERSION = 1", "TRACE_CTX_VERSION = 2",
+    )
+    _mutate(
+        root, "distributed_learning_tpu/native/wire.cpp",
+        r"constexpr uint8_t kTraceCtxVersion = 1;",
+        "constexpr uint8_t kTraceCtxVersion = 2;",
+    )
+    _mutate(
+        root, "distributed_learning_tpu/native/dlt_abi.h",
+        r"#define DLT_TRACE_CTX_VERSION 1u",
+        "#define DLT_TRACE_CTX_VERSION 2u",
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    assert [f.rule for f in fs] == [wc.PIN_RULE], [str(f) for f in fs]
+    assert wc.write_pin(repo_root=root, expected_path=expected) == []
+    assert wc.check(repo_root=root, expected_path=expected) == []
+
+
+# --------------------------------------------------------------------- #
 # obs-delta payload surface (ISSUE 12): authority obs/aggregate.py,     #
 # declared wire surface via the comm/protocol.py re-export             #
 # --------------------------------------------------------------------- #

@@ -25,7 +25,10 @@ unless every shared constant matches exactly:
 * the ABI version (``dlt_abi.h`` vs ``native/__init__.py``);
 * transport framing header/version/cap and message TYPE_CODEs
   (Python-only authorities, guarded against silent renumbering by the
-  pin below);
+  pin below); the transport wire version and the trace-context trailer
+  version are each stated THREE times (``framing.py``/``protocol.py``
+  authority, ``wire.cpp`` constexpr, ``dlt_abi.h`` define) and all
+  three statements must agree;
 * the obs-delta payload surface (``OBS_PAYLOAD_KIND``/
   ``OBS_PAYLOAD_VERSION``): authority ``obs/aggregate.py``, declared
   wire surface through the ``comm/protocol.py`` re-export — the
@@ -144,6 +147,10 @@ _VLEN_F32_RE = re.compile(r"default:\s*return (\d+) \+ (\d+) \* k;")
 _FRAME_HDR_RE = re.compile(r"size = (\d+);\s*//\s*frame header")
 _TRAIL_CRC_RE = re.compile(r"size \+ (\d+)\);\s*//\s*\+ trailing crc")
 _ABI_DEFINE_RE = re.compile(r"#define\s+DLT_ABI_VERSION\s+(\d+)[uU]?")
+_WIRE_DEFINE_RE = re.compile(r"#define\s+DLT_WIRE_VERSION\s+(\d+)[uU]?")
+_TRACE_DEFINE_RE = re.compile(
+    r"#define\s+DLT_TRACE_CTX_VERSION\s+(\d+)[uU]?"
+)
 
 
 def _cpp_side(repo_root: str, ex: _Extract) -> Dict[str, object]:
@@ -164,6 +171,16 @@ def _cpp_side(repo_root: str, ex: _Extract) -> Dict[str, object]:
     else:
         out["abi_version"] = (_to_int(m.group(1)), _line_of(abi_src, m.start()))
     out["abi_rel"] = abi_rel
+    for key, pat, name in (
+        ("abi_wire_version", _WIRE_DEFINE_RE, "DLT_WIRE_VERSION"),
+        ("abi_trace_ctx_version", _TRACE_DEFINE_RE,
+         "DLT_TRACE_CTX_VERSION"),
+    ):
+        m = pat.search(abi_src)
+        if m is None:
+            ex.fail(abi_rel, 1, f"{name} #define not found")
+        else:
+            out[key] = (_to_int(m.group(1)), _line_of(abi_src, m.start()))
 
     polys = []
     for src, rel in ((wire_src, wire_rel), (codec_src, codec_rel)):
@@ -453,6 +470,7 @@ def _py_side(repo_root: str, ex: _Extract) -> Dict[str, object]:
     if out["framing_header_fmt"] is None:
         ex.fail(framing_rel, 1, '_HEADER = struct.Struct("<...") not found')
     out["type_codes"] = _type_codes(proto)
+    out["proto_int"] = _module_int_consts(proto)
     out["proto_rel"] = proto_rel
     agg_src, agg_rel = _read(repo_root, CONTRACT_FILES[8])
     agg = ast.parse(agg_src)
@@ -577,6 +595,42 @@ def extract(repo_root: str = REPO_ROOT) -> Tuple[dict, List[Finding]]:
             "bump both together",
         )
 
+    # Transport wire version and trace-context version: each is stated
+    # three times (Python authority, wire.cpp constexpr, dlt_abi.h
+    # define) and all three must agree — a one-sided bump means v1
+    # peers and v2 peers disagree about whether value bodies carry the
+    # TraceContext trailer.
+    for cname, abi_key, abi_name, table_key, pname in (
+        ("kWireVersion", "abi_wire_version", "DLT_WIRE_VERSION",
+         "framing", "WIRE_VERSION"),
+        ("kTraceCtxVersion", "abi_trace_ctx_version",
+         "DLT_TRACE_CTX_VERSION", "proto_int", "TRACE_CTX_VERSION"),
+    ):
+        rel = py[f"{table_key}_rel" if table_key != "proto_int"
+                 else "proto_rel"]
+        ent = py[table_key].get(pname)
+        if ent is None:
+            ex.fail(rel, 1, f"python authority constant {pname} not found")
+        cv = cpp_val(cname)
+        if cv is not None and ent is not None and cv != ent[0]:
+            ex.fail(
+                wire_rel, cpp_line(cname),
+                f"{cname} = {cv} in wire.cpp but the python authority "
+                f"{rel} has {pname} = {ent[0]} (line {ent[1]}): "
+                "one-sided edit — align both sides, then repin with "
+                "--audit-write",
+            )
+        abi_ent = cpp.get(abi_key)
+        if abi_ent is not None and ent is not None and (
+            abi_ent[0] != ent[0]
+        ):
+            ex.fail(
+                cpp["abi_rel"], abi_ent[1],
+                f"{abi_name} = {abi_ent[0]} in dlt_abi.h but the python "
+                f"authority {rel} has {pname} = {ent[0]} (line {ent[1]}): "
+                "bump both together",
+            )
+
     # crc polynomial agreement across the two C++ files.
     polys = cpp["crc_polys"]
     if len({p[1] for p in polys}) > 1:
@@ -668,6 +722,9 @@ def extract(repo_root: str = REPO_ROOT) -> Tuple[dict, List[Finding]]:
     ent = py["tc"].get("_MAX_NDIM")
     if ent is not None:
         contract["max_ndim"] = ent[0]
+    ent = py["proto_int"].get("TRACE_CTX_VERSION")
+    if ent is not None:
+        contract["trace_ctx_version"] = ent[0]
     contract["type_codes"] = {
         name: code for name, (code, _line) in sorted(py["type_codes"].items())
     }
