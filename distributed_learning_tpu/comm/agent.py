@@ -48,6 +48,15 @@ __all__ = [
     "RoundAbortedError",
 ]
 
+#: graftproto role annotation (tools/graftlint/proto_extract.py): the
+#: protocol state-machine extractor walks this module's isinstance
+#: dispatch branches and message-constructor send sites under this role
+#: name and cross-checks the recovered send/handle sets against
+#: protocol.py's _REGISTRY.  Dispatch must stay extractable: construct
+#: messages with explicit ``P.<Class>(...)`` calls, never through a
+#: class held in a variable.
+PROTO_ROLE = "agent"
+
 # Collective-op tag space: op_id = round_id * _OPS_PER_ROUND + seq, where
 # round_id is the master's (global, strictly increasing) round counter and
 # seq counts collective ops since that round (the round itself is seq 0,
@@ -584,7 +593,10 @@ class ConsensusAgent:
             # A neighbor one step behind (lockstep skew across an edge —
             # within an op, or across an op boundary it crossed off our
             # deferred answer — is at most 1): answer with the value it
-            # is mixing against.
+            # is mixing against.  Counted separately: the graftproto
+            # conformance replay asserts this liveness-critical path
+            # actually engaged under an injected skew-1 schedule.
+            self._count("prev_tag_answers")
             value = self._prev_value
         elif key > self._iter_key:
             self._count("requests_deferred")
@@ -1232,12 +1244,17 @@ class ConsensusAgent:
                 # consensus_asyncio.py:297 is a recorded defect).
                 residual = float(np.max(np.abs(y_new - y))) if y.size else 0.0
                 y = y_new
-                status = (
-                    P.Converged if residual <= self.convergence_eps else P.NotConverged
-                )
-                await self._master.send(
-                    status(round_id=self._round_id, iteration=self._iteration)
-                )
+                # Explicit per-class constructions (not a class held in a
+                # variable): graftproto extracts the send sites by AST.
+                if residual <= self.convergence_eps:
+                    status = P.Converged(
+                        round_id=self._round_id, iteration=self._iteration
+                    )
+                else:
+                    status = P.NotConverged(
+                        round_id=self._round_id, iteration=self._iteration
+                    )
+                await self._master.send(status)
             self._count("rounds_run")
             self._observe_round(time.perf_counter() - t0, wall_t0)
             return y

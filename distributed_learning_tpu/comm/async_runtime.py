@@ -71,6 +71,12 @@ __all__ = [
     "QUARANTINE_PAYLOAD_KIND",
 ]
 
+#: graftproto role annotation (tools/graftlint/proto_extract.py): the
+#: protocol extractor recovers this module's send/handle message sets
+#: (isinstance dispatch + ``P.<Class>(...)`` constructions) under this
+#: role and cross-checks them against protocol.py's _REGISTRY.
+PROTO_ROLE = "async_runner"
+
 #: ``payload["kind"]`` marking a Telemetry payload as a quarantine report
 #: (runner -> master): ``{"kind": ..., "accused": token, "violations": n,
 #: "round": r, "generation": g}``.  The master accumulates accusers per

@@ -49,6 +49,12 @@ from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
 
 __all__ = ["ConsensusMaster"]
 
+#: graftproto role annotation (tools/graftlint/proto_extract.py): the
+#: protocol extractor recovers this module's send/handle message sets
+#: (isinstance dispatch + ``P.<Class>(...)`` constructions) under this
+#: role and cross-checks them against protocol.py's _REGISTRY.
+PROTO_ROLE = "master"
+
 
 class ConsensusMaster:
     """Serve registration, weight distribution, and round lifecycle."""

@@ -19,6 +19,13 @@ from distributed_learning_tpu.obs import get_registry
 
 __all__ = ["StreamMultiplexer"]
 
+#: graftproto role annotation (tools/graftlint/proto_extract.py).  The
+#: multiplexer is pure transport: it yields whatever unpacks and never
+#: dispatches on a message type, so its send/handle sets are empty — the
+#: extractor still walks it so any future per-type dispatch added here
+#: lands in the pinned protocol model instead of drifting silently.
+PROTO_ROLE = "transport"
+
 
 class StreamMultiplexer:
     """``async for token, msg, stream in mux:`` over a dynamic socket set."""

@@ -444,6 +444,19 @@ def test_wire_code_unique_fires_on_phantom_and_double_registration(tmp_path):
     assert "'Ghost'" in msgs and "more than once" in msgs
 
 
+def test_wire_code_unique_fires_on_type_code_gap(tmp_path):
+    """ISSUE 15 satellite: a hole in the TYPE_CODE range means a deleted
+    code is silently reusable by the next class."""
+    code = _proto_snippet([("A", 1), ("B", 2), ("D", 4)], ["A", "B", "D"])
+    fs = _lint(
+        tmp_path, code, relname=_PROTOCOL_RELNAME,
+        rules=["wire-code-unique"],
+    )
+    assert _rules_of(fs) == ["wire-code-unique"]
+    assert "gap(s) at [3]" in fs[0].message
+    assert "renumber contiguously" in fs[0].message
+
+
 def test_wire_code_unique_fires_when_registry_table_is_missing(tmp_path):
     code = (
         "from typing import ClassVar\n"
@@ -601,16 +614,20 @@ GOLDEN_RULES = [
     "blocking-in-async",
     "branch-divergent-collective",
     "collective-order-drift",
+    "dead-message",
     "donation-alias",
     "host-sync-in-hot-path",
     "no-pickle",
     "no-print-in-library",
+    "protocol-liveness",
+    "protocol-model-pin",
     "raw-collective-in-shard-map",
     "reference-citation",
     "stdout-contract",
     "suppression-claim",
     "task-shared-mutation",
     "unawaited-coroutine",
+    "unhandled-message",
     "vma-discipline",
     "wallclock-duration",
     "wire-code-unique",
@@ -639,12 +656,12 @@ def test_cli_list_rules_json_golden():
         r["name"] for r in payload["rules"] if r["requires_reason"]
     ] == GOLDEN_REQUIRES_REASON
     assert payload["stages"] == [
-        "ast", "wire-contract", "audit", "dataflow", "native-san"
+        "ast", "wire-contract", "audit", "dataflow", "proto", "native-san"
     ]
     assert "disable=<rule>" in payload["suppression"]
     for r in payload["rules"]:
         assert r["summary"], f"rule {r['name']} has no docstring summary"
-        assert r["stage"] in ("ast", "wire-contract", "dataflow")
+        assert r["stage"] in ("ast", "wire-contract", "dataflow", "proto")
     # The human docs must mention every registered rule.
     doc = open(os.path.join(REPO_ROOT, "docs", "static_analysis.md")).read()
     missing = [r for r in GOLDEN_RULES if f"`{r}`" not in doc]

@@ -1,0 +1,454 @@
+"""graftproto tier-1 coverage (ISSUE 15): extraction, pin lifecycle,
+model checker, SARIF, --proto CLI, and the PR 8 conformance replays.
+
+Layers:
+
+* registry + role extraction over the REAL tree must be clean and match
+  the ``protocol_model`` pin in ``audit_expected.json``;
+* seeded drift (a broken dispatch branch, a retired send site, a
+  missing ``PROTO_ROLE``) in a copied tree must fire the named rule —
+  an extractor that can silently stop firing is worse than none;
+* the pin lifecycle mirrors the wire contract: unpinned -> finding,
+  ``write_pin`` -> clean, hand-drifted pin -> finding, refusal to pin
+  over cross-check findings;
+* the bounded model checker verifies every clean spec exhaustively and
+  MUST keep finding each re-seeded mutation with the expected violation
+  kind and a named trace;
+* the SARIF emitter's shape is golden-pinned;
+* both PR 8 bugs replay against the real asyncio implementation through
+  the PR 13 ``FaultPlan`` harness: the schedule predicted by the
+  model-checker counterexample drives the fixed code's skew-tolerance
+  paths (observable via counters) and completes with oracle-exact
+  values — the outcome the mutated spec proves impossible.
+"""
+
+import asyncio
+import contextlib
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_learning_tpu.comm import (
+    ConsensusAgent,
+    ConsensusMaster,
+    FaultPlan,
+    inject_neighbor_faults,
+)
+from tools.graftlint import RULES
+from tools.graftlint.core import REPO_ROOT, Finding
+from tools.graftlint import proto_extract, proto_model, sarif
+from tools.graftlint.proto_model import MUTATIONS, counterexample_for, explore
+from tools.graftlint.proto_spec import clean_specs
+
+_ASYNC_REL = "distributed_learning_tpu/comm/async_runtime.py"
+
+
+# --------------------------------------------------------------------- #
+# helpers: a mutable copy of the five protocol-bearing modules           #
+# --------------------------------------------------------------------- #
+def _copy_proto_tree(tmp_path):
+    for rel in proto_extract.PROTO_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(REPO_ROOT, rel), dst)
+    return str(tmp_path)
+
+
+def _mutate(root, rel, pattern, repl, count=1):
+    path = os.path.join(root, rel)
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    out, n = re.subn(pattern, repl, src)
+    assert n == count, f"mutation {pattern!r} matched {n}x, wanted {count}"
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(out)
+
+
+# --------------------------------------------------------------------- #
+# extraction over the real tree                                          #
+# --------------------------------------------------------------------- #
+def test_registry_codes_recovers_the_full_table():
+    codes, findings = proto_extract.registry_codes()
+    assert findings == []
+    assert sorted(codes.values()) == list(range(1, 18))
+    assert codes["AsyncPoke"] == 17
+
+
+def test_extract_real_tree_is_clean_and_total():
+    model, findings = proto_extract.extract()
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert set(model) == {"agent", "master", "async_runner", "transport"}
+    # the multiplexer is pure transport: no protocol-level dispatch
+    assert model["transport"] == {"sends": [], "handles": []}
+    # every registered message has a sender and a handler somewhere
+    codes, _ = proto_extract.registry_codes()
+    sent = set().union(*(set(r["sends"]) for r in model.values()))
+    handled = set().union(*(set(r["handles"]) for r in model.values()))
+    assert sent == set(codes)
+    assert handled == set(codes)
+    # spot anchors for the role split
+    assert "ValueRequest" in model["agent"]["sends"]
+    assert "NewRoundNotification" in model["master"]["sends"]
+    assert "AsyncPoke" in model["async_runner"]["handles"]
+
+
+def test_extract_matches_the_recorded_pin():
+    model, _ = proto_extract.extract()
+    with open(
+        os.path.join(REPO_ROOT, "tools/graftlint/audit_expected.json"),
+        encoding="utf-8",
+    ) as fh:
+        expected = json.load(fh)
+    pin = expected["protocol_model"]
+    assert pin["kind"] == "protocol-model"
+    assert pin["verified"] is True
+    assert pin["model"] == model
+
+
+def test_stage_checks_are_clean_on_the_real_tree():
+    assert proto_extract.check() == []
+    assert proto_model.check() == []
+
+
+# --------------------------------------------------------------------- #
+# seeded drift: the extractor must fire                                  #
+# --------------------------------------------------------------------- #
+def test_unhandled_message_fires_when_dispatch_branch_is_lost(tmp_path):
+    root = _copy_proto_tree(tmp_path)
+    # retarget the AsyncPoke dispatch branch: the message is still sent
+    # but no role handles it any more
+    _mutate(root, _ASYNC_REL,
+            r"isinstance\(msg, P\.AsyncPoke\)",
+            "isinstance(msg, P.AsyncValue)")
+    model, findings = proto_extract.extract(repo_root=root)
+    assert "AsyncPoke" not in model["async_runner"]["handles"]
+    msgs = [f.message for f in findings
+            if f.rule == proto_extract.UNHANDLED_RULE]
+    assert len(msgs) == 1, findings
+    assert "async_runner" in msgs[0]  # the sending role is named
+    assert "AsyncPoke" in msgs[0] and "TYPE_CODE 17" in msgs[0]
+
+
+def test_dead_message_fires_when_send_site_is_retired(tmp_path):
+    root = _copy_proto_tree(tmp_path)
+    _mutate(root, _ASYNC_REL, r"P\.AsyncPoke\(", "_local_poke(")
+    model, findings = proto_extract.extract(repo_root=root)
+    assert "AsyncPoke" not in model["async_runner"]["sends"]
+    msgs = [f.message for f in findings
+            if f.rule == proto_extract.DEAD_RULE]
+    assert len(msgs) == 1, findings
+    assert "AsyncPoke" in msgs[0] and "TYPE_CODE 17" in msgs[0]
+    assert "NO role ever sends" in msgs[0]
+
+
+def test_missing_proto_role_is_a_finding(tmp_path):
+    root = _copy_proto_tree(tmp_path)
+    _mutate(root, _ASYNC_REL,
+            r'PROTO_ROLE = "async_runner"',
+            '_PROTO_ROLE = "async_runner"')
+    model, findings = proto_extract.extract(repo_root=root)
+    assert "async_runner" not in model
+    assert any("PROTO_ROLE" in f.message for f in findings), findings
+
+
+# --------------------------------------------------------------------- #
+# pin lifecycle (the wire-contract shape)                                #
+# --------------------------------------------------------------------- #
+def test_pin_lifecycle_roundtrip(tmp_path):
+    root = _copy_proto_tree(tmp_path)
+    exp = str(tmp_path / "expected.json")
+
+    # unpinned: one actionable finding
+    findings = proto_extract.check(repo_root=root, expected_path=exp)
+    assert [f.rule for f in findings] == [proto_extract.PIN_RULE]
+    assert "--audit-write" in findings[0].message
+
+    # pin, then clean
+    assert proto_extract.write_pin(repo_root=root, expected_path=exp) == []
+    assert proto_extract.check(repo_root=root, expected_path=exp) == []
+
+    # hand-drift the pin: check must report what changed
+    with open(exp, encoding="utf-8") as fh:
+        data = json.load(fh)
+    data["protocol_model"]["model"]["agent"]["handles"].remove("Shutdown")
+    with open(exp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh)
+    findings = proto_extract.check(repo_root=root, expected_path=exp)
+    assert [f.rule for f in findings] == [proto_extract.PIN_RULE]
+    assert "drifted" in findings[0].message
+    assert "agent" in findings[0].message
+
+    # repin acknowledges the (restored) truth
+    assert proto_extract.write_pin(repo_root=root, expected_path=exp) == []
+    assert proto_extract.check(repo_root=root, expected_path=exp) == []
+
+
+def test_write_pin_refuses_over_crosscheck_findings(tmp_path):
+    """A pin must never freeze an unhandled message into the record."""
+    root = _copy_proto_tree(tmp_path)
+    _mutate(root, _ASYNC_REL,
+            r"isinstance\(msg, P\.AsyncPoke\)",
+            "isinstance(msg, P.AsyncValue)")
+    exp = str(tmp_path / "expected.json")
+    findings = proto_extract.write_pin(repo_root=root, expected_path=exp)
+    assert findings, "write_pin must surface the cross-check failure"
+    assert not os.path.exists(exp), "no pin may be written while dirty"
+
+
+# --------------------------------------------------------------------- #
+# the bounded model checker                                              #
+# --------------------------------------------------------------------- #
+def test_clean_specs_verify_exhaustively():
+    for spec in clean_specs():
+        explored, cex, exhausted = explore(spec)
+        assert exhausted, f"{spec.name} hit the state cap"
+        assert cex == [], f"{spec.name}: " + "\n".join(str(c) for c in cex)
+        assert explored > 10, f"{spec.name} explored suspiciously little"
+
+
+def test_every_seeded_mutation_is_found_with_a_named_trace():
+    for name, mut in MUTATIONS.items():
+        cex = counterexample_for(name)
+        assert cex is not None, f"mutation {name} no longer found"
+        assert cex.kind == mut.expected_kind
+        assert cex.trace, f"mutation {name} produced an empty trace"
+        rendered = str(cex)
+        assert "trace:" in rendered and cex.spec in rendered
+
+
+def test_skew1_counterexample_shows_the_stale_request_drop():
+    """The liveness trace must end with the one-op-behind request whose
+    drop (under the mutation) deadlocks the lockstep exchange."""
+    cex = counterexample_for("skew1-stale-drop")
+    assert cex.kind == "liveness"
+    assert any("deliver" in step for step in cex.trace)
+    assert any("advance" in step for step in cex.trace)
+
+
+def test_model_checker_cli_is_green(capsys):
+    assert proto_model.main() == 0
+    out = capsys.readouterr().out
+    assert "ok" in out and "found (expected)" in out
+
+
+# --------------------------------------------------------------------- #
+# SARIF emitter golden                                                   #
+# --------------------------------------------------------------------- #
+def test_sarif_shape_golden():
+    doc = sarif.to_sarif([Finding("no-pickle", "a/b.py", 3, "msg")])
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    assert [r["id"] for r in driver["rules"]] == sorted(RULES)
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"]
+        assert r["properties"]["stage"] in (
+            "ast", "wire-contract", "dataflow", "proto"
+        )
+    assert run["results"] == [{
+        "ruleId": "no-pickle",
+        "level": "error",
+        "message": {"text": "msg"},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": "a/b.py"},
+                "region": {"startLine": 3},
+            },
+        }],
+    }]
+
+
+def test_sarif_clamps_line_zero():
+    doc = sarif.to_sarif([Finding("no-pickle", "x.py", 0, "m")])
+    region = doc["runs"][0]["results"][0]["locations"][0][
+        "physicalLocation"]["region"]
+    assert region["startLine"] == 1
+
+
+def test_write_sarif_is_stable_json(tmp_path):
+    path = tmp_path / "lint.sarif"
+    sarif.write_sarif(str(path), [])
+    text = path.read_text()
+    assert text.endswith("\n")
+    assert json.loads(text)["runs"][0]["results"] == []
+
+
+# --------------------------------------------------------------------- #
+# CLI: --proto and --sarif                                               #
+# --------------------------------------------------------------------- #
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_proto_standalone_is_clean():
+    out = _cli("--proto", "--rules", "protocol-liveness")
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-500:])
+    assert "0 findings" in out.stderr
+
+
+def test_cli_sarif_writes_a_log(tmp_path):
+    path = str(tmp_path / "lint.sarif")
+    out = _cli("--proto", "--rules", "protocol-model-pin", "--sarif", path)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-500:])
+    assert "SARIF written" in out.stderr
+    doc = json.loads(open(path).read())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_proto_seeded_drift_fails(monkeypatch, capsys):
+    """A seeded unhandled-message drift must fail lint, naming the role
+    and the TYPE_CODE (in-process: subprocesses can't see the patch)."""
+    from tools.graftlint.__main__ import main as graftlint_main
+
+    seeded = Finding(
+        proto_extract.UNHANDLED_RULE,
+        "distributed_learning_tpu/comm/protocol.py", 1,
+        "role(s) async_runner send AsyncPoke (TYPE_CODE 17) but NO role "
+        "dispatches on it",
+    )
+    monkeypatch.setattr(proto_extract, "check", lambda: [seeded])
+    rc = graftlint_main(["--proto", "--rules", "unhandled-message"])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "async_runner" in out.out and "TYPE_CODE 17" in out.out
+
+
+# --------------------------------------------------------------------- #
+# conformance replay 1: the skew-1 stale-request bug (PR 8 bug 1)        #
+# --------------------------------------------------------------------- #
+def test_replay_skew1_schedule_on_real_agents():
+    """Drive the real agents through the schedule of the
+    ``skew1-stale-drop`` counterexample: chain A-B-C, C slow (so B is
+    barriered and A races one op ahead — A's future request parks in
+    B's deferral buffer), B's frames to A delayed (so B's flushed
+    response frees A before B's own request arrives, which then lands
+    on A's PREVIOUS tag).  The fixed code answers from the prev-op
+    buffer (``prev_tag_answers``) and every run completes with values
+    exactly on the metropolis-chain trajectory; the mutated spec proves
+    a stale-drop implementation deadlocks this very schedule.
+    """
+    cex = counterexample_for("skew1-stale-drop")
+    assert cex is not None and cex.kind == "liveness"
+
+    N = 5
+
+    async def main():
+        master = ConsensusMaster(
+            [("A", "B"), ("B", "C")], convergence_eps=1e-6
+        )
+        host, port = await master.start()
+        agents = {t: ConsensusAgent(t, host, port) for t in "ABC"}
+        await asyncio.gather(*(a.start() for a in agents.values()))
+        inject_neighbor_faults(
+            agents["B"], "A", FaultPlan(3, delay_p=1.0, delay_max_s=0.02)
+        )
+        vals = {"A": np.array([1.0, 3.0], np.float32),
+                "B": np.array([3.0, 1.0], np.float32),
+                "C": np.array([5.0, 5.0], np.float32)}
+        outs = {}
+
+        async def seq(tok, pause=0.0):
+            v = vals[tok]
+            for _ in range(N):
+                if pause:
+                    await asyncio.sleep(pause)  # simulated compute
+                v = await agents[tok].run_once(v)
+            outs[tok] = v
+
+        async def seq_a():
+            await seq("A")
+            # sentinel op: keeps A's exchange open so B's delayed final
+            # request is answered (via the prev-tag path) instead of
+            # sitting unread after A's last op; never completes.
+            await agents["A"].run_once(outs["A"])
+
+        a_task = asyncio.create_task(seq_a())
+        await asyncio.wait_for(
+            asyncio.gather(seq("B"), seq("C", pause=0.05)), 30
+        )
+        a_task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await a_task
+
+        # the two skew-tolerance paths the bug removed must have fired
+        assert agents["A"].counters.get("prev_tag_answers", 0) >= 1
+        assert agents["B"].counters.get("requests_deferred", 0) >= 1
+        # and the values are oracle-exact: x <- W^N x on the chain
+        W = np.array(
+            [[2 / 3, 1 / 3, 0], [1 / 3, 1 / 3, 1 / 3], [0, 1 / 3, 2 / 3]]
+        )
+        X = np.stack([vals[t] for t in "ABC"]).astype(np.float64)
+        np.testing.assert_allclose(
+            np.stack([outs[t] for t in "ABC"]),
+            np.linalg.matrix_power(W, N) @ X, atol=1e-5,
+        )
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+# --------------------------------------------------------------------- #
+# conformance replay 2: transient-convergence round end (PR 8 bug 2)     #
+# --------------------------------------------------------------------- #
+def test_replay_transient_convergence_on_real_round():
+    """Drive the real round protocol through the schedule of the
+    ``latest-status-round-end`` counterexample: chain A-B-C with values
+    1, 1, 0 makes A's iteration-0 residual exactly zero (a TRANSIENT
+    Converged report — the true consensus is 2/3), and a FaultPlan
+    delay on A's status stream staggers its delivery exactly like the
+    counterexample's channel reordering.  The fixed master ends the
+    round only at a commonly-converged iteration; a latest-status
+    implementation would have ended it at the transient.
+    """
+    cex = counterexample_for("latest-status-round-end")
+    assert cex is not None and cex.kind == "safety"
+
+    async def main():
+        master = ConsensusMaster(
+            [("A", "B"), ("B", "C")], convergence_eps=1e-5
+        )
+        host, port = await master.start()
+        agents = {t: ConsensusAgent(t, host, port) for t in "ABC"}
+        await asyncio.gather(*(a.start() for a in agents.values()))
+        plan = FaultPlan(7, delay_p=1.0, delay_max_s=0.01)
+        agents["A"]._master = plan.wrap(
+            agents["A"]._master, peer="master", edge="A->master"
+        )
+        vals = {"A": 1.0, "B": 1.0, "C": 0.0}
+        outs = await asyncio.wait_for(asyncio.gather(*(
+            agents[t].run_round(
+                np.array([vals[t]], np.float32), weight=1.0
+            ) for t in "ABC")), 45)
+
+        # the round completed (no early termination, no hang) ...
+        assert master.counters.get("rounds_done", 0) == 1
+        # ... A's transient iteration-0 convergence was REAL and seen
+        assert master._conv_at.get(0, set()) == {"A"}
+        # ... but never treated as round-ending: the first commonly-
+        # converged iteration is strictly later
+        common = [
+            it for it, s in master._conv_at.items() if len(s) == 3
+        ]
+        assert common and min(common) >= 1
+        # and everyone left at the true consensus, not the transient
+        for out in outs:
+            np.testing.assert_allclose(out, [2 / 3], atol=1e-3)
+        await master.shutdown()
+        for a in agents.values():
+            await a.close()
+
+    asyncio.run(asyncio.wait_for(main(), 60))

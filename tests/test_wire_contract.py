@@ -60,6 +60,11 @@ def test_real_tree_contract_extracts_and_passes():
         "bf16": [8, 2], "f32": [8, 4], "i8": [12, 1]
     }
     assert contract["status_codes"]["ERR_INTERNAL"] == -10
+    # ISSUE 15: the max TYPE_CODE rides in the contract so retiring the
+    # top code (invisible to the contiguity gap check) is a pin drift.
+    assert contract["max_type_code"] == max(
+        contract["type_codes"].values()
+    ) == 17
     assert wc.check() == []
 
 

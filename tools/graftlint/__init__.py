@@ -1,7 +1,7 @@
 """graftlint: static analysis enforcing this repo's SPMD, wire-format,
 concurrency, and dependency invariants.
 
-Five stages (full reference: ``docs/static_analysis.md``):
+Six stages (full reference: ``docs/static_analysis.md``):
 
 * AST (``rules.py`` + ``concurrency.py``): pluggable source rules over
   ``distributed_learning_tpu/``, ``benchmarks/``, ``examples/`` and
@@ -19,6 +19,13 @@ Five stages (full reference: ``docs/static_analysis.md``):
   suppression-claim verification against the traced program, vma
   discipline, and donation aliasing; the suppression inventory itself
   is jax-free (``--suppressions``).
+* Protocol model (``proto_extract.py`` + ``proto_spec.py`` +
+  ``proto_model.py``, ``--proto`` or under ``--audit``): extracts the
+  per-role send/handle message sets from the comm modules, cross-checks
+  them against ``protocol.py``'s registry, pins the role model in
+  ``audit_expected.json``, and bounded-model-checks the protocol specs
+  for safety + liveness (with the PR 8 bugs re-seeded as mutations the
+  checker must find).  Jax-free.
 * Sanitizer replay (``native_san.py``, ``--native``): rebuilds the
   native libs under ASan/UBSan into a separate cache and replays the
   wire fuzz corpus + oracle matrix; any report fails lint.
@@ -26,7 +33,8 @@ Five stages (full reference: ``docs/static_analysis.md``):
 CLI: ``python -m tools.graftlint`` (see ``--help``); pre-commit gate:
 ``tools/precommit.sh``; tier-1 coverage: ``tests/test_graftlint.py``,
 ``tests/test_graftlint_concurrency.py``, ``tests/test_wire_contract.py``,
-``tests/test_native_san.py``, ``tests/test_jaxpr_verify.py``.
+``tests/test_native_san.py``, ``tests/test_jaxpr_verify.py``,
+``tests/test_proto_model.py``.
 """
 
 from tools.graftlint.core import (  # noqa: F401
@@ -46,3 +54,5 @@ import tools.graftlint.rules  # noqa: F401  (registers the rule set)
 import tools.graftlint.concurrency  # noqa: F401  (async-concurrency rules)
 import tools.graftlint.jaxpr_verify  # noqa: F401  (dataflow-stage rules;
 #   the module import is jax-free — tracing stays behind --audit)
+import tools.graftlint.proto_extract  # noqa: F401  (proto-stage rules)
+import tools.graftlint.proto_model  # noqa: F401  (protocol-liveness rule)

@@ -1,7 +1,7 @@
 """CLI: ``python -m tools.graftlint [paths...]``.
 
 Exits non-zero when any unsuppressed finding (or audit/contract/
-sanitizer mismatch) survives.  Five stages:
+sanitizer mismatch) survives.  Six stages:
 
 * **AST rules** (always): import no jax — safe to run bare.
 * **Wire contract** (always on full/--changed runs touching the
@@ -11,9 +11,19 @@ sanitizer mismatch) survives.  Five stages:
 * **Dataflow verify** (``--audit``, after the inventory audit): branch
   uniformity, ordered collective sequences, suppression-claim checks,
   vma discipline, and donation aliasing (``jaxpr_verify.py``).
+* **Protocol model** (``--proto``; always on full runs and on
+  ``--changed`` runs touching a comm role module; under ``--audit``
+  the ``--audit-write`` path also repins the role model): per-role
+  send/handle extraction cross-checked against ``protocol.py``'s
+  registry, plus the bounded model check of the protocol specs
+  (safety + liveness, with the PR 8 bugs re-seeded as mutations the
+  checker must keep finding).  Jax-free.
 * **Sanitizer replay** (``--native``): rebuilds both native libs under
   ASan/UBSan into a separate cache and replays the wire fuzz corpus +
   oracle matrix; skips with a notice when the toolchain is absent.
+
+``--sarif <path>`` additionally serializes every finding the invoked
+stages produced as one SARIF 2.1.0 log (``sarif.py``).
 
 ``--entry <name>`` (repeatable, with ``--audit``/``--audit-write``/
 ``--report-unverified``) restricts the trace stages to the named entry
@@ -42,7 +52,7 @@ from tools.graftlint import (
     RULES,
     lint_paths,
 )
-from tools.graftlint import wire_contract
+from tools.graftlint import proto_extract, proto_model, wire_contract
 
 
 def _changed_files(repo_root: str = REPO_ROOT) -> Tuple[list, list, list]:
@@ -99,7 +109,7 @@ def _list_rules(as_json: bool) -> int:
                 "rules": rules,
                 "stages": [
                     "ast", "wire-contract", "audit", "dataflow",
-                    "native-san",
+                    "proto", "native-san",
                 ],
                 "suppression":
                     "# graftlint: disable=<rule>[,<rule>] -- <reason>",
@@ -307,6 +317,14 @@ def main(argv=None) -> int:
                     help="build the native libs under ASan/UBSan into a "
                     "separate cache and replay the wire fuzz corpus + "
                     "oracle matrix; any sanitizer report fails lint")
+    ap.add_argument("--proto", action="store_true",
+                    help="force the protocol stage (role-model "
+                    "extraction cross-check + pin + bounded model "
+                    "check) even when the selection would skip it; "
+                    "imports no jax")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write every finding the invoked stages "
+                    "produced as a SARIF 2.1.0 log at PATH")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -349,7 +367,7 @@ def main(argv=None) -> int:
 
     aux_stage = (
         args.audit or args.audit_write or args.report_unverified
-        or args.native
+        or args.native or args.proto or args.sarif is not None
     )
     paths = args.paths
     changed_rels: List[str] = []
@@ -406,6 +424,33 @@ def main(argv=None) -> int:
     if run_contract:
         findings.extend(wire_contract.check())
 
+    # Protocol stage: full runs always; --proto forces it; --changed
+    # runs when a comm role module (or protocol.py) changed; explicit-
+    # path runs when one was named; skipped when a --rules subset
+    # excludes all four of its rule names.  Jax-free, like the AST and
+    # wire-contract stages.
+    proto_rules = {
+        proto_extract.UNHANDLED_RULE, proto_extract.DEAD_RULE,
+        proto_extract.PIN_RULE, proto_model.LIVENESS_RULE,
+    }
+    run_proto = rules is None or bool(proto_rules & set(rules))
+    if run_proto and not args.proto:
+        if args.changed:
+            run_proto = any(
+                rel in proto_extract.PROTO_FILES for rel in changed_rels
+            )
+        elif args.paths:
+            named = {
+                os.path.relpath(os.path.abspath(p), REPO_ROOT).replace(
+                    os.sep, "/"
+                )
+                for p in args.paths
+            }
+            run_proto = bool(named & set(proto_extract.PROTO_FILES))
+    if run_proto:
+        findings.extend(proto_extract.check())
+        findings.extend(proto_model.check())
+
     for f in findings:
         print(str(f))
     rc = 1 if findings else 0
@@ -419,10 +464,17 @@ def main(argv=None) -> int:
                 rc = 1
             if not pin_findings:
                 print("audit wire_contract: pin written", file=sys.stderr)
+            proto_pin_findings = proto_extract.write_pin()
+            for f in proto_pin_findings:
+                print(str(f))
+                rc = 1
+            if not proto_pin_findings:
+                print("audit protocol_model: pin written",
+                      file=sys.stderr)
         elif args.audit_write:
             print(
-                "audit wire_contract: pin left untouched "
-                "(--entry filter)",
+                "audit wire_contract / protocol_model: pins left "
+                "untouched (--entry filter)",
                 file=sys.stderr,
             )
         rc = max(rc, _run_audit(write=args.audit_write,
@@ -437,6 +489,13 @@ def main(argv=None) -> int:
     if args.native:
         native_rc, _detail = _run_native()
         rc = max(rc, native_rc)
+
+    if args.sarif is not None:
+        from tools.graftlint import sarif as sarif_mod
+
+        sarif_mod.write_sarif(args.sarif, findings)
+        print(f"graftlint: SARIF written to {args.sarif}",
+              file=sys.stderr)
 
     n = len(findings)
     print(

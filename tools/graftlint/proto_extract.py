@@ -1,0 +1,400 @@
+"""graftproto stage (a): protocol state-machine extraction (ISSUE 15).
+
+The wire protocol's dispatch lives in four comm modules — ``agent.py``,
+``master.py``, ``async_runtime.py``, ``multiplexer.py`` — as isinstance
+branches and ``P.<Class>(...)`` send sites, with the 17 message classes
+registered once in ``protocol.py``'s ``_REGISTRY``.  Nothing previously
+tied the two together: a new message class wired into one side only (a
+sender nobody dispatches on, or a registered code no role ever emits)
+failed at runtime, on the first frame, in whatever deployment happened
+to exercise it first.
+
+This stage recovers, per role (each comm module carries a module-level
+``PROTO_ROLE`` annotation), the set of message classes the role can
+*send* (constructor calls on registry classes) and *handle* (isinstance
+dispatch tests), ``ast``-only — no jax, no imports of the comm modules
+— and cross-checks the union against ``_REGISTRY``:
+
+* **``unhandled-message``** — some role sends a registered message that
+  NO role handles: the frame arrives, unpacks fine, and is dropped on
+  the floor (or worse, hits a default branch) — named with the sending
+  role(s) and the TYPE_CODE.
+* **``dead-message``** — a registered message no role ever sends: dead
+  wire surface whose TYPE_CODE is silently reusable (see the
+  ``wire-code-unique`` gap check for the deleted-code variant).
+
+The extracted role model is additionally PINNED under the
+``protocol_model`` key of ``audit_expected.json`` (rule
+``protocol-model-pin``) through the same ``--audit-write`` lifecycle as
+the wire contract: growing a role's send/handle set is fine — but it
+must be acknowledged with a repin, so the protocol surface never drifts
+silently between stacked PRs.
+
+Extraction contract on the comm modules (enforced here by failing
+loudly, documented at each ``PROTO_ROLE``): dispatch is isinstance on
+``P.<Class>`` (single or tuple), sends construct ``P.<Class>(...)``
+directly — never through a class held in a variable (the ``status =
+P.Converged if ... else P.NotConverged`` shape was refactored out).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import REPO_ROOT, Finding, Rule, register
+from tools.graftlint.jaxpr_audit import EXPECTED_PATH
+
+UNHANDLED_RULE = "unhandled-message"
+DEAD_RULE = "dead-message"
+PIN_RULE = "protocol-model-pin"
+
+#: Repo-relative files the stage reads; a --changed run that touched any
+#: of them re-runs the stage (same gating shape as the wire contract).
+PROTO_FILES = (
+    "distributed_learning_tpu/comm/protocol.py",
+    "distributed_learning_tpu/comm/agent.py",
+    "distributed_learning_tpu/comm/master.py",
+    "distributed_learning_tpu/comm/async_runtime.py",
+    "distributed_learning_tpu/comm/multiplexer.py",
+)
+
+#: The registry authority (first entry of PROTO_FILES).
+_PROTOCOL_REL = PROTO_FILES[0]
+#: The role modules the extractor walks (everything but the authority).
+ROLE_FILES = PROTO_FILES[1:]
+
+
+@register
+class UnhandledMessage(Rule):
+    """A sent message class must have a handler in some role."""
+
+    name = UNHANDLED_RULE
+    stage = "proto"
+
+    def check(self, ctx) -> List[Finding]:  # stage-level, not per-file
+        return []
+
+
+@register
+class DeadMessage(Rule):
+    """A registered message class must have a sender in some role."""
+
+    name = DEAD_RULE
+    stage = "proto"
+
+    def check(self, ctx) -> List[Finding]:  # stage-level, not per-file
+        return []
+
+
+@register
+class ProtocolModelPin(Rule):
+    """The extracted role model must match its audit_expected.json pin."""
+
+    name = PIN_RULE
+    stage = "proto"
+
+    def check(self, ctx) -> List[Finding]:  # stage-level, not per-file
+        return []
+
+
+# --------------------------------------------------------------------- #
+# Registry extraction (protocol.py authority)                           #
+# --------------------------------------------------------------------- #
+def _parse(repo_root: str, rel: str) -> Tuple[Optional[ast.Module], str]:
+    path = os.path.join(repo_root, rel)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return ast.parse(fh.read()), rel
+    except (OSError, SyntaxError):
+        return None, rel
+
+
+def _type_code_of(cls: ast.ClassDef) -> Optional[int]:
+    for node in cls.body:
+        target = None
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            target = node.target.id
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+        ):
+            target = node.targets[0].id
+        if target != "TYPE_CODE":
+            continue
+        value = node.value
+        if isinstance(value, ast.Constant) and isinstance(value.value, int):
+            return value.value
+    return None
+
+
+def registry_codes(
+    repo_root: str = REPO_ROOT,
+) -> Tuple[Dict[str, int], List[Finding]]:
+    """``{class name: TYPE_CODE}`` for every class enumerated in
+    protocol.py's ``_REGISTRY`` dict-comprehension (the single dispatch
+    table the ``wire-code-unique`` rule guards)."""
+    tree, rel = _parse(repo_root, _PROTOCOL_REL)
+    if tree is None:
+        return {}, [Finding(
+            UNHANDLED_RULE, rel, 1,
+            "protocol.py could not be parsed: the graftproto extractor "
+            "has no registry authority to check roles against",
+        )]
+    codes: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            code = _type_code_of(node)
+            if code is not None and code >= 0:
+                codes[node.name] = code
+    reg_names: Optional[List[str]] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if (
+            isinstance(target, ast.Name)
+            and target.id == "_REGISTRY"
+            and isinstance(node.value, ast.DictComp)
+            and node.value.generators
+        ):
+            src = node.value.generators[0].iter
+            if isinstance(src, (ast.Tuple, ast.List)):
+                reg_names = [
+                    el.id for el in src.elts if isinstance(el, ast.Name)
+                ]
+    if reg_names is None:
+        return {}, [Finding(
+            UNHANDLED_RULE, rel, 1,
+            "no _REGISTRY dict-comprehension found in protocol.py: the "
+            "graftproto extractor cannot recover the message table "
+            "(wire-code-unique guards the table's own integrity)",
+        )]
+    # The registry view: names both listed AND carrying a code (table
+    # integrity itself is wire-code-unique's job, not re-reported here).
+    return {n: codes[n] for n in reg_names if n in codes}, []
+
+
+# --------------------------------------------------------------------- #
+# Role extraction (the four comm modules)                               #
+# --------------------------------------------------------------------- #
+def _protocol_aliases(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(module aliases bound to comm.protocol, class names imported
+    directly from it) — e.g. ``from ... import protocol as P`` -> {"P"},
+    ``from .protocol import ValueRequest`` -> {"ValueRequest"}."""
+    mod_aliases: Set[str] = set()
+    direct: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                if al.name.endswith(".protocol") or al.name == "protocol":
+                    mod_aliases.add(al.asname or al.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.endswith("protocol") or mod == "protocol":
+                for al in node.names:
+                    direct.add(al.asname or al.name)
+            else:
+                for al in node.names:
+                    if al.name == "protocol":
+                        mod_aliases.add(al.asname or "protocol")
+    return mod_aliases, direct
+
+
+def _message_name(node: ast.AST, mod_aliases: Set[str],
+                  direct: Set[str]) -> Optional[str]:
+    """The protocol class name an expression refers to, if any."""
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id in mod_aliases:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in direct:
+        return node.id
+    return None
+
+
+def _extract_role(
+    tree: ast.Module, rel: str, registry: Dict[str, int]
+) -> Tuple[Optional[str], Set[str], Set[str], List[Finding]]:
+    """(role, sends, handles, findings) for one comm module."""
+    findings: List[Finding] = []
+    role: Optional[str] = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "PROTO_ROLE"
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            role = node.value.value
+    if role is None:
+        findings.append(Finding(
+            UNHANDLED_RULE, rel, 1,
+            "no module-level PROTO_ROLE annotation: the graftproto "
+            "extractor cannot attribute this module's dispatch to a "
+            "role — add PROTO_ROLE = \"<role>\"",
+        ))
+        return None, set(), set(), findings
+    mod_aliases, direct = _protocol_aliases(tree)
+    sends: Set[str] = set()
+    handles: Set[str] = set()
+    for node in ast.walk(tree):
+        # Handle sites: isinstance(x, P.Cls) / isinstance(x, (P.A, P.B)).
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Name
+        ) and node.func.id == "isinstance" and len(node.args) == 2:
+            spec = node.args[1]
+            elts = spec.elts if isinstance(
+                spec, (ast.Tuple, ast.List)
+            ) else [spec]
+            for el in elts:
+                name = _message_name(el, mod_aliases, direct)
+                if name is not None and name in registry:
+                    handles.add(name)
+            continue
+        # Send sites: P.Cls(...) constructor calls on registry classes.
+        if isinstance(node, ast.Call):
+            name = _message_name(node.func, mod_aliases, direct)
+            if name is not None and name in registry:
+                sends.add(name)
+    return role, sends, handles, findings
+
+
+def extract(
+    repo_root: str = REPO_ROOT,
+) -> Tuple[Dict[str, Dict[str, List[str]]], List[Finding]]:
+    """The role model ``{role: {"sends": [...], "handles": [...]}}``
+    plus the registry cross-check findings (unhandled/dead messages).
+    """
+    registry, findings = registry_codes(repo_root)
+    model: Dict[str, Dict[str, List[str]]] = {}
+    if not registry:
+        return model, findings
+    sent_by: Dict[str, Set[str]] = {}
+    handled_by: Dict[str, Set[str]] = {}
+    for rel in ROLE_FILES:
+        tree, rel = _parse(repo_root, rel)
+        if tree is None:
+            findings.append(Finding(
+                UNHANDLED_RULE, rel, 1,
+                "role module could not be parsed: the graftproto "
+                "extractor has an incomplete view of the protocol — "
+                "fix the module, do not pin around it",
+            ))
+            continue
+        role, sends, handles, role_findings = _extract_role(
+            tree, rel, registry
+        )
+        findings.extend(role_findings)
+        if role is None:
+            continue
+        if role in model:
+            findings.append(Finding(
+                UNHANDLED_RULE, rel, 1,
+                f"duplicate PROTO_ROLE {role!r}: every comm module must "
+                "declare a distinct role",
+            ))
+            continue
+        model[role] = {
+            "sends": sorted(sends), "handles": sorted(handles),
+        }
+        for name in sends:
+            sent_by.setdefault(name, set()).add(role)
+        for name in handles:
+            handled_by.setdefault(name, set()).add(role)
+    proto_rel = _PROTOCOL_REL
+    for name, code in sorted(registry.items(), key=lambda kv: kv[1]):
+        senders = sorted(sent_by.get(name, ()))
+        handlers = sorted(handled_by.get(name, ()))
+        if senders and not handlers:
+            findings.append(Finding(
+                UNHANDLED_RULE, proto_rel, 1,
+                f"role(s) {', '.join(senders)} send {name} (TYPE_CODE "
+                f"{code}) but NO role dispatches on it: the frame "
+                "arrives, unpacks, and is dropped on the floor — wire "
+                "a handler branch or retire the send site",
+            ))
+        elif handlers and not senders:
+            findings.append(Finding(
+                DEAD_RULE, proto_rel, 1,
+                f"{name} (TYPE_CODE {code}) is registered and handled "
+                f"by {', '.join(handlers)} but NO role ever sends it: "
+                "dead wire surface — retire the class (and mind the "
+                "wire-code-unique TYPE_CODE gap check) or wire the "
+                "sender",
+            ))
+        elif not senders and not handlers:
+            findings.append(Finding(
+                DEAD_RULE, proto_rel, 1,
+                f"{name} (TYPE_CODE {code}) is registered but no role "
+                "sends OR handles it: fully dead wire surface",
+            ))
+    return model, findings
+
+
+# --------------------------------------------------------------------- #
+# Pin lifecycle (the wire_contract.py shape)                            #
+# --------------------------------------------------------------------- #
+def check(
+    repo_root: str = REPO_ROOT, expected_path: str = EXPECTED_PATH
+) -> List[Finding]:
+    """Run the stage: cross-check findings plus the role-model pin."""
+    model, findings = extract(repo_root)
+    pin_rel = os.path.relpath(expected_path, repo_root).replace(os.sep, "/")
+    expected = {}
+    if os.path.exists(expected_path):
+        with open(expected_path, "r", encoding="utf-8") as fh:
+            expected = json.load(fh)
+    pinned = expected.get("protocol_model", {}).get("model")
+    if pinned is None:
+        findings.append(Finding(
+            PIN_RULE, pin_rel, 1,
+            "protocol role model has no pin recorded; run "
+            "'python -m tools.graftlint --audit-write' to record it",
+        ))
+        return findings
+    if model and pinned != model:
+        gone = {k: v for k, v in pinned.items() if model.get(k) != v}
+        new = {k: v for k, v in model.items() if pinned.get(k) != v}
+        findings.append(Finding(
+            PIN_RULE, pin_rel, 1,
+            f"protocol role model drifted from its pin: expected "
+            f"{json.dumps(gone, sort_keys=True)} but observed "
+            f"{json.dumps(new, sort_keys=True)} — if the protocol "
+            "change is intentional, acknowledge it with "
+            "'python -m tools.graftlint --audit-write'",
+        ))
+    return findings
+
+
+def write_pin(
+    repo_root: str = REPO_ROOT, expected_path: str = EXPECTED_PATH
+) -> List[Finding]:
+    """Record the observed role model as the pin (the --audit-write
+    path).  Cross-check findings still fail: a pin must never freeze an
+    unhandled or dead message."""
+    model, findings = extract(repo_root)
+    if findings:
+        return findings
+    expected = {}
+    if os.path.exists(expected_path):
+        with open(expected_path, "r", encoding="utf-8") as fh:
+            expected = json.load(fh)
+    expected["protocol_model"] = {
+        "kind": "protocol-model",
+        "model": model,
+        "verified": True,
+        "provenance": "static extraction from the comm role modules "
+        "(tools/graftlint/proto_extract.py); every registered message "
+        "had a sender and a handler at pin time",
+    }
+    with open(expected_path, "w", encoding="utf-8") as fh:
+        json.dump(expected, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return []

@@ -1,0 +1,255 @@
+"""graftproto stage (b): bounded explicit-state model checker.
+
+Exhaustively explores the finite protocol specs in
+``tools/graftlint/proto_spec.py`` (breadth-first over hashable states,
+parent pointers for trace reconstruction) and checks:
+
+* **safety** — ``spec.safety(state)`` must be empty in every reachable
+  state; a violation yields a named counterexample whose trace is the
+  action-label path from the initial state.
+* **liveness** — every *terminal* reachable state (no enabled action)
+  must satisfy ``spec.is_goal``; a terminal non-goal state is a
+  deadlock/livelock counterexample (in these specs every action
+  consumes bounded script/channel/duplication budget, so bounded
+  exploration covers all executions and "terminates in every terminal
+  state" IS round-termination liveness).
+
+The stage's power is self-tested on every run: the two PR 8 bugs are
+re-seeded as spec mutations (``MUTATIONS``) that the checker MUST find
+— a mutation that stops producing its expected counterexample means
+the checker lost discrimination, and that is itself a lint failure
+("protocol-liveness"), exactly like a sanitizer whose known-bad corpus
+stops failing.  ``tests/test_proto_model.py`` replays both mutation
+counterexamples against the real asyncio implementation through the
+PR 13 ``FaultPlan`` harness.
+
+Run standalone (jax-free): ``python -m tools.graftlint --proto`` or
+``python -m tools.graftlint.proto_model``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tools.graftlint.core import Finding, Rule, register
+from tools.graftlint.proto_spec import (
+    AsyncSpec,
+    LockstepSpec,
+    RoundSpec,
+    clean_specs,
+)
+
+LIVENESS_RULE = "protocol-liveness"
+
+#: The file findings anchor to (the specs are the checkable artifact).
+SPEC_REL = "tools/graftlint/proto_spec.py"
+
+#: Exploration cap — far above any current spec (the largest explores
+#: ~30k states); hitting it is reported as a finding, never truncated
+#: silently.
+MAX_STATES = 400_000
+
+
+@register
+class ProtocolLiveness(Rule):
+    """A model-checked protocol spec must satisfy safety and liveness."""
+
+    name = LIVENESS_RULE
+    stage = "proto"
+
+    def check(self, ctx) -> List[Finding]:  # stage-level, not per-file
+        return []
+
+
+@dataclasses.dataclass(frozen=True)
+class Counterexample:
+    """One named violation with its replayable action trace."""
+
+    spec: str
+    kind: str  # "safety" | "liveness"
+    violation: str
+    trace: Tuple[str, ...]
+
+    def __str__(self) -> str:
+        steps = " -> ".join(self.trace) if self.trace else "<initial>"
+        return (
+            f"[{self.kind}] {self.spec}: {self.violation}\n"
+            f"  trace: {steps}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutation:
+    """A seeded spec bug the checker must keep finding."""
+
+    factory: Callable[[], object]
+    expected_kind: str
+    description: str
+
+
+#: Named re-seeded bugs (both PR 8 regressions plus the double-consume
+#: the tag machinery exists to prevent).  tests/test_proto_model.py
+#: replays the first two against the real implementation.
+MUTATIONS: Dict[str, Mutation] = {
+    "skew1-stale-drop": Mutation(
+        factory=lambda: LockstepSpec(
+            n_agents=2, n_ops=2, mutation="skew1-stale-drop"
+        ),
+        expected_kind="liveness",
+        description=(
+            "PR 8 bug 1: a responder one op ahead treats the "
+            "neighbor's previous-tag value request as stale and drops "
+            "it — un-barriered run_once sequences deadlock"
+        ),
+    ),
+    "latest-status-round-end": Mutation(
+        factory=lambda: RoundSpec(mutation="latest-status-round-end"),
+        expected_kind="safety",
+        description=(
+            "PR 8 bug 2: the master ends a round when every "
+            "participant's LATEST status reads Converged, terminating "
+            "at transiently-zero residuals instead of a commonly-"
+            "converged iteration"
+        ),
+    ),
+    "choco-replay-apply": Mutation(
+        factory=lambda: AsyncSpec(mutation="choco-replay-apply"),
+        expected_kind="safety",
+        description=(
+            "a stale (replayed) async frame's hat correction is "
+            "applied instead of only counted — double-consume of a "
+            "correction the staleness check exists to prevent"
+        ),
+    ),
+}
+
+
+def _trace(parents: Dict, state) -> Tuple[str, ...]:
+    steps: List[str] = []
+    while True:
+        entry = parents[state]
+        if entry is None:
+            break
+        state, label = entry
+        steps.append(label)
+    return tuple(reversed(steps))
+
+
+def explore(
+    spec, max_states: int = MAX_STATES, max_counterexamples: int = 3
+) -> Tuple[int, List[Counterexample], bool]:
+    """(states explored, counterexamples, exhausted) for one spec.
+
+    ``exhausted`` is False when the state cap was hit — the result is
+    then a partial view and the caller must report that, not pass.
+    """
+    init = spec.initial()
+    parents: Dict = {init: None}
+    queue = deque([init])
+    cex: List[Counterexample] = []
+    seen_violations = set()
+    explored = 0
+    while queue and explored < max_states:
+        state = queue.popleft()
+        explored += 1
+        for violation in spec.safety(state):
+            if (
+                violation not in seen_violations
+                and len(cex) < max_counterexamples
+            ):
+                seen_violations.add(violation)
+                cex.append(Counterexample(
+                    spec.name, "safety", violation,
+                    _trace(parents, state),
+                ))
+        actions = spec.actions(state)
+        if not actions:
+            if not spec.is_goal(state) and len(cex) < max_counterexamples:
+                violation = (
+                    "terminal state does not satisfy the liveness goal "
+                    "(deadlock: no action enabled, protocol not done)"
+                )
+                if ("terminal", state) not in seen_violations:
+                    # one liveness counterexample is enough per spec
+                    if not any(c.kind == "liveness" for c in cex):
+                        cex.append(Counterexample(
+                            spec.name, "liveness", violation,
+                            _trace(parents, state),
+                        ))
+            continue
+        for label, succ in actions:
+            if succ not in parents:
+                parents[succ] = (state, label)
+                queue.append(succ)
+    return explored, cex, not queue
+
+
+def check() -> List[Finding]:
+    """The model-check half of the proto stage (extraction cross-check
+    lives in ``proto_extract.check``): clean specs must verify, seeded
+    mutations must keep failing with the expected violation kind."""
+    findings: List[Finding] = []
+    for spec in clean_specs():
+        explored, cex, exhausted = explore(spec)
+        if not exhausted:
+            findings.append(Finding(
+                LIVENESS_RULE, SPEC_REL, 1,
+                f"spec {spec.name} exceeded the {MAX_STATES}-state "
+                f"exploration cap ({explored} explored): the bounded "
+                "check is no longer exhaustive — shrink the spec "
+                "bounds",
+            ))
+        for c in cex:
+            findings.append(Finding(
+                LIVENESS_RULE, SPEC_REL, 1, str(c),
+            ))
+    for name, mut in MUTATIONS.items():
+        spec = mut.factory()
+        _, cex, _ = explore(spec)
+        if not any(c.kind == mut.expected_kind for c in cex):
+            findings.append(Finding(
+                LIVENESS_RULE, SPEC_REL, 1,
+                f"seeded mutation {name!r} ({mut.description}) no "
+                f"longer produces a {mut.expected_kind} counterexample "
+                "— the model checker lost the power to find the bug "
+                "it exists to catch",
+            ))
+    return findings
+
+
+def counterexample_for(name: str) -> Optional[Counterexample]:
+    """The first expected-kind counterexample of a named mutation (the
+    conformance-replay tests anchor on its trace)."""
+    mut = MUTATIONS[name]
+    _, cex, _ = explore(mut.factory())
+    for c in cex:
+        if c.kind == mut.expected_kind:
+            return c
+    return None
+
+
+def main() -> int:
+    rc = 0
+    for spec in clean_specs():
+        explored, cex, exhausted = explore(spec)
+        status = "ok" if (exhausted and not cex) else "FAIL"
+        rc = rc or (0 if status == "ok" else 1)
+        print(f"{spec.name:28s} {explored:7d} states  {status}")
+        for c in cex:
+            print(f"  {c}")
+    for name, mut in MUTATIONS.items():
+        spec = mut.factory()
+        explored, cex, _ = explore(spec)
+        found = [c for c in cex if c.kind == mut.expected_kind]
+        status = "found (expected)" if found else "NOT FOUND"
+        rc = rc or (0 if found else 1)
+        print(f"{spec.name:28s} {explored:7d} states  {status}")
+        for c in found[:1]:
+            print(f"  {c}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,457 @@
+"""graftproto stage (b): executable protocol specs for the model checker.
+
+Three jax-free, finite, explicit-state specs of the gossip wire
+protocol's coordination cores, small enough to explore exhaustively
+(``tools/graftlint/proto_model.py``) yet faithful enough that their
+counterexample traces replay against the real asyncio implementation
+through the PR 13 fault harness (``tests/test_proto_model.py``):
+
+* **LockstepSpec** — the masterless per-op value exchange
+  (``comm/agent.py`` ``_exchange_values``/``_answer``): agents publish
+  a tagged request to every neighbor, answer requests by tag (current
+  tag, previous tag, defer-future, drop-stale), and advance when every
+  neighbor answered.  Un-barriered ``run_once`` sequences let neighbors
+  skew by one op — answering the *previous* tag is the liveness-
+  critical path PR 8's first bug dropped.  Mutation
+  ``skew1-stale-drop`` re-seeds that bug: prev-tag requests are treated
+  as stale and dropped, and the checker finds the deadlock.
+* **RoundSpec** — the master's round-termination rule
+  (``comm/master.py`` ``_on_status``): a round ends only when ONE
+  iteration saw every participant report Converged.  Mutation
+  ``latest-status-round-end`` re-seeds PR 8's second bug (end when the
+  *latest* status from every participant is Converged), which ends
+  rounds at transiently-zero residuals — the checker reports the
+  safety violation with the interleaving that exposes it.
+* **AsyncSpec** — the async push/staleness/quarantine path
+  (``comm/async_runtime.py``): honest agents exchange monotone rounds
+  (with a bounded duplication budget on honest edges), a byzantine
+  peer replays stale rounds, receivers count staleness violations and
+  accuse past a threshold, the master evicts at an accuser quorum.
+  Safety: a hat-correction payload is consumed at most once and the
+  quarantine never evicts an honest agent; liveness: the byzantine
+  peer is evicted in every terminal state.  Mutation
+  ``choco-replay-apply`` applies stale payloads anyway (the
+  double-consume the PR 8 tag machinery exists to prevent).
+
+Spec interface (shared with the checker):
+
+* ``name`` — stable identifier used in counterexample traces.
+* ``initial()`` — the (hashable) start state.
+* ``actions(state)`` — list of ``(label, successor)`` pairs; labels are
+  human-readable and become the counterexample trace lines.
+* ``safety(state)`` — list of violated-invariant strings (empty = ok).
+* ``is_goal(state)`` — liveness: every *terminal* state (no enabled
+  action) must satisfy this.
+
+All state is plain nested tuples/frozensets: hashable, comparable,
+allocation-cheap.  No jax, no asyncio — safe to run bare, anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+State = tuple
+
+
+# --------------------------------------------------------------------- #
+# LockstepSpec — masterless per-op exchange (PR 8 bug 1)                #
+# --------------------------------------------------------------------- #
+class LockstepSpec:
+    """Masterless tagged value exchange with skew-1 neighbors.
+
+    State layout::
+
+        (agents, channels)
+        agents   = tuple per agent of (op, sent, answered, deferred)
+                   answered = frozenset of neighbor ids
+                   deferred = frozenset of (requester, op) pairs
+        channels = tuple over directed edges (i, j) sorted, each a
+                   tuple of in-flight ("req"|"resp", op) messages
+
+    ``cur_tag = op if sent else op - 1`` mirrors ``agent._iter_key``
+    being published at exchange start; ``prev_tag = cur_tag - 1``
+    mirrors ``agent._prev_key``.
+    """
+
+    def __init__(self, n_agents: int = 2, n_ops: int = 2,
+                 mutation: Optional[str] = None, reorder: bool = True):
+        self.name = f"lockstep[n={n_agents},ops={n_ops}" + (
+            f",mut={mutation}]" if mutation else "]"
+        )
+        self.n_agents = n_agents
+        self.n_ops = n_ops
+        self.mutation = mutation
+        self.reorder = reorder
+        self.edges = tuple(
+            (i, j)
+            for i in range(n_agents)
+            for j in range(n_agents)
+            if i != j
+        )
+
+    def initial(self) -> State:
+        agents = tuple(
+            (0, False, frozenset(), frozenset())
+            for _ in range(self.n_agents)
+        )
+        channels = tuple(() for _ in self.edges)
+        return (agents, channels)
+
+    def _send(self, channels: Tuple, edge: Tuple[int, int], msg) -> Tuple:
+        k = self.edges.index(edge)
+        return channels[:k] + (channels[k] + (msg,),) + channels[k + 1:]
+
+    def actions(self, state: State):
+        agents, channels = state
+        out = []
+        for i, (op, sent, answered, deferred) in enumerate(agents):
+            neighbors = frozenset(
+                j for j in range(self.n_agents) if j != i
+            )
+            if op < self.n_ops and not sent:
+                # Publish: request op from every neighbor, flush any
+                # deferred requests for the tag being published (the
+                # agent._flush_deferred parity point).
+                ch = channels
+                for j in neighbors:
+                    ch = self._send(ch, (i, j), ("req", op))
+                kept = deferred
+                for (rq, dop) in sorted(deferred):
+                    if dop == op:
+                        ch = self._send(ch, (i, rq), ("resp", dop))
+                        kept = kept - {(rq, dop)}
+                na = agents[:i] + (
+                    (op, True, answered, kept),
+                ) + agents[i + 1:]
+                out.append((f"publish(agent={i},op={op})", (na, ch)))
+            if sent and answered >= neighbors:
+                na = agents[:i] + (
+                    (op + 1, False, frozenset(), deferred),
+                ) + agents[i + 1:]
+                out.append((f"advance(agent={i},to={op + 1})",
+                            (na, channels)))
+        for k, (src, dst) in enumerate(self.edges):
+            chan = channels[k]
+            if not chan:
+                continue
+            slots = range(len(chan)) if self.reorder else (0,)
+            for s in slots:
+                msg = chan[s]
+                rest = chan[:s] + chan[s + 1:]
+                ch = channels[:k] + (rest,) + channels[k + 1:]
+                label = (
+                    f"deliver({src}->{dst},{msg[0]},op={msg[1]})"
+                )
+                out.append(
+                    (label, self._receive(agents, ch, src, dst, msg))
+                )
+        return out
+
+    def _receive(self, agents, channels, src, dst, msg) -> State:
+        op, sent, answered, deferred = agents[dst]
+        cur = op if sent else op - 1
+        prev = cur - 1
+        kind, o = msg
+        if kind == "req":
+            if o == cur:
+                channels = self._send(channels, (dst, src), ("resp", o))
+            elif o == prev:
+                if self.mutation == "skew1-stale-drop":
+                    pass  # the re-seeded PR 8 bug: prev tag == stale
+                else:
+                    channels = self._send(
+                        channels, (dst, src), ("resp", o)
+                    )
+            elif o > cur:
+                deferred = deferred | {(src, o)}
+            # else: genuinely stale (two behind can never await us)
+        else:  # resp
+            if sent and o == op:
+                answered = answered | {src}
+            # tag-mismatched responses are never consumed
+        na = agents[:dst] + (
+            (op, sent, answered, deferred),
+        ) + agents[dst + 1:]
+        return (na, channels)
+
+    def safety(self, state: State) -> List[str]:
+        agents, _ = state
+        return [
+            f"agent {i} overran the op schedule ({op} > {self.n_ops})"
+            for i, (op, _, _, _) in enumerate(agents)
+            if op > self.n_ops
+        ]
+
+    def is_goal(self, state: State) -> bool:
+        agents, _ = state
+        return all(op == self.n_ops for (op, _, _, _) in agents)
+
+
+# --------------------------------------------------------------------- #
+# RoundSpec — master round termination (PR 8 bug 2)                     #
+# --------------------------------------------------------------------- #
+class RoundSpec:
+    """Master round-end rule against out-of-phase convergence reports.
+
+    Two agents follow scripted status sequences chosen so each is
+    *transiently* converged at a different iteration (the symmetric-
+    initial-values shape that broke PR 8): A reports Converged at
+    iterations 0 and 2, B at 1 and 2.  Only iteration 2 is commonly
+    converged, so the round must not end before both C@2 reports are
+    delivered.
+
+    State layout::
+
+        (ptrs, channels, conv, latest, ended)
+        ptrs     = per-agent script pointer
+        channels = per-agent FIFO of ("C"|"N", iteration) to the master
+        conv     = per-iteration frozenset of agents whose Converged
+                   for that iteration was delivered
+        latest   = per-agent latest delivered status or None
+        ended    = round-ended flag
+    """
+
+    SCRIPTS = (
+        (("C", 0), ("N", 1), ("C", 2)),
+        (("N", 0), ("C", 1), ("C", 2)),
+    )
+    N_ITERS = 3
+
+    def __init__(self, mutation: Optional[str] = None):
+        self.name = "round[master+2]" + (
+            f"[mut={mutation}]" if mutation else ""
+        )
+        self.mutation = mutation
+        self.n_agents = len(self.SCRIPTS)
+
+    def initial(self) -> State:
+        return (
+            tuple(0 for _ in self.SCRIPTS),
+            tuple(() for _ in self.SCRIPTS),
+            tuple(frozenset() for _ in range(self.N_ITERS)),
+            tuple(None for _ in self.SCRIPTS),
+            False,
+        )
+
+    def actions(self, state: State):
+        ptrs, channels, conv, latest, ended = state
+        if ended:
+            return []
+        out = []
+        for i, script in enumerate(self.SCRIPTS):
+            if ptrs[i] < len(script):
+                msg = script[ptrs[i]]
+                np = ptrs[:i] + (ptrs[i] + 1,) + ptrs[i + 1:]
+                nc = channels[:i] + (
+                    channels[i] + (msg,),
+                ) + channels[i + 1:]
+                out.append((
+                    f"status(agent={i},{msg[0]}@{msg[1]})",
+                    (np, nc, conv, latest, ended),
+                ))
+            if channels[i]:
+                kind, it = channels[i][0]
+                nc = channels[:i] + (
+                    channels[i][1:],
+                ) + channels[i + 1:]
+                nconv = conv
+                if kind == "C":
+                    nconv = conv[:it] + (
+                        conv[it] | {i},
+                    ) + conv[it + 1:]
+                nlatest = latest[:i] + ((kind, it),) + latest[i + 1:]
+                if self.mutation == "latest-status-round-end":
+                    # The re-seeded PR 8 bug: end as soon as the latest
+                    # status from every participant reads Converged —
+                    # regardless of whether they converged TOGETHER.
+                    nend = all(
+                        st is not None and st[0] == "C"
+                        for st in nlatest
+                    )
+                else:
+                    # The fixed rule: one iteration must have seen
+                    # every participant converge (master._conv_at).
+                    nend = any(
+                        len(s) == self.n_agents for s in nconv
+                    )
+                out.append((
+                    f"deliver(agent={i},{kind}@{it})",
+                    (ptrs, nc, nconv, nlatest, nend),
+                ))
+        return out
+
+    def safety(self, state: State) -> List[str]:
+        _, _, conv, latest, ended = state
+        if ended and not any(
+            len(s) == self.n_agents for s in conv
+        ):
+            seen = ", ".join(
+                f"agent {i}: {st[0]}@{st[1]}" if st else f"agent {i}: -"
+                for i, st in enumerate(latest)
+            )
+            return [
+                "round ended without a commonly-converged iteration "
+                f"(latest delivered statuses: {seen}) — a transiently-"
+                "zero residual terminated the round early"
+            ]
+        return []
+
+    def is_goal(self, state: State) -> bool:
+        return state[4]  # the round terminated
+
+
+# --------------------------------------------------------------------- #
+# AsyncSpec — push/staleness/quarantine (async_runtime)                 #
+# --------------------------------------------------------------------- #
+class AsyncSpec:
+    """Staleness quarantine with one byzantine replayer.
+
+    Agents H0, H1 are honest (monotone round pushes 1, 2 to each
+    other; the environment may duplicate at most one frame per honest
+    edge — the transport's at-least-once worst case).  Agent Z replays
+    stale rounds (1, 0, 0) to both.  A receiver counts staleness
+    violations per sender and accuses at ``QUARANTINE_AFTER``; the
+    master evicts at ``EVICT_QUORUM`` distinct accusers.
+
+    State layout::
+
+        (scripts, channels, dup, seen, viol, accused,
+         applied, double_applied, accusers, evicted)
+        scripts  = per-directed-edge send pointer
+        channels = per-directed-edge FIFO of round numbers
+        dup      = per-honest-edge remaining duplication budget
+        seen     = per-edge highest round accepted
+        viol     = per-edge staleness-violation count
+        accused  = per-edge accusation-sent flag
+        applied  = frozenset of (edge, round) payloads consumed
+        accusers = tuple per sender of frozenset of accusing receivers
+        evicted  = frozenset of evicted senders
+    """
+
+    HONEST = (0, 1)
+    BYZ = 2
+    QUARANTINE_AFTER = 2
+    EVICT_QUORUM = 2
+    #: directed push edges (sender, receiver)
+    EDGES = ((0, 1), (1, 0), (2, 0), (2, 1))
+    SCRIPTS = {(0, 1): (1, 2), (1, 0): (1, 2),
+               (2, 0): (1, 0, 0), (2, 1): (1, 0, 0)}
+    DUP_BUDGET = {(0, 1): 1, (1, 0): 1, (2, 0): 0, (2, 1): 0}
+
+    def __init__(self, mutation: Optional[str] = None):
+        self.name = "async[2h+1byz]" + (
+            f"[mut={mutation}]" if mutation else ""
+        )
+        self.mutation = mutation
+
+    def initial(self) -> State:
+        n = len(self.EDGES)
+        return (
+            (0,) * n,                                   # scripts
+            ((),) * n,                                  # channels
+            tuple(self.DUP_BUDGET[e] for e in self.EDGES),
+            (0,) * n,                                   # seen
+            (0,) * n,                                   # viol
+            (False,) * n,                               # accused
+            frozenset(),                                # applied
+            False,                                      # double_applied
+            tuple(frozenset() for _ in range(3)),       # accusers
+            frozenset(),                                # evicted
+        )
+
+    def actions(self, state: State):
+        (scripts, channels, dup, seen, viol, accused,
+         applied, double_applied, accusers, evicted) = state
+        out = []
+        for k, edge in enumerate(self.EDGES):
+            sender, receiver = edge
+            script = self.SCRIPTS[edge]
+            if scripts[k] < len(script) and sender not in evicted:
+                rnd = script[scripts[k]]
+                ns = scripts[:k] + (scripts[k] + 1,) + scripts[k + 1:]
+                nc = channels[:k] + (
+                    channels[k] + (rnd,),
+                ) + channels[k + 1:]
+                out.append((
+                    f"push({sender}->{receiver},round={rnd})",
+                    (ns, nc, dup, seen, viol, accused,
+                     applied, double_applied, accusers, evicted),
+                ))
+            if channels[k] and dup[k] > 0:
+                nc = channels[:k] + (
+                    (channels[k][0],) + channels[k],
+                ) + channels[k + 1:]
+                nd = dup[:k] + (dup[k] - 1,) + dup[k + 1:]
+                out.append((
+                    f"dup({sender}->{receiver},round={channels[k][0]})",
+                    (scripts, nc, nd, seen, viol, accused,
+                     applied, double_applied, accusers, evicted),
+                ))
+            if channels[k]:
+                rnd = channels[k][0]
+                nc = channels[:k] + (
+                    channels[k][1:],
+                ) + channels[k + 1:]
+                nseen, nviol, nacc = seen, viol, accused
+                napp, ndbl = applied, double_applied
+                naccusers, nevicted = accusers, evicted
+                if rnd > seen[k]:
+                    nseen = seen[:k] + (rnd,) + seen[k + 1:]
+                    if (k, rnd) in napp:
+                        ndbl = True
+                    napp = napp | {(k, rnd)}
+                else:
+                    nviol = viol[:k] + (viol[k] + 1,) + viol[k + 1:]
+                    if self.mutation == "choco-replay-apply":
+                        # Re-seeded double-consume: the stale frame's
+                        # hat correction is applied anyway.
+                        if (k, rnd) in napp:
+                            ndbl = True
+                        napp = napp | {(k, rnd)}
+                    if (
+                        nviol[k] >= self.QUARANTINE_AFTER
+                        and not accused[k]
+                    ):
+                        nacc = accused[:k] + (True,) + accused[k + 1:]
+                        acc = accusers[sender] | {receiver}
+                        naccusers = accusers[:sender] + (
+                            acc,
+                        ) + accusers[sender + 1:]
+                        if len(acc) >= self.EVICT_QUORUM:
+                            nevicted = evicted | {sender}
+                out.append((
+                    f"deliver({sender}->{receiver},round={rnd})",
+                    (scripts, nc, dup, nseen, nviol, nacc,
+                     napp, ndbl, naccusers, nevicted),
+                ))
+        return out
+
+    def safety(self, state: State) -> List[str]:
+        double_applied, evicted = state[7], state[9]
+        bad = []
+        if double_applied:
+            bad.append(
+                "a hat-correction payload was applied twice (stale "
+                "frame consumed instead of counted)"
+            )
+        honest_out = sorted(set(self.HONEST) & evicted)
+        if honest_out:
+            bad.append(
+                f"quarantine evicted honest agent(s) {honest_out} — "
+                "the honest quorum is no longer intact"
+            )
+        return bad
+
+    def is_goal(self, state: State) -> bool:
+        return self.BYZ in state[9]  # the replayer was evicted
+
+
+def clean_specs() -> List:
+    """The specs the checker must find clean (no mutation)."""
+    return [
+        LockstepSpec(n_agents=2, n_ops=2),
+        LockstepSpec(n_agents=3, n_ops=1),
+        RoundSpec(),
+        AsyncSpec(),
+    ]

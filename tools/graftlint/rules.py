@@ -723,6 +723,28 @@ class WireCodeUnique(Rule):
                     f"_REGISTRY lists '{name}' more than once",
                 )
             )
+        # Gap check (ISSUE 15): codes must stay contiguous min..max.  A
+        # hole means a message class was deleted without retiring its
+        # code explicitly — the freed code is silently reusable, and a
+        # stale peer still emitting it would misparse into whatever
+        # class claims the number next.  Retiring a code on purpose
+        # means renumbering (a wire-contract bump, repinned with
+        # --audit-write, which also pins the max code).
+        if coded:
+            lo, hi = min(coded), max(coded)
+            holes = sorted(set(range(lo, hi + 1)) - set(coded))
+            if holes:
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.relpath,
+                        reg_line,
+                        f"TYPE_CODE range {lo}..{hi} has gap(s) at "
+                        f"{holes}: a deleted code is silently reusable "
+                        "by the next class — renumber contiguously and "
+                        "repin the wire contract (--audit-write)",
+                    )
+                )
         return out
 
 

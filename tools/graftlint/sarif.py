@@ -1,0 +1,86 @@
+"""SARIF 2.1.0 emitter for graftlint findings (ISSUE 15 satellite).
+
+``python -m tools.graftlint --sarif <path>`` serializes every
+``Finding`` the invoked in-process stages produced — AST,
+wire-contract, and proto (the audit/dataflow/native stages report
+per-entry trace results on stderr, not source-anchored findings) —
+into one Static Analysis Results Interchange Format log, so CI
+annotators and editor SARIF viewers consume graftlint output without
+scraping stderr.  The shape is the minimal conformant
+subset: one run, the tool driver with the full rule table (name +
+short description from each rule's docstring), one ``result`` per
+finding with ``ruleId``, ``level``, message text, and a physical
+location (repo-relative URI + start line).
+
+Jax-free and side-effect-free: pure dict building plus one
+``json.dump``; golden-tested in ``tests/test_proto_model.py``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from tools.graftlint.core import RULES, Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _rule_table() -> List[Dict]:
+    rules = []
+    for name in sorted(RULES):
+        doc = (RULES[name].__doc__ or "").strip().splitlines()
+        rules.append({
+            "id": name,
+            "shortDescription": {"text": doc[0] if doc else name},
+            "properties": {"stage": RULES[name].stage},
+        })
+    return rules
+
+
+def to_sarif(findings: List[Finding]) -> Dict:
+    """One SARIF 2.1.0 log dict for the given findings.
+
+    Every graftlint finding gates the exit code, so every result is
+    ``level: error``; findings whose rule is not in the registry (none
+    today — kept total so the emitter never throws mid-lint) still
+    serialize, they just have no driver-rule entry to link to.
+    """
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftlint",
+                    "informationUri":
+                        "docs/static_analysis.md",
+                    "rules": _rule_table(),
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, findings: List[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(to_sarif(findings), fh, indent=2, sort_keys=True)
+        fh.write("\n")

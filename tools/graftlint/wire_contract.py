@@ -728,6 +728,14 @@ def extract(repo_root: str = REPO_ROOT) -> Tuple[dict, List[Finding]]:
     contract["type_codes"] = {
         name: code for name, (code, _line) in sorted(py["type_codes"].items())
     }
+    # Pinned alongside the codes (ISSUE 15): the high-water mark makes
+    # a deleted-then-reused top code a visible pin drift, pairing with
+    # wire-code-unique's contiguity (gap) check — retiring any code is
+    # a wire bump that goes through --audit-write.
+    if py["type_codes"]:
+        contract["max_type_code"] = max(
+            code for code, _line in py["type_codes"].values()
+        )
 
     # Obs-delta payload surface: authority obs/aggregate.py, declared
     # wire surface via the comm/protocol.py re-export.

@@ -1,16 +1,22 @@
 #!/usr/bin/env bash
-# Pre-commit gate: the jax-free graftlint stages (AST rules + the
-# Python<->C++ wire-contract check when a contract file changed) over
+# Pre-commit gate: the jax-free graftlint stages (AST rules, the
+# Python<->C++ wire-contract check when a contract file changed, and
+# the protocol role-model extraction + bounded model check) over
 # exactly the files modified vs. HEAD.  Deleted/renamed paths are
-# skipped with a notice; a clean tree exits 0 in well under a second.
+# skipped with a notice; a clean tree exits 0 in a few seconds.
 #
 # Install as a git hook:
 #   ln -s ../../tools/precommit.sh .git/hooks/pre-commit
 # or run directly: bash tools/precommit.sh
+# Extra flags pass through, e.g.:
+#   bash tools/precommit.sh --sarif lint.sarif
 #
-# The jaxpr audit (--audit) and the sanitizer replay (--native) are NOT
-# run here — they need jax / a toolchain and belong to tier-1 and CI,
-# not the commit hot path (docs/static_analysis.md §Stages).
+# --proto is always on: the protocol stage imports no jax, finishes in
+# about a second, and its model-checker self-test (the re-seeded PR 8
+# bugs) must never rot silently between commits.  The jaxpr audit
+# (--audit) and the sanitizer replay (--native) are NOT run here — they
+# need jax / a toolchain and belong to tier-1 and CI, not the commit
+# hot path (docs/static_analysis.md §Stages).
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
-exec python -m tools.graftlint --changed
+exec python -m tools.graftlint --changed --proto "$@"
