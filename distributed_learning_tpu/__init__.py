@@ -29,22 +29,61 @@ Subpackages
 ``utils``     logging, metrics, tree utilities.
 """
 
-from distributed_learning_tpu.parallel.topology import Topology, gamma, spectral_gap
-from distributed_learning_tpu.parallel.consensus import (
-    ConsensusEngine,
-    Mixer,
-    make_agent_mesh,
-)
-from distributed_learning_tpu.parallel.fast_averaging import (
-    find_optimal_weights,
-    solve_fastest_mixing,
-)
-from distributed_learning_tpu.parallel.pushsum import (
-    PushSumEngine,
-    push_sum_matrix,
-)
+import importlib
 
 __version__ = "0.1.0"
+
+# PEP 562 lazy re-exports.  The package root must stay importable
+# without jax: the graftlint sched stage (and every other bare-run-safe
+# surface) imports ``distributed_learning_tpu.comm.*`` on boxes with no
+# accelerator stack, and an eager ``parallel.*`` import here would drag
+# jax in transitively.  Attribute access resolves (and caches) the real
+# symbol on first use; eager `from distributed_learning_tpu import X`
+# call sites are unchanged.
+_LAZY = {
+    "Topology": ("distributed_learning_tpu.parallel.topology", "Topology"),
+    "gamma": ("distributed_learning_tpu.parallel.topology", "gamma"),
+    "spectral_gap": (
+        "distributed_learning_tpu.parallel.topology", "spectral_gap"
+    ),
+    "ConsensusEngine": (
+        "distributed_learning_tpu.parallel.consensus", "ConsensusEngine"
+    ),
+    "Mixer": ("distributed_learning_tpu.parallel.consensus", "Mixer"),
+    "make_agent_mesh": (
+        "distributed_learning_tpu.parallel.consensus", "make_agent_mesh"
+    ),
+    "find_optimal_weights": (
+        "distributed_learning_tpu.parallel.fast_averaging",
+        "find_optimal_weights",
+    ),
+    "solve_fastest_mixing": (
+        "distributed_learning_tpu.parallel.fast_averaging",
+        "solve_fastest_mixing",
+    ),
+    "PushSumEngine": (
+        "distributed_learning_tpu.parallel.pushsum", "PushSumEngine"
+    ),
+    "push_sum_matrix": (
+        "distributed_learning_tpu.parallel.pushsum", "push_sum_matrix"
+    ),
+}
+
+
+def __getattr__(name):
+    try:
+        module, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
 
 __all__ = [
     "ConsensusEngine",

@@ -17,36 +17,62 @@ ICI/DCN); this backend is the interoperability / heterogeneous-cluster
 path.
 """
 
-from distributed_learning_tpu.comm.agent import (
-    AgentStatus,
-    ConsensusAgent,
-    RoundAbortedError,
-    ShutdownError,
-)
-from distributed_learning_tpu.comm.async_runtime import (
-    AsyncGossipRunner,
-    AsyncRoundStats,
-    QUARANTINE_PAYLOAD_KIND,
-)
-from distributed_learning_tpu.comm.faults import (
-    FaultPlan,
-    FaultyStream,
-    inject_neighbor_faults,
-    lying_fields_mutator,
-    poison_value_mutator,
-)
-from distributed_learning_tpu.comm.framing import FramedStream, FrameError, open_framed_connection
-from distributed_learning_tpu.comm.master import ConsensusMaster
-from distributed_learning_tpu.comm.multiplexer import StreamMultiplexer
-from distributed_learning_tpu.comm.tensor_codec import (
-    decode_fused_sparse,
-    decode_sparse,
-    decode_tensor,
-    encode_fused_sparse,
-    encode_sparse,
-    encode_tensor,
-    top_k_sparse,
-)
+import importlib
+
+# PEP 562 lazy re-exports: ``master`` imports the jax-backed weight
+# solvers (``parallel.topology`` / ``parallel.fast_averaging``), so an
+# eager import here would make *every* comm submodule import pull jax.
+# The graftlint sched stage drives the real agent/runner coroutines on
+# a jax-free box (docs/static_analysis.md §Stage 7) and relies on
+# ``comm.agent`` / ``comm.async_runtime`` / ``comm.faults`` importing
+# bare; resolve the public names on first attribute access instead.
+_LAZY = {
+    "AgentStatus": ("agent", "AgentStatus"),
+    "ConsensusAgent": ("agent", "ConsensusAgent"),
+    "RoundAbortedError": ("agent", "RoundAbortedError"),
+    "ShutdownError": ("agent", "ShutdownError"),
+    "AsyncGossipRunner": ("async_runtime", "AsyncGossipRunner"),
+    "AsyncRoundStats": ("async_runtime", "AsyncRoundStats"),
+    "QUARANTINE_PAYLOAD_KIND": (
+        "async_runtime", "QUARANTINE_PAYLOAD_KIND"
+    ),
+    "FaultPlan": ("faults", "FaultPlan"),
+    "FaultyStream": ("faults", "FaultyStream"),
+    "inject_neighbor_faults": ("faults", "inject_neighbor_faults"),
+    "lying_fields_mutator": ("faults", "lying_fields_mutator"),
+    "poison_value_mutator": ("faults", "poison_value_mutator"),
+    "FramedStream": ("framing", "FramedStream"),
+    "FrameError": ("framing", "FrameError"),
+    "open_framed_connection": ("framing", "open_framed_connection"),
+    "ConsensusMaster": ("master", "ConsensusMaster"),
+    "StreamMultiplexer": ("multiplexer", "StreamMultiplexer"),
+    "decode_fused_sparse": ("tensor_codec", "decode_fused_sparse"),
+    "decode_sparse": ("tensor_codec", "decode_sparse"),
+    "decode_tensor": ("tensor_codec", "decode_tensor"),
+    "encode_fused_sparse": ("tensor_codec", "encode_fused_sparse"),
+    "encode_sparse": ("tensor_codec", "encode_sparse"),
+    "encode_tensor": ("tensor_codec", "encode_tensor"),
+    "top_k_sparse": ("tensor_codec", "top_k_sparse"),
+}
+
+
+def __getattr__(name):
+    try:
+        submodule, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    module = importlib.import_module(
+        f"distributed_learning_tpu.comm.{submodule}"
+    )
+    value = getattr(module, attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
 
 
 def top_k_compressor(fraction: float):
@@ -58,6 +84,8 @@ def top_k_compressor(fraction: float):
     here; only ``encode_sparse``'s flatnonzero re-scan (~1 extra pass)
     is redundant with the selection."""
     import numpy as np
+
+    from distributed_learning_tpu.comm.tensor_codec import top_k_sparse
 
     if not 0.0 < fraction <= 1.0:
         raise ValueError(f"fraction must be in (0, 1], got {fraction}")

@@ -57,6 +57,17 @@ __all__ = [
 #: class held in a variable.
 PROTO_ROLE = "agent"
 
+#: graftsched hot-coroutine annotation (tools/graftlint/schedsim.py):
+#: the await-point model of these coroutines pins under ``sched_model``
+#: — they are the agent-side suspension points the schedule explorer
+#: permutes (membership realignment and the detached telemetry path the
+#: async runner's quarantine reporting rides).
+SCHED_HOT = (
+    "_apply_neighborhood",
+    "send_telemetry",
+    "_recv_any",
+)
+
 # Collective-op tag space: op_id = round_id * _OPS_PER_ROUND + seq, where
 # round_id is the master's (global, strictly increasing) round counter and
 # seq counts collective ops since that round (the round itself is seq 0,

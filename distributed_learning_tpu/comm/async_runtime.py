@@ -77,6 +77,26 @@ __all__ = [
 #: role and cross-checks them against protocol.py's _REGISTRY.
 PROTO_ROLE = "async_runner"
 
+#: graftsched hot-coroutine annotation (tools/graftlint/schedsim.py):
+#: the schedule explorer extracts the ordered await points of these
+#: coroutines into the ``sched_model`` pin and permutes wakeup order at
+#: each of them.  Every coroutine here must keep its timing loop-derived
+#: (``asyncio.get_event_loop().time()``/``asyncio.sleep``) so the
+#: virtual clock can drive ``deadline_s`` paths in simulated time.
+SCHED_HOT = (
+    "_push",
+    "_answer_poke",
+    "_poke",
+    "_recv_step",
+    "_handle_master",
+    "_collect",
+    "begin_round",
+    "finish_round",
+    "run_async_round",
+    "_collect_choco",
+    "run_async_choco",
+)
+
 #: ``payload["kind"]`` marking a Telemetry payload as a quarantine report
 #: (runner -> master): ``{"kind": ..., "accused": token, "violations": n,
 #: "round": r, "generation": g}``.  The master accumulates accusers per
@@ -96,7 +116,9 @@ class AsyncRoundStats:
     #: tokens whose contribution was dropped this round (staleness > tau
     #: or deadline expiry); their edge weight stayed on self.
     dropped: List[str] = dataclasses.field(default_factory=list)
-    #: queued frames skipped by latest-wins consumption (tau > 0 only).
+    #: queued frames skipped: latest-wins consumption (plain rounds,
+    #: tau > 0) or replayed corrections deduplicated by the exactly-once
+    #: watermark (CHOCO rounds).
     skipped: int = 0
     #: corrections applied this round (CHOCO rounds), token -> count.
     applied: Dict[str, int] = dataclasses.field(default_factory=dict)
@@ -109,7 +131,7 @@ class _Inbox:
     __slots__ = (
         "queue", "last", "times_mixed", "dropped", "choco_lag",
         "violations", "seen_gen", "seen_round", "seen_stale",
-        "last_trace",
+        "last_trace", "choco_applied_gen", "choco_applied_round",
     )
 
     def __init__(self):
@@ -130,6 +152,17 @@ class _Inbox:
         self.seen_gen: Optional[int] = None
         self.seen_round = -1
         self.seen_stale = -1
+        # Exactly-once CHOCO accounting: the newest sender round whose
+        # correction was APPLIED (within choco_applied_gen).  A replayed
+        # frame — a dup, or a poke-triggered re-push of a round that
+        # already landed through the normal path — carries a round id at
+        # or below this watermark and must be counted, never re-applied:
+        # corrections are deltas on the replicated estimate, so a second
+        # apply corrupts x̂ for every subsequent round (the
+        # ``choco-replay-apply`` spec mutation in
+        # tools/graftlint/proto_spec.py models exactly this bug).
+        self.choco_applied_gen: Optional[int] = None
+        self.choco_applied_round = -1
 
 
 class AsyncGossipRunner:
@@ -720,7 +753,22 @@ class AsyncGossipRunner:
                 else:
                     batch = list(box.queue)
                     box.queue.clear()
-                for qn, _, _, qtrace in batch:
+                if box.choco_applied_gen != a._generation:
+                    # New membership generation: the peer's correction
+                    # counter legitimately restarts with its round ids.
+                    box.choco_applied_gen = a._generation
+                    box.choco_applied_round = -1
+                for qn, q_round, _, qtrace in batch:
+                    if q_round <= box.choco_applied_round:
+                        # Replayed correction (a dup, or a poke-answer
+                        # re-push of an already-applied round): count
+                        # it, never apply — a correction is a delta on
+                        # the replicated estimate and must land exactly
+                        # once (the choco-replay-apply contract).
+                        a._count("async_choco_replay_skipped")
+                        stats.skipped += 1
+                        continue
+                    box.choco_applied_round = q_round
                     a._choco_hat_nbrs[token] = a._choco_hat_nbrs[
                         token
                     ] + np.asarray(qn, np.float32).ravel()
@@ -730,16 +778,18 @@ class AsyncGossipRunner:
                         a._emit_flow(
                             "mix", qtrace, f"{token}->{a.token}"
                         )
+            if applied:
                 box.choco_lag = 0
                 box.dropped = False
-            else:
-                box.choco_lag += 1
-                a._count("async_stale_dropped")
-                stats.dropped.append(token)
-            if applied:
                 stats.applied[token] = applied
                 if applied > 1:
                     a._count("async_choco_catchup", applied - 1)
+            else:
+                # No NEW correction this round (empty queue, or a batch
+                # of pure replays): mix against the standing estimates.
+                box.choco_lag += 1
+                a._count("async_stale_dropped")
+                stats.dropped.append(token)
             a._observe(
                 "comm.agent.staleness", float(box.choco_lag),
                 step=self._round,

@@ -32,6 +32,14 @@ index)`` (a per-index :func:`numpy.random.default_rng` stream), so the
 same plan replays the identical fault schedule — the property the
 breakdown and determinism tests in ``tests/test_faults.py`` pin.
 
+Schedule composition: because delays are applied through
+``asyncio.sleep`` and carry no wall-clock state, a :class:`FaultyStream`
+runs unmodified under the graftsched virtual-clock explorer
+(``tools/graftlint/schedsim.py``) — (fault seed, schedule seed) then
+jointly replays a wire-fault storm under a chosen task interleaving in
+simulated time, which is how the sched corpus composes the two
+harnesses (``docs/static_analysis.md`` §Stage 7).
+
 The reference's transport (``utils/consensus_tcp/pickled_socket.py``)
 has no failure injection at all — its failure story is whatever pickle
 does with a torn byte stream; this harness is the framework's addition
@@ -64,6 +72,12 @@ __all__ = [
     "lying_fields_mutator",
     "poison_value_mutator",
 ]
+
+#: graftsched hot-coroutine annotation (tools/graftlint/schedsim.py):
+#: ``FaultyStream.send`` is where injected delays suspend — its
+#: await-point model pins under ``sched_model`` so the joint
+#: (FaultPlan x schedule) exploration surface cannot drift silently.
+SCHED_HOT = ("FaultyStream.send",)
 
 #: Exclusive per-frame fault kinds, in decision priority order.
 _KINDS = (

@@ -19,9 +19,20 @@ import errno
 import struct
 from typing import Callable, Optional, Tuple
 
+import numpy as np
+
 from distributed_learning_tpu import native
 from distributed_learning_tpu.comm.protocol import Message, pack_message, unpack_message
 from distributed_learning_tpu.obs import get_registry
+
+#: graftsched hot-coroutine annotation (tools/graftlint/schedsim.py):
+#: ``send`` holds the backoff sleep the virtual clock fires in simulated
+#: time; ``recv`` holds the frame-boundary wait_for.  Their await-point
+#: model pins under ``sched_model``.
+SCHED_HOT = (
+    "FramedStream.send",
+    "FramedStream.recv",
+)
 
 __all__ = [
     "FramedStream",
@@ -90,6 +101,8 @@ class FramedStream:
         *,
         send_retries: int = 0,
         retry_base_s: float = 0.02,
+        retry_jitter_frac: float = 0.0,
+        retry_seed: int = 0,
         on_retry: Optional[Callable[[], None]] = None,
     ):
         self.reader = reader
@@ -100,11 +113,20 @@ class FramedStream:
         self.frames_sent = 0
         self.frames_received = 0
         # Bounded exponential-backoff retry of transient socket errors on
-        # send (TRANSIENT_ERRNOS): attempt k sleeps retry_base_s * 2**k.
-        # 0 = fail on first error (the pre-async behavior).  on_retry is
-        # the owner's counter hook (ConsensusAgent wires comm.agent.retries).
+        # send (TRANSIENT_ERRNOS): attempt k sleeps retry_base_s * 2**k,
+        # stretched by up to retry_jitter_frac (decorrelates retry storms
+        # across streams sharing a congested kernel).  The jitter is a
+        # pure function of (retry_seed, attempt) — the FaultPlan
+        # counter-keyed rng idiom — so a retry schedule replays
+        # bit-identically under the graftsched explorer and the fault
+        # harness; 0.0 keeps the exact legacy powers-of-two schedule.
+        # 0 retries = fail on first error (the pre-async behavior).
+        # on_retry is the owner's counter hook (ConsensusAgent wires
+        # comm.agent.retries).
         self.send_retries = int(send_retries)
         self.retry_base_s = float(retry_base_s)
+        self.retry_jitter_frac = float(retry_jitter_frac)
+        self.retry_seed = int(retry_seed)
         self.on_retry = on_retry
         # Directed-edge attribution (set post-construction by the owner
         # once the peer's token is known, e.g. after the Register
@@ -125,6 +147,19 @@ class FramedStream:
     @property
     def peername(self):
         return self.writer.get_extra_info("peername")
+
+    def _retry_delay_s(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (0-based).  Deterministic:
+        the jitter draw is keyed on (retry_seed, attempt) exactly like
+        ``FaultPlan.decide`` keys on (seed, frame index), never on
+        shared-rng call order."""
+        delay = self.retry_base_s * (2 ** attempt)
+        if self.retry_jitter_frac:
+            u = np.random.default_rng(
+                [self.retry_seed, attempt]
+            ).random()
+            delay *= 1.0 + self.retry_jitter_frac * u
+        return delay
 
     async def send(self, msg: Message) -> None:
         code, body = pack_message(msg)
@@ -151,7 +186,7 @@ class FramedStream:
                     self._edge_inc("comm.edge.retries", forward=True)
                     if self.on_retry is not None:
                         self.on_retry()
-                    await asyncio.sleep(self.retry_base_s * (2 ** attempt))
+                    await asyncio.sleep(self._retry_delay_s(attempt))
                     attempt += 1
         self.bytes_sent += nbytes
         self.frames_sent += 1
@@ -217,7 +252,8 @@ class FramedStream:
 
 async def open_framed_connection(
     host: str, port: int, *, retries: int = 20, delay: float = 0.1,
-    send_retries: int = 0, on_retry: Optional[Callable[[], None]] = None,
+    send_retries: int = 0, retry_jitter_frac: float = 0.0,
+    retry_seed: int = 0, on_retry: Optional[Callable[[], None]] = None,
 ) -> FramedStream:
     """Connect with retry (peers race to start their servers)."""
     last: Optional[Exception] = None
@@ -226,7 +262,9 @@ async def open_framed_connection(
             reader, writer = await asyncio.open_connection(host, port)
             return FramedStream(
                 reader, writer,
-                send_retries=send_retries, on_retry=on_retry,
+                send_retries=send_retries,
+                retry_jitter_frac=retry_jitter_frac,
+                retry_seed=retry_seed, on_retry=on_retry,
             )
         except OSError as e:
             last = e

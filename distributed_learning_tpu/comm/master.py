@@ -55,6 +55,17 @@ __all__ = ["ConsensusMaster"]
 #: role and cross-checks them against protocol.py's _REGISTRY.
 PROTO_ROLE = "master"
 
+#: graftsched hot-coroutine annotation (tools/graftlint/schedsim.py):
+#: the round-lifecycle coroutines whose await-point model pins under
+#: ``sched_model`` — the master-side suspension points the schedule
+#: explorer permutes when replaying the PR 15 round-end counterexample
+#: against the real ``_on_status`` accounting.
+SCHED_HOT = (
+    "_on_status",
+    "_broadcast_round",
+    "_maybe_start_round",
+)
+
 
 class ConsensusMaster:
     """Serve registration, weight distribution, and round lifecycle."""

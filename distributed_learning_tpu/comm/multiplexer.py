@@ -26,6 +26,12 @@ __all__ = ["StreamMultiplexer"]
 #: lands in the pinned protocol model instead of drifting silently.
 PROTO_ROLE = "transport"
 
+#: graftsched hot-coroutine annotation (tools/graftlint/schedsim.py):
+#: ``__anext__`` is the single dispatch service point every peer frame
+#: funnels through — its await-point model (arm pending reads, wait
+#: FIRST_COMPLETED with the wake event) pins under ``sched_model``.
+SCHED_HOT = ("__anext__",)
+
 
 class StreamMultiplexer:
     """``async for token, msg, stream in mux:`` over a dynamic socket set."""

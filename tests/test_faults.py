@@ -362,6 +362,86 @@ def test_agent_stream_retries_under_injected_transient_errnos():
     asyncio.run(asyncio.wait_for(main(), 60))
 
 
+def test_retry_backoff_jitter_is_seed_deterministic():
+    """The send-retry backoff jitter is a pure function of
+    ``(retry_seed, attempt)`` — the FaultPlan counter-keyed rng idiom:
+    same seed replays the identical backoff schedule (in any call
+    order), different seeds decorrelate, and ``retry_jitter_frac=0``
+    keeps the exact legacy powers-of-two schedule."""
+
+    async def main():
+        def stream(**kw):
+            return FramedStream(
+                asyncio.StreamReader(), writer=None, send_retries=3,
+                retry_base_s=0.02, **kw,
+            )
+
+        legacy = stream()
+        assert [legacy._retry_delay_s(k) for k in range(4)] == [
+            0.02, 0.04, 0.08, 0.16
+        ]
+
+        a = stream(retry_jitter_frac=0.5, retry_seed=11)
+        b = stream(retry_jitter_frac=0.5, retry_seed=11)
+        c = stream(retry_jitter_frac=0.5, retry_seed=12)
+        sched_a = [a._retry_delay_s(k) for k in range(4)]
+        # Evaluation order must not matter (counter-keyed, no shared rng).
+        sched_b = [b._retry_delay_s(k) for k in reversed(range(4))][::-1]
+        assert sched_a == sched_b
+        assert sched_a != [c._retry_delay_s(k) for k in range(4)]
+        for k, delay in enumerate(sched_a):
+            base = 0.02 * (2 ** k)
+            assert base <= delay <= base * 1.5
+
+    asyncio.run(main())
+
+
+def test_retry_backoff_jitter_replays_through_the_send_loop():
+    """End to end: two streams with the same ``retry_seed`` sleep the
+    identical jittered backoff schedule through the REAL send-retry
+    loop (transient errnos injected at drain); a third seed diverges."""
+
+    async def run(seed):
+        reader = asyncio.StreamReader()
+        failures = [2]
+
+        class _W:
+            def write(self, data):
+                pass
+
+            async def drain(self):
+                if failures[0] > 0:
+                    failures[0] -= 1
+                    raise OSError(errno.EAGAIN, "injected")
+
+            def get_extra_info(self, name, default=None):
+                return default
+
+        s = FramedStream(
+            reader, _W(), send_retries=3, retry_base_s=0.001,
+            retry_jitter_frac=1.0, retry_seed=seed,
+        )
+        slept = []
+        real_sleep = asyncio.sleep
+
+        async def spy_sleep(delay, *a, **k):
+            slept.append(delay)
+            await real_sleep(0)
+
+        asyncio.sleep, _saved = spy_sleep, asyncio.sleep
+        try:
+            await s.send(P.Ok(info="x"))
+        finally:
+            asyncio.sleep = _saved
+        return slept
+
+    first = asyncio.run(run(21))
+    second = asyncio.run(run(21))
+    third = asyncio.run(run(22))
+    assert first and first == second
+    assert first != third
+
+
 def test_reconnects_counter_after_neighbor_death_and_rejoin():
     """A fault-injected crash kills B; a replacement rejoins and dials
     back in — the survivor's ``comm.agent.reconnects`` counter records

@@ -623,9 +623,13 @@ GOLDEN_RULES = [
     "protocol-model-pin",
     "raw-collective-in-shard-map",
     "reference-citation",
+    "sched-model-pin",
+    "schedule-deadlock",
+    "schedule-nondeterminism",
     "stdout-contract",
     "suppression-claim",
     "task-shared-mutation",
+    "turn-discipline-claim",
     "unawaited-coroutine",
     "unhandled-message",
     "vma-discipline",
@@ -656,12 +660,15 @@ def test_cli_list_rules_json_golden():
         r["name"] for r in payload["rules"] if r["requires_reason"]
     ] == GOLDEN_REQUIRES_REASON
     assert payload["stages"] == [
-        "ast", "wire-contract", "audit", "dataflow", "proto", "native-san"
+        "ast", "wire-contract", "audit", "dataflow", "proto", "sched",
+        "native-san"
     ]
     assert "disable=<rule>" in payload["suppression"]
     for r in payload["rules"]:
         assert r["summary"], f"rule {r['name']} has no docstring summary"
-        assert r["stage"] in ("ast", "wire-contract", "dataflow", "proto")
+        assert r["stage"] in (
+            "ast", "wire-contract", "dataflow", "proto", "sched"
+        )
     # The human docs must mention every registered rule.
     doc = open(os.path.join(REPO_ROOT, "docs", "static_analysis.md")).read()
     missing = [r for r in GOLDEN_RULES if f"`{r}`" not in doc]

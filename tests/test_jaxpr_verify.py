@@ -658,8 +658,19 @@ def test_cli_suppressions_json_golden():
     for p in raw:
         assert p["claim"] is not None, p
         assert p["claim"]["kind"] in ("exit", "vma-cast", "statistic")
+        assert p["verification"] is None  # only concurrency rules
     assert any(p["path"] == "distributed_learning_tpu/training/pp.py"
                for p in raw)
+    # The concurrency-rule verification column (sched stage): every
+    # task-shared-mutation suppression in the comm files maps to a
+    # runtime-checked sched claim whose pinned status is "verified".
+    sched = [p for p in payload if "task-shared-mutation" in p["rules"]]
+    assert sched, "no task-shared-mutation suppressions in the tree?"
+    for p in sched:
+        ver = p["verification"]
+        assert ver is not None, p
+        assert ver["kind"] in ("turn", "service-point"), p
+        assert ver["status"] == "verified", p
 
 
 def test_cli_suppressions_text_mode():

@@ -251,7 +251,7 @@ def test_sarif_shape_golden():
     for r in driver["rules"]:
         assert r["shortDescription"]["text"]
         assert r["properties"]["stage"] in (
-            "ast", "wire-contract", "dataflow", "proto"
+            "ast", "wire-contract", "dataflow", "proto", "sched"
         )
     assert run["results"] == [{
         "ruleId": "no-pickle",

@@ -1,7 +1,7 @@
 """CLI: ``python -m tools.graftlint [paths...]``.
 
 Exits non-zero when any unsuppressed finding (or audit/contract/
-sanitizer mismatch) survives.  Six stages:
+sanitizer mismatch) survives.  Seven stages:
 
 * **AST rules** (always): import no jax — safe to run bare.
 * **Wire contract** (always on full/--changed runs touching the
@@ -18,6 +18,14 @@ sanitizer mismatch) survives.  Six stages:
   registry, plus the bounded model check of the protocol specs
   (safety + liveness, with the PR 8 bugs re-seeded as mutations the
   checker must keep finding).  Jax-free.
+* **Schedule exploration** (``--sched``; always on full runs and on
+  ``--changed`` runs touching a sched file; under ``--audit-write``
+  the ``sched_model`` pin is also rewritten): the comm control plane
+  runs on a controlled event loop (virtual clock + seeded schedule
+  policy) that verifies every task-shared-mutation suppression's
+  serialization claim, detects deadlocks/lost wakeups, checks
+  same-seed trace determinism, and self-tests its power on seeded
+  race mutations (``schedsim.py`` + ``sched_corpus.py``).  Jax-free.
 * **Sanitizer replay** (``--native``): rebuilds both native libs under
   ASan/UBSan into a separate cache and replays the wire fuzz corpus +
   oracle matrix; skips with a notice when the toolchain is absent.
@@ -52,7 +60,12 @@ from tools.graftlint import (
     RULES,
     lint_paths,
 )
-from tools.graftlint import proto_extract, proto_model, wire_contract
+from tools.graftlint import (
+    proto_extract,
+    proto_model,
+    schedsim,
+    wire_contract,
+)
 
 
 def _changed_files(repo_root: str = REPO_ROOT) -> Tuple[list, list, list]:
@@ -109,7 +122,7 @@ def _list_rules(as_json: bool) -> int:
                 "rules": rules,
                 "stages": [
                     "ast", "wire-contract", "audit", "dataflow",
-                    "proto", "native-san",
+                    "proto", "sched", "native-san",
                 ],
                 "suppression":
                     "# graftlint: disable=<rule>[,<rule>] -- <reason>",
@@ -132,11 +145,44 @@ def _pin_jax_env() -> None:
         ).strip()
 
 
+#: Concurrency rules whose suppressions get a verification-status
+#: column in --suppressions.  task-shared-mutation claims in the sched
+#: files are checked at runtime by the schedule explorer (status from
+#: the sched_model pin); the other two are enforced purely statically.
+_STATIC_CONCURRENCY_RULES = frozenset(
+    {"blocking-in-async", "unawaited-coroutine"}
+)
+
+
+def _sup_verification(record, sched_by_site):
+    """{"kind", "status"} for a concurrency-rule suppression (None for
+    every other rule).  Statuses: verified/contradicted/unexercised
+    from the sched_model pin, "unpinned" before the first
+    --audit-write, "unanchored" when the explorer cannot map the claim
+    to a mutation, "static" for the purely-static rules."""
+    if schedsim.TASK_MUTATION_RULE in record.rules:
+        info = sched_by_site.get((record.path, record.line))
+        if info is not None:
+            return dict(info)
+        return {"kind": None, "status": "unanchored"}
+    if _STATIC_CONCURRENCY_RULES & set(record.rules):
+        return {"kind": None, "status": "static"}
+    return None
+
+
 def _run_suppressions(as_json: bool) -> int:
     """The --suppressions inventory report (jax-free)."""
     from tools.graftlint import claims as claims_mod
 
     records = claims_mod.inventory()
+    sites, _sched_findings = schedsim.collect_claims()
+    pinned = schedsim.claim_statuses()
+    sched_by_site = {}
+    for key, site in sites.items():
+        status = pinned.get(key, {}).get("status", "unpinned")
+        sched_by_site[(site.path, site.line)] = {
+            "kind": site.kind, "status": status,
+        }
     if as_json:
         payload = []
         for r in records:
@@ -151,6 +197,7 @@ def _run_suppressions(as_json: bool) -> int:
                     "rules": list(r.rules),
                     "reason": r.reason,
                     "claim": claim,
+                    "verification": _sup_verification(r, sched_by_site),
                 }
             )
         print(json.dumps({"suppressions": payload}, indent=2,
@@ -164,6 +211,10 @@ def _run_suppressions(as_json: bool) -> int:
             if r.claim.axis:
                 line += f" over {r.claim.axis}"
             line += "]"
+        ver = _sup_verification(r, sched_by_site)
+        if ver is not None:
+            kind = f"{ver['kind']} " if ver["kind"] else ""
+            line += f" [verify: {kind}{ver['status']}]"
         if r.reason:
             line += f" -- {r.reason}"
         print(line)
@@ -322,6 +373,13 @@ def main(argv=None) -> int:
                     "extraction cross-check + pin + bounded model "
                     "check) even when the selection would skip it; "
                     "imports no jax")
+    ap.add_argument("--sched", action="store_true",
+                    help="force the schedule-exploration stage "
+                    "(controlled-loop corpus run: turn-discipline "
+                    "claim verification, deadlock/lost-wakeup "
+                    "detection, determinism replay, seeded-mutation "
+                    "power self-test) even when the selection would "
+                    "skip it; imports no jax")
     ap.add_argument("--sarif", default=None, metavar="PATH",
                     help="also write every finding the invoked stages "
                     "produced as a SARIF 2.1.0 log at PATH")
@@ -367,7 +425,8 @@ def main(argv=None) -> int:
 
     aux_stage = (
         args.audit or args.audit_write or args.report_unverified
-        or args.native or args.proto or args.sarif is not None
+        or args.native or args.proto or args.sched
+        or args.sarif is not None
     )
     paths = args.paths
     changed_rels: List[str] = []
@@ -451,6 +510,34 @@ def main(argv=None) -> int:
         findings.extend(proto_extract.check())
         findings.extend(proto_model.check())
 
+    # Sched stage: full runs always; --sched forces it; --changed runs
+    # when a sched file (or the stage's own source/corpus) changed;
+    # explicit-path runs when one was named; skipped when a --rules
+    # subset excludes all four of its rule names.  Jax-free like the
+    # proto stage: the comm package roots import lazily, so the
+    # controlled-loop corpus run never pulls the device stack.
+    sched_rules = {
+        schedsim.TURN_RULE, schedsim.DEADLOCK_RULE,
+        schedsim.NONDET_RULE, schedsim.PIN_RULE,
+    }
+    sched_sources = set(schedsim.SCHED_FILES) | {
+        schedsim.CORPUS_REL, "tools/graftlint/schedsim.py",
+    }
+    run_sched = rules is None or bool(sched_rules & set(rules))
+    if run_sched and not args.sched:
+        if args.changed:
+            run_sched = any(rel in sched_sources for rel in changed_rels)
+        elif args.paths:
+            named = {
+                os.path.relpath(os.path.abspath(p), REPO_ROOT).replace(
+                    os.sep, "/"
+                )
+                for p in args.paths
+            }
+            run_sched = bool(named & sched_sources)
+    if run_sched:
+        findings.extend(schedsim.check())
+
     for f in findings:
         print(str(f))
     rc = 1 if findings else 0
@@ -471,10 +558,16 @@ def main(argv=None) -> int:
             if not proto_pin_findings:
                 print("audit protocol_model: pin written",
                       file=sys.stderr)
+            sched_pin_findings = schedsim.write_pin()
+            for f in sched_pin_findings:
+                print(str(f))
+                rc = 1
+            if not sched_pin_findings:
+                print("audit sched_model: pin written", file=sys.stderr)
         elif args.audit_write:
             print(
-                "audit wire_contract / protocol_model: pins left "
-                "untouched (--entry filter)",
+                "audit wire_contract / protocol_model / sched_model: "
+                "pins left untouched (--entry filter)",
                 file=sys.stderr,
             )
         rc = max(rc, _run_audit(write=args.audit_write,

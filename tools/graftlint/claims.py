@@ -158,6 +158,47 @@ def inventory(
     return sorted(out, key=lambda r: (r.path, r.line))
 
 
+#: Sched-claim taxonomy (docs/static_analysis.md §Stage 7): a
+#: ``task-shared-mutation`` suppression reason in the sched files maps
+#: onto one of two serialization disciplines the schedule explorer
+#: (tools/graftlint/schedsim.py) can check at runtime:
+#:
+#: - ``service-point`` — the mutation only ever executes at the single
+#:   dispatch service point, i.e. on the round task AND inside its own
+#:   ``_recv_step`` await.  Keyed on "service point" / "FIFO
+#:   discipline" (matched first: a service-point reason usually also
+#:   says "turn").
+#: - ``turn`` — the mutation only ever executes on the round task (its
+#:   turn discipline serializes it against the round body's own
+#:   mutations).  Keyed on "turn discipline" / "turn".
+_SCHED_SERVICE_RE = re.compile(
+    r"\bservice[ -]points?\b|\bFIFO discipline\b", re.IGNORECASE
+)
+_SCHED_TURN_RE = re.compile(
+    r"\bturn discipline\b|\bturns?\b", re.IGNORECASE
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedClaim:
+    """A parsed task-shared-mutation suppression reason."""
+
+    kind: str  # "turn" | "service-point"
+
+
+def parse_sched_claim(reason: Optional[str]) -> Optional[SchedClaim]:
+    """Map a task-shared-mutation reason onto the sched-claim taxonomy
+    (None when it names no recognizable serialization discipline —
+    reported by the sched stage, never passed)."""
+    if not reason:
+        return None
+    if _SCHED_SERVICE_RE.search(reason):
+        return SchedClaim(kind="service-point")
+    if _SCHED_TURN_RE.search(reason):
+        return SchedClaim(kind="turn")
+    return None
+
+
 def raw_collective_records(
     repo_root: str = REPO_ROOT,
 ) -> List[SuppressionRecord]:

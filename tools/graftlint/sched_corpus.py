@@ -1,0 +1,645 @@
+"""graftsched corpus: the scenarios and seeded race mutations the
+schedule explorer (``tools/graftlint/schedsim.py``) runs every lint.
+
+Each scenario builds a REAL control plane — a :class:`~distributed_
+learning_tpu.comm.agent.ConsensusAgent` with real :class:`~distributed_
+learning_tpu.comm.framing.FramedStream` framing over in-memory
+stream pairs, driven by a real :class:`~distributed_learning_tpu.comm.
+async_runtime.AsyncGossipRunner` — and exercises one concurrency
+contract of the shipped comm modules end to end under the controlled
+loop: production coroutines, production wire bytes, virtual time.  A
+scenario returns its GOAL FAILURES (empty list = the end state honors
+the contract); deadlocks and claim contradictions are detected by the
+explorer itself.
+
+The MUTATIONS table is the stage's power self-test (the proto stage's
+re-seeded-bug discipline, PR 15): each entry re-introduces a
+representative race — a shared-state turn detached from its claimed
+task, a check-then-act window, a lost wakeup, a wall-clock leak, a
+broken exactly-once watermark — and lint FAILS if the explorer stops
+catching it.
+
+Everything here is jax-free; the comm package root imports lazily so
+pulling the agent/runner never pulls the device stack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_learning_tpu.comm import async_runtime as AR
+from distributed_learning_tpu.comm import protocol as P
+from distributed_learning_tpu.comm.agent import (
+    AgentStatus,
+    ConsensusAgent,
+    ShutdownError,
+)
+from distributed_learning_tpu.comm.faults import (
+    FaultPlan,
+    inject_neighbor_faults,
+)
+from distributed_learning_tpu.comm.framing import FramedStream
+from tools.graftlint.schedsim import (
+    DEADLOCK_RULE,
+    NONDET_RULE,
+    TURN_RULE,
+)
+
+
+# --------------------------------------------------------------------- #
+# In-memory transport: real FramedStreams over cross-fed StreamReaders  #
+# --------------------------------------------------------------------- #
+class _SimWriter:
+    """StreamWriter stand-in: writes feed the PEER's StreamReader
+    directly, so the production framing/codec path runs end to end with
+    no sockets and no real I/O."""
+
+    def __init__(self, peer_reader: asyncio.StreamReader):
+        self._peer = peer_reader
+        self._closed = False
+
+    def write(self, data) -> None:
+        if self._closed:
+            raise BrokenPipeError("sim stream closed")
+        self._peer.feed_data(bytes(data))
+
+    async def drain(self) -> None:
+        await asyncio.sleep(0)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._peer.feed_eof()
+
+    def is_closing(self) -> bool:
+        return self._closed
+
+    async def wait_closed(self) -> None:
+        return None
+
+    def get_extra_info(self, name, default=None):
+        return ("sim", 0) if name == "peername" else default
+
+
+def sim_pair() -> Tuple[FramedStream, FramedStream]:
+    """Two cross-connected FramedStreams (a's sends arrive at b and
+    vice versa).  Construct inside a running SimLoop so the readers
+    bind to it."""
+    reader_a = asyncio.StreamReader()
+    reader_b = asyncio.StreamReader()
+    a = FramedStream(reader_a, _SimWriter(reader_b))
+    b = FramedStream(reader_b, _SimWriter(reader_a))
+    return a, b
+
+
+class SimWorld:
+    """One agent ("A") wired READY: real framed streams to each scripted
+    peer and to a scripted master, plus an AsyncGossipRunner.  The
+    handshake is pre-faked (status/generation/weights/streams installed
+    directly) — the scenarios exercise the round/dispatch machinery,
+    not the TCP bring-up."""
+
+    def __init__(self, peer_tokens, **runner_kwargs):
+        self.agent = ConsensusAgent("A", "sim", 0)
+        self.agent.status = AgentStatus.READY
+        self.agent._generation = 1
+        self.agent._nbhd_ready.set()
+        weight = 0.5 / max(1, len(peer_tokens))
+        self.agent._weights = {t: weight for t in peer_tokens}
+        self.agent.self_weight = 1.0 - weight * len(peer_tokens)
+        #: token -> the PEER's end of the edge (scripts send/recv here).
+        self.peers: Dict[str, FramedStream] = {}
+        for token in peer_tokens:
+            ours, theirs = sim_pair()
+            self.agent._add_neighbor(token, ours)
+            self.peers[token] = theirs
+        ours, theirs = sim_pair()
+        self.agent._master = ours
+        #: The MASTER's end of the control stream.
+        self.master = theirs
+        self.runner = AR.AsyncGossipRunner(self.agent, **runner_kwargs)
+
+
+def _frame(value, round_id: int, *, gen: int = 1, staleness: int = 0):
+    return P.AsyncValue(
+        round_id=round_id, generation=gen, staleness=staleness,
+        value=np.asarray(value, np.float32), kind=P._ASYNC_DENSE,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """name + async driver; ``fn(monitor, mutate)`` returns goal
+    failures.  ``seeds`` are the seeded schedules every lint explores."""
+
+    name: str
+    fn: Callable
+    seeds: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedMutation:
+    """One re-seeded race: ``apply(world)`` patches the freshly-built
+    world; the explorer must produce an ``expected_rule`` finding whose
+    message contains ``expected_token`` within the seed budget (plus a
+    bounded-exhaustive fallback of ``exhaustive_depth`` flips)."""
+
+    scenario: str
+    expected_rule: str
+    expected_token: str
+    description: str
+    apply: Callable
+    seeds: Tuple[int, ...] = (0,)
+    exhaustive_depth: int = 0
+
+
+# --------------------------------------------------------------------- #
+# Scenarios                                                             #
+# --------------------------------------------------------------------- #
+async def _scn_membership_purge(monitor, mutate=None) -> List[str]:
+    """A generation-2 NeighborhoodData removes C mid-run: the round
+    task's _handle_master turn must purge C's inbox (the _inbox turn
+    claim) and round 2 must complete against B alone."""
+    world = SimWorld(("B", "C"), staleness_bound=0)
+    runner, agent = world.runner, world.agent
+    monitor.adopt_round_task()
+    monitor.install(runner)
+    if mutate is not None:
+        mutate(world)
+    fails: List[str] = []
+    for token in ("B", "C"):
+        await world.peers[token].send(_frame([1.0], 1))
+    await runner.run_async_round(np.zeros(1, np.float32))
+    if sorted(runner.last_stats.mixed) != ["B", "C"]:
+        fails.append(
+            "round 1 mixed {} — expected B and C".format(
+                sorted(runner.last_stats.mixed)
+            )
+        )
+    await world.master.send(P.NeighborhoodData(
+        self_weight=0.75, convergence_eps=1e-4,
+        neighbors=[P.Neighbor(token="B", host="sim", port=0, weight=0.25)],
+        generation=2,
+    ))
+    stop = asyncio.Event()
+
+    async def b_repush():
+        # A gen-2 frame may race the NeighborhoodData broadcast and be
+        # gen-dropped; keep re-pushing (monotone round ids) until the
+        # round lands.
+        for rnd in range(2, 40):
+            if stop.is_set():
+                return
+            await world.peers["B"].send(_frame([2.0], rnd, gen=2))
+            await asyncio.sleep(0.01)
+        fails.append("B's re-pusher exhausted its budget")
+
+    pusher = asyncio.ensure_future(b_repush())
+    await runner.run_async_round(np.zeros(1, np.float32))
+    stop.set()
+    await pusher
+    if "B" not in runner.last_stats.mixed:
+        fails.append("round 2 did not mix B after the generation change")
+    if "C" in runner._inbox:
+        fails.append(
+            "C's inbox survived the membership purge — the removed "
+            "edge's receive state must die with its generation"
+        )
+    if "C" in agent._weights:
+        fails.append("C still weighted after generation 2")
+    return fails
+
+
+async def _scn_poke_excursion(monitor, mutate=None) -> List[str]:
+    """C misses round 1's deadline: dropped + poked exactly once; its
+    answer clears the excursion at the dispatch service point (the
+    _poked service-point claim) and C mixes within a few rounds."""
+    world = SimWorld(("B", "C"), staleness_bound=0, deadline_s=0.25)
+    runner, agent = world.runner, world.agent
+    monitor.adopt_round_task()
+    monitor.install(runner)
+    if mutate is not None:
+        mutate(world)
+    fails: List[str] = []
+
+    async def b_echo():
+        stream = world.peers["B"]
+        try:
+            for rnd in range(1, 10):
+                while True:
+                    msg = await stream.recv()
+                    if isinstance(msg, P.AsyncValue):
+                        break
+                await stream.send(_frame([1.0], rnd))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return
+
+    async def c_waits_for_poke():
+        stream = world.peers["C"]
+        try:
+            while True:
+                msg = await stream.recv()
+                if isinstance(msg, P.AsyncPoke):
+                    break
+            for rnd in range(1, 10):
+                await stream.send(_frame([3.0], rnd))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return
+
+    asyncio.ensure_future(b_echo())
+    asyncio.ensure_future(c_waits_for_poke())
+    mixed_at = None
+    for rnd in range(1, 8):
+        await runner.run_async_round(np.zeros(1, np.float32))
+        if "C" in runner.last_stats.mixed:
+            mixed_at = rnd
+            break
+    if mixed_at is None:
+        fails.append("C never mixed within 7 rounds of its poke")
+    if "C" in runner._poked:
+        fails.append(
+            "C's poke excursion not cleared by its arrival (the "
+            "arrival-clears-excursion discipline)"
+        )
+    pokes = agent.counters.get("pokes_sent", 0)
+    if pokes != 1:
+        fails.append(
+            "pokes_sent {} != 1 — one poke per staleness "
+            "excursion".format(pokes)
+        )
+    return fails
+
+
+async def _scn_quarantine_storm(monitor, mutate=None) -> List[str]:
+    """Two protocol-violating frames from C reach the quarantine
+    threshold: C is evicted and the master receives exactly one
+    QUARANTINE telemetry payload (no rounds — the dispatch machinery
+    alone)."""
+    world = SimWorld(("B", "C"), staleness_bound=0, quarantine_after=2)
+    runner, agent = world.runner, world.agent
+    monitor.adopt_round_task()
+    monitor.install(runner)
+    if mutate is not None:
+        mutate(world)
+    fails: List[str] = []
+    payloads: List[dict] = []
+
+    async def master_script():
+        try:
+            while True:
+                msg = await world.master.recv()
+                if isinstance(msg, P.Telemetry):
+                    payloads.append(msg.payload)
+                    return
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return
+
+    collector = asyncio.ensure_future(master_script())
+    await world.peers["C"].send(_frame([9.0], -1))  # round_id < 0
+    await world.peers["C"].send(_frame([9.0], -1))
+    await runner._recv_step(None)
+    await runner._recv_step(None)
+    await collector
+    if "C" not in runner.quarantined:
+        fails.append(
+            "C not quarantined after {} violations".format(
+                world.runner.quarantine_after
+            )
+        )
+    if runner._box("C").violations != 2:
+        fails.append(
+            "violation tally {} != 2 — a lost update in the "
+            "check-then-act window".format(runner._box("C").violations)
+        )
+    if (
+        not payloads
+        or payloads[0].get("kind") != AR.QUARANTINE_PAYLOAD_KIND
+        or payloads[0].get("accused") != "C"
+    ):
+        fails.append(
+            "master did not receive the quarantine telemetry payload "
+            "accusing C (got {})".format(payloads)
+        )
+    return fails
+
+
+async def _scn_deadline_storm(monitor, mutate=None) -> List[str]:
+    """Both neighbors silent + fault-injected delays on the B edge:
+    the round must close at the deadline (virtual clock), drop both,
+    and poke both — FaultPlan's seeded delays compose with the seeded
+    schedule (joint (fault seed, schedule seed) replay)."""
+    world = SimWorld(("B", "C"), staleness_bound=0, deadline_s=0.5)
+    runner, agent = world.runner, world.agent
+    monitor.adopt_round_task()
+    monitor.install(runner)
+    if mutate is not None:
+        mutate(world)
+    wrapper = inject_neighbor_faults(
+        agent, "B", FaultPlan(7, delay_p=1.0, delay_max_s=0.2)
+    )
+    fails: List[str] = []
+    await runner.run_async_round(np.zeros(1, np.float32))
+    vtime = asyncio.get_event_loop().time()
+    if not 0.5 <= vtime < 1.0:
+        fails.append(
+            "round closed at virtual t={:.3f} — expected the 0.5s "
+            "deadline (+ bounded fault delays < 0.5s)".format(vtime)
+        )
+    if runner.last_stats.dropped != ["B", "C"]:
+        fails.append(
+            "dropped {} — expected both silent neighbors".format(
+                runner.last_stats.dropped
+            )
+        )
+    if agent.counters.get("pokes_sent", 0) != 2:
+        fails.append(
+            "pokes_sent {} != 2 — every deadline-dropped neighbor is "
+            "poked".format(agent.counters.get("pokes_sent", 0))
+        )
+    if wrapper.counters.get("delay", 0) < 1:
+        fails.append("fault plan injected no delays (delay_p=1.0)")
+    return fails
+
+
+async def _scn_choco_replay(monitor, mutate=None) -> List[str]:
+    """PR 15's choco-replay-apply counterexample through the REAL
+    stack: a correction plus its poke-answer replay arrive before the
+    round; the exactly-once watermark must apply the correction once
+    and count the replay as skipped."""
+    world = SimWorld(("B",), staleness_bound=1)
+    runner, agent = world.runner, world.agent
+    monitor.adopt_round_task()
+    monitor.install(runner)
+    if mutate is not None:
+        mutate(world)
+    fails: List[str] = []
+    q = np.asarray([2.0, -1.0], np.float32)
+    await world.peers["B"].send(_frame(q, 1, staleness=0))
+    await world.peers["B"].send(_frame(q, 1, staleness=1))  # the replay
+    await runner._recv_step(None)
+    await runner._recv_step(None)
+    await runner.run_async_choco(
+        np.zeros(2, np.float32), lambda v: v
+    )
+    hat = agent._choco_hat_nbrs.get("B")
+    if hat is None or not np.array_equal(hat, q):
+        fails.append(
+            "B's replicated estimate is {} — the exactly-once contract "
+            "wants the correction {} applied exactly once".format(
+                None if hat is None else hat.tolist(), q.tolist()
+            )
+        )
+    if agent.counters.get("async_choco_replay_skipped", 0) != 1:
+        fails.append(
+            "async_choco_replay_skipped {} != 1".format(
+                agent.counters.get("async_choco_replay_skipped", 0)
+            )
+        )
+    if runner.last_stats.applied.get("B") != 1:
+        fails.append(
+            "stats.applied {} != {{'B': 1}}".format(
+                runner.last_stats.applied
+            )
+        )
+    if runner.last_stats.skipped != 1:
+        fails.append(
+            "stats.skipped {} != 1".format(runner.last_stats.skipped)
+        )
+    return fails
+
+
+async def _scn_poke_liveness(monitor, mutate=None) -> List[str]:
+    """The poke IS the wakeup: C's only valid push is gated on
+    receiving the violation-path poke, with no deadline to fall back
+    on — losing that wakeup deadlocks the round (the lost-poke-wakeup
+    mutation's target)."""
+    world = SimWorld(
+        ("B", "C"), staleness_bound=0, quarantine_after=10
+    )
+    runner, agent = world.runner, world.agent
+    monitor.adopt_round_task()
+    monitor.install(runner)
+    if mutate is not None:
+        mutate(world)
+    fails: List[str] = []
+
+    async def b_echo():
+        stream = world.peers["B"]
+        try:
+            while True:
+                msg = await stream.recv()
+                if isinstance(msg, P.AsyncValue):
+                    break
+            await stream.send(_frame([1.0], 1))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return
+
+    async def c_script():
+        stream = world.peers["C"]
+        try:
+            await stream.send(_frame([5.0], -1))  # draws the poke
+            while True:
+                msg = await stream.recv()
+                if isinstance(msg, P.AsyncPoke):
+                    break
+            await stream.send(_frame([5.0], 1))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return
+
+    asyncio.ensure_future(b_echo())
+    asyncio.ensure_future(c_script())
+    await runner.run_async_round(np.zeros(1, np.float32))
+    if sorted(runner.last_stats.mixed) != ["B", "C"]:
+        fails.append(
+            "round mixed {} — expected B and C".format(
+                sorted(runner.last_stats.mixed)
+            )
+        )
+    if agent.counters.get("async_field_violations", 0) != 1:
+        fails.append("C's malformed frame was not flagged")
+    if agent.counters.get("pokes_sent", 0) != 1:
+        fails.append(
+            "pokes_sent {} != 1".format(
+                agent.counters.get("pokes_sent", 0)
+            )
+        )
+    return fails
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    s.name: s
+    for s in (
+        Scenario("membership-purge", _scn_membership_purge, (0, 1, 2, 3)),
+        Scenario("poke-excursion", _scn_poke_excursion, (0, 1, 2)),
+        Scenario("quarantine-storm", _scn_quarantine_storm,
+                 tuple(range(8))),
+        Scenario("deadline-storm", _scn_deadline_storm, (0, 1, 2)),
+        Scenario("choco-replay", _scn_choco_replay, (0, 1, 2)),
+        Scenario("poke-liveness", _scn_poke_liveness, (0, 1, 2)),
+    )
+}
+
+
+# --------------------------------------------------------------------- #
+# Seeded race mutations (the power self-test)                           #
+# --------------------------------------------------------------------- #
+def _mut_drop_purge_turn(world: SimWorld) -> None:
+    """Detach the membership inbox purge from the round task's
+    _handle_master turn — the exact race the _inbox suppression claims
+    away.  Expected: turn-discipline-claim contradiction."""
+    runner, agent = world.runner, world.agent
+
+    async def handle(msg):
+        if isinstance(msg, P.NeighborhoodData):
+            await agent._apply_neighborhood(msg)
+
+            async def purge():
+                for token in list(runner._inbox):
+                    if token not in agent._weights:
+                        del runner._inbox[token]
+
+            task = asyncio.ensure_future(purge())
+            task.add_done_callback(agent._silence)
+        elif isinstance(msg, P.Shutdown):
+            agent.status = AgentStatus.SHUTDOWN
+            raise ShutdownError(msg.reason)
+
+    runner._handle_master = handle
+
+
+def _mut_check_then_act(world: SimWorld) -> None:
+    """Open a check-then-act window on the violation tally: each
+    violation reads the count, yields, then writes it back from a
+    detached task.  Two interleaved violations lose an update, the
+    quarantine threshold is never reached, and the master's telemetry
+    wait deadlocks.  Expected: schedule-deadlock."""
+    runner, agent = world.runner, world.agent
+
+    def on_violation(token):
+        async def delayed():
+            box = runner._box(token)
+            tally = box.violations
+            await asyncio.sleep(0)  # the lost-update window
+            box.violations = tally + 1
+            agent._count("async_field_violations")
+            if box.violations >= runner.quarantine_after:
+                runner._quarantine(token)
+            else:
+                task = asyncio.ensure_future(runner._poke(token))
+                task.add_done_callback(agent._silence)
+
+        task = asyncio.ensure_future(delayed())
+        task.add_done_callback(agent._silence)
+
+    runner._on_violation = on_violation
+
+
+def _mut_lost_poke(world: SimWorld) -> None:
+    """Tally the poke but never send it — the lost wakeup.  C's valid
+    push is gated on that poke and poke-liveness has no deadline, so
+    the round can never complete.  Expected: schedule-deadlock."""
+    runner, agent = world.runner, world.agent
+
+    async def poke(token):
+        if token in runner._poked or token not in agent._neighbors:
+            return
+        runner._poked.add(token)
+        agent._count("pokes_sent")
+
+    runner._poke = poke
+
+
+def _mut_wallclock_jitter(world: SimWorld) -> None:
+    """Leak wall-clock entropy into the push path: same-seed schedules
+    stop replaying byte-identically.  Expected:
+    schedule-nondeterminism."""
+    runner = world.runner
+    orig = runner._push
+
+    async def push(value, staleness=0):
+        await asyncio.sleep(
+            max(1e-9, int.from_bytes(os.urandom(4), "little") / 1e9)
+        )
+        await orig(value, staleness)
+
+    runner._push = push
+
+
+def _mut_choco_reapply(world: SimWorld) -> None:
+    """Disable the exactly-once watermark (the round id never sticks):
+    a replayed correction double-applies and the replicated estimate
+    diverges — PR 15's choco-replay-apply counterexample against the
+    real stack.  Expected: schedule-deadlock (goal)."""
+    runner = world.runner
+
+    class _ReplayBox(AR._Inbox):
+        @property
+        def choco_applied_round(self):
+            return -1
+
+        @choco_applied_round.setter
+        def choco_applied_round(self, value):
+            pass
+
+    def box(token):
+        found = runner._inbox.get(token)
+        if found is None:
+            found = runner._inbox[token] = _ReplayBox()
+        return found
+
+    runner._box = box
+
+
+MUTATIONS: Dict[str, SchedMutation] = {
+    "drop-purge-turn": SchedMutation(
+        scenario="membership-purge",
+        expected_rule=TURN_RULE,
+        expected_token="contradicted",
+        description="membership inbox purge detached from the round "
+        "task's _recv_step turn",
+        apply=_mut_drop_purge_turn,
+        seeds=tuple(range(8)),
+    ),
+    "quarantine-check-then-act": SchedMutation(
+        scenario="quarantine-storm",
+        expected_rule=DEADLOCK_RULE,
+        expected_token="deadlock",
+        description="check-then-act window on the violation tally "
+        "loses an update below the quarantine threshold",
+        apply=_mut_check_then_act,
+        seeds=tuple(range(64)),
+        exhaustive_depth=10,
+    ),
+    "lost-poke-wakeup": SchedMutation(
+        scenario="poke-liveness",
+        expected_rule=DEADLOCK_RULE,
+        expected_token="deadlock",
+        description="poke tallied but never sent — the waiter's only "
+        "wakeup is lost",
+        apply=_mut_lost_poke,
+        seeds=(0,),
+    ),
+    "wallclock-jitter": SchedMutation(
+        scenario="membership-purge",
+        expected_rule=NONDET_RULE,
+        expected_token="",
+        description="wall-clock entropy in the push path breaks "
+        "same-seed trace identity",
+        apply=_mut_wallclock_jitter,
+        seeds=(0,),
+    ),
+    "choco-replay-reapply": SchedMutation(
+        scenario="choco-replay",
+        expected_rule=DEADLOCK_RULE,
+        expected_token="goal",
+        description="exactly-once watermark disabled: a replayed "
+        "correction double-applies into the replicated estimate",
+        apply=_mut_choco_reapply,
+        seeds=(0,),
+    ),
+}

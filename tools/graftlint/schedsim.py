@@ -1,0 +1,1237 @@
+"""graftsched stage: deterministic asyncio schedule exploration.
+
+PR 15 model-checked the protocol *specs*; this stage checks the
+*implementation*: the real ``comm`` coroutines (agent, async runner,
+master, multiplexer, framed/faulty streams) are driven on a controlled
+event loop — :class:`SimLoop` — that
+
+* runs a **virtual clock**: timers fire in simulated time, so
+  ``deadline_s`` expiries, retry backoff, and poke/cooldown paths
+  explore in milliseconds of wall time;
+* **serializes task steps** and lets a schedule policy choose which
+  runnable callback fires whenever more than one is ready —
+  seeded-random schedules (:class:`SeededPolicy`) plus a bounded
+  preemption-exhaustive DFS (:func:`explore_exhaustive`) over the
+  choice points of the annotated hot coroutines;
+* records a **byte-identical event trace** per (scenario, schedule):
+  one line per executed callback, virtual timestamp + sanitized task
+  label (no object ids, no wall clock) — same seed MUST reproduce the
+  same trace bytes, and the stage checks that every run
+  (``schedule-nondeterminism``).
+
+Three checkers ride on top (corpus: ``tools/graftlint/sched_corpus.py``):
+
+* **turn-discipline claim verification** (``turn-discipline-claim``) —
+  every ``task-shared-mutation`` suppression reason in the sched files
+  parses into a checkable claim (:func:`tools.graftlint.claims.
+  parse_sched_claim`: ``turn`` = the mutation only ever executes on the
+  round task; ``service-point`` = additionally inside the round task's
+  own ``_recv_step`` await).  The runner's ``_inbox``/``_poked``
+  containers are replaced with monitored twins and every explored
+  schedule asserts the claimed serialization actually held; a
+  contradiction fails lint naming the suppression site and the
+  schedule that broke it.
+* **deadlock / lost-wakeup detection** (``schedule-deadlock``) — a
+  state with no runnable callback, no pending timer, and the scenario
+  goal still unfulfilled raises a schedule snapshot (pending tasks,
+  their suspension frames, the trace tail — the linear trace is the
+  parent-pointer path of this explorer); an end state failing the
+  scenario's goal predicates reports the same rule with kind
+  ``goal``.  PR 15's mutation counterexamples are cross-validated by
+  replaying them through the real stack under schedsim
+  (``choco-replay`` scenario here; skew1 + round-end in
+  ``tests/test_schedsim.py``).
+* **determinism** (``schedule-nondeterminism``) — each scenario runs
+  twice per seed and the traces are compared byte-for-byte; residual
+  wall-clock or iteration-order leaks fail lint.
+
+Like the proto stage, the explorer self-tests its power on every run:
+the seeded race mutations in the corpus (a dropped inbox-purge turn, a
+check-then-act window on the quarantine tally, a lost poke wakeup, a
+wall-clock jitter leak, a re-applied CHOCO correction) MUST keep
+producing their expected findings; one that stops is itself a lint
+failure.
+
+The await-point model of the ``SCHED_HOT``-annotated coroutines pins
+under the ``sched_model`` key of ``audit_expected.json`` through the
+standard ``--audit-write`` lifecycle (rule ``sched-model-pin``), along
+with the verification status of every sched claim.
+
+Everything here is jax-free (stdlib + the comm modules, whose package
+roots import lazily); run standalone with
+``python -m tools.graftlint --sched`` or
+``python -m tools.graftlint.schedsim``.
+"""
+
+from __future__ import annotations
+
+import ast
+import asyncio
+import dataclasses
+import functools
+import heapq
+import itertools
+import json
+import os
+import random
+import sys
+from asyncio import events
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from tools.graftlint.claims import parse_sched_claim
+from tools.graftlint.core import Finding, REPO_ROOT, Rule, register
+from tools.graftlint.jaxpr_audit import EXPECTED_PATH
+
+#: The AST rule whose suppression reasons carry the sched claims
+#: (concurrency.TaskSharedMutation.name).
+TASK_MUTATION_RULE = "task-shared-mutation"
+
+TURN_RULE = "turn-discipline-claim"
+DEADLOCK_RULE = "schedule-deadlock"
+NONDET_RULE = "schedule-nondeterminism"
+PIN_RULE = "sched-model-pin"
+
+#: The sched stage's source surface: the modules whose ``SCHED_HOT``
+#: annotations feed the await-point model and whose suppressions carry
+#: sched claims.  ``--changed`` runs the stage when any member changed.
+SCHED_FILES = (
+    "distributed_learning_tpu/comm/async_runtime.py",
+    "distributed_learning_tpu/comm/agent.py",
+    "distributed_learning_tpu/comm/master.py",
+    "distributed_learning_tpu/comm/multiplexer.py",
+    "distributed_learning_tpu/comm/framing.py",
+    "distributed_learning_tpu/comm/faults.py",
+)
+
+#: Corpus-level findings (deadlocks, goal failures, lost mutation
+#: power) anchor to the corpus file — the checkable artifact, exactly
+#: as proto findings anchor to proto_spec.py.
+CORPUS_REL = "tools/graftlint/sched_corpus.py"
+
+#: Runaway guard per schedule: far above any corpus scenario (the
+#: largest executes ~2k steps); hitting it is reported, never silent.
+MAX_STEPS = 200_000
+
+
+@register
+class TurnDisciplineClaim(Rule):
+    """A task-shared-mutation suppression's serialization claim must
+    hold on every explored schedule."""
+
+    name = TURN_RULE
+    stage = "sched"
+
+    def check(self, ctx) -> List[Finding]:  # stage-level, not per-file
+        return []
+
+
+@register
+class ScheduleDeadlock(Rule):
+    """No explored schedule may deadlock (kind ``deadlock``: no
+    runnable task + unmet goal) or end with a scenario goal unmet
+    (kind ``goal``)."""
+
+    name = DEADLOCK_RULE
+    stage = "sched"
+
+    def check(self, ctx) -> List[Finding]:
+        return []
+
+
+@register
+class ScheduleNondeterminism(Rule):
+    """Same schedule seed must reproduce a byte-identical event
+    trace."""
+
+    name = NONDET_RULE
+    stage = "sched"
+
+    def check(self, ctx) -> List[Finding]:
+        return []
+
+
+@register
+class SchedModelPin(Rule):
+    """The await-point model + claim statuses must match their
+    ``sched_model`` pin in audit_expected.json."""
+
+    name = PIN_RULE
+    stage = "sched"
+
+    def check(self, ctx) -> List[Finding]:
+        return []
+
+
+# --------------------------------------------------------------------- #
+# The deterministic event loop                                          #
+# --------------------------------------------------------------------- #
+class DeadlockError(RuntimeError):
+    """No runnable callback and no pending timer while the scenario's
+    main future is still pending.  ``snapshot`` names every pending
+    task, its suspension frame, and the schedule-trace tail."""
+
+    def __init__(self, snapshot: str):
+        super().__init__(snapshot)
+        self.snapshot = snapshot
+
+
+class SeededPolicy:
+    """Pick uniformly among runnable callbacks from a seeded stdlib
+    rng — the seeded-random schedule family."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._rng = random.Random(int(seed))
+
+    def choose(self, n: int) -> int:
+        return self._rng.randrange(n)
+
+
+class ReplayPolicy:
+    """Force a recorded choice prefix, then always pick index 0 — the
+    unit of the bounded-exhaustive DFS and of counterexample replay."""
+
+    def __init__(self, prefix: Sequence[int] = ()):
+        self.prefix = tuple(int(i) for i in prefix)
+        self._i = 0
+
+    def choose(self, n: int) -> int:
+        if self._i < len(self.prefix):
+            idx = self.prefix[self._i]
+            self._i += 1
+            return idx if idx < n else 0
+        self._i += 1
+        return 0
+
+
+class SimLoop(asyncio.AbstractEventLoop):
+    """A single-threaded, virtually-clocked, policy-scheduled event
+    loop.  Real asyncio primitives (Task, Future, Event, StreamReader,
+    wait, wait_for, sleep) run on it unmodified; only *when* each ready
+    callback fires is ours to choose, and time advances exactly to the
+    next armed timer whenever no callback is runnable."""
+
+    def __init__(self, policy=None, max_steps: int = MAX_STEPS):
+        self._time = 0.0
+        self._ready: List[Tuple[int, str, asyncio.Handle]] = []
+        self._timers: list = []  # heap of (when, seq, label, handle)
+        self._seq = itertools.count()
+        self._policy = policy or SeededPolicy(0)
+        self._max_steps = int(max_steps)
+        self._running = False
+        self._closed = False
+        self._debug = False
+        #: (virtual time, label) per executed callback — THE schedule.
+        self.trace: List[Tuple[float, str]] = []
+        #: policy decisions taken at >1-way choice points (replayable
+        #: via ReplayPolicy) and the fanout seen at each.
+        self.choices: List[int] = []
+        self.branch_sizes: List[int] = []
+        #: unhandled exception contexts funneled through the loop.
+        self.errors: List[str] = []
+        self._task_labels: Dict[Any, str] = {}
+        self._ntasks = itertools.count(1)
+        self._steps = 0
+
+    # -- introspection ------------------------------------------------ #
+    def get_debug(self) -> bool:
+        return self._debug
+
+    def set_debug(self, enabled: bool) -> None:
+        self._debug = enabled
+
+    def is_running(self) -> bool:
+        return self._running
+
+    def is_closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+
+    def time(self) -> float:
+        return self._time
+
+    # -- labels: sanitized, id-free, deterministic --------------------- #
+    def _label_of(self, callback) -> str:
+        owner = getattr(callback, "__self__", None)
+        if owner is not None and owner in self._task_labels:
+            return self._task_labels[owner]
+        if isinstance(callback, functools.partial):
+            return "partial:" + self._label_of(callback.func)
+        qualname = getattr(callback, "__qualname__", None)
+        if qualname:
+            return qualname
+        return type(callback).__name__
+
+    # -- scheduling surface ------------------------------------------- #
+    def call_soon(self, callback, *args, context=None):
+        handle = asyncio.Handle(callback, args, self, context)
+        self._ready.append(
+            (next(self._seq), self._label_of(callback), handle)
+        )
+        return handle
+
+    call_soon_threadsafe = call_soon
+
+    def call_later(self, delay, callback, *args, context=None):
+        return self.call_at(
+            self._time + max(0.0, delay), callback, *args, context=context
+        )
+
+    def call_at(self, when, callback, *args, context=None):
+        handle = asyncio.TimerHandle(when, callback, args, self, context)
+        heapq.heappush(
+            self._timers,
+            (when, next(self._seq), self._label_of(callback), handle),
+        )
+        handle._scheduled = True
+        return handle
+
+    def _timer_handle_cancelled(self, handle) -> None:
+        pass  # lazily skipped when popped
+
+    def create_future(self):
+        return asyncio.Future(loop=self)
+
+    def create_task(self, coro, *, name=None, context=None):
+        task = asyncio.Task(coro, loop=self, name=name)
+        label = "T{}:{}".format(
+            next(self._ntasks), getattr(coro, "__qualname__", "coro")
+        )
+        self._task_labels[task] = label
+        # Task.__init__ enqueued its first step via call_soon before a
+        # label existed; retag that entry.
+        seq, _, handle = self._ready[-1]
+        self._ready[-1] = (seq, label, handle)
+        return task
+
+    def label_of_task(self, task) -> str:
+        return self._task_labels.get(task, "task")
+
+    def call_exception_handler(self, context) -> None:
+        exc = context.get("exception")
+        self.errors.append(
+            "{}: {!r}".format(context.get("message"), exc)
+            if exc is not None
+            else str(context.get("message"))
+        )
+
+    def default_exception_handler(self, context) -> None:
+        self.call_exception_handler(context)
+
+    # -- the clock and the step engine --------------------------------- #
+    def _pump_timers(self) -> None:
+        # Due timers always become runnable; when NOTHING is runnable,
+        # virtual time advances exactly to the earliest armed timer.
+        if not self._ready:
+            while self._timers and self._timers[0][3]._cancelled:
+                heapq.heappop(self._timers)
+            if self._timers:
+                self._time = max(self._time, self._timers[0][0])
+        while self._timers:
+            when, seq, label, handle = self._timers[0]
+            if handle._cancelled:
+                heapq.heappop(self._timers)
+                continue
+            if when <= self._time:
+                heapq.heappop(self._timers)
+                self._ready.append((seq, label, handle))
+            else:
+                break
+
+    def _step(self) -> bool:
+        self._pump_timers()
+        if not self._ready:
+            return False
+        if len(self._ready) > 1:
+            idx = self._policy.choose(len(self._ready))
+            self.choices.append(idx)
+            self.branch_sizes.append(len(self._ready))
+        else:
+            idx = 0
+        _, label, handle = self._ready.pop(idx)
+        self.trace.append((self._time, label))
+        self._steps += 1
+        if not handle._cancelled:
+            handle._run()
+        return True
+
+    def _snapshot(self) -> str:
+        lines = [
+            "no runnable callback and no armed timer while the "
+            "scenario is pending (deadlock / lost wakeup)"
+        ]
+        pending = [t for t in asyncio.all_tasks(self) if not t.done()]
+        pending.sort(key=self.label_of_task)
+        for task in pending:
+            frames = task.get_stack(limit=8)
+            if frames:
+                frame = frames[-1]
+                where = "{}:{} in {}".format(
+                    os.path.basename(frame.f_code.co_filename),
+                    frame.f_lineno,
+                    frame.f_code.co_name,
+                )
+            else:
+                where = "<no frame>"
+            lines.append(
+                "  pending {} suspended at {}".format(
+                    self.label_of_task(task), where
+                )
+            )
+        tail = self.trace[-14:]
+        lines.append(
+            "  schedule trace (tail): "
+            + " -> ".join(label for _, label in tail)
+        )
+        return "\n".join(lines)
+
+    def run_until_complete(self, future):
+        fut = asyncio.ensure_future(future, loop=self)
+        if fut not in self._task_labels:
+            self._task_labels[fut] = "T0:main"
+        old_running = events._get_running_loop()
+        events._set_running_loop(self)
+        self._running = True
+        try:
+            while not fut.done():
+                if self._steps >= self._max_steps:
+                    raise DeadlockError(
+                        "schedule exceeded {} steps (livelock?)\n{}".format(
+                            self._max_steps, self._snapshot()
+                        )
+                    )
+                if not self._step():
+                    raise DeadlockError(self._snapshot())
+        finally:
+            self._running = False
+            events._set_running_loop(old_running)
+        return fut.result()
+
+    def drain(self) -> None:
+        """Cancel every still-pending task and let the cancellations
+        run out (FIFO, no policy, no clock) so no task outlives the
+        simulation half-finished."""
+        for task in asyncio.all_tasks(self):
+            task.cancel()
+        old_running = events._get_running_loop()
+        events._set_running_loop(self)
+        try:
+            for _ in range(10_000):
+                if not self._ready:
+                    break
+                _, _, handle = self._ready.pop(0)
+                if not handle._cancelled:
+                    handle._run()
+        finally:
+            events._set_running_loop(old_running)
+
+    def trace_text(self) -> str:
+        """The schedule as bytes-comparable text: one
+        ``<virtual time> <label>`` line per executed callback."""
+        return "\n".join(
+            "{:.9f} {}".format(t, label) for t, label in self.trace
+        )
+
+
+# --------------------------------------------------------------------- #
+# Claim monitoring (the runtime half of the suppression contract)       #
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class MutEvent:
+    """One observed mutation of a claimed shared container."""
+
+    attr: str  # "_inbox" | "_poked"
+    op: str  # "remove" | "add"
+    task_label: str
+    on_round_task: bool
+    in_recv_step: bool
+    site: Optional[int]  # async_runtime.py line, None for patched code
+
+
+class ClaimMonitor:
+    """Replaces a runner's ``_inbox``/``_poked`` with monitored twins
+    and records, for every mutation, which task performed it and
+    whether the round task's ``_recv_step`` frame was on the stack —
+    the two facts the sched claim kinds assert."""
+
+    def __init__(self):
+        self.events: List[MutEvent] = []
+        self.round_task = None
+
+    def adopt_round_task(self) -> None:
+        """Declare the calling task the round task (the one whose turn
+        discipline the suppressions claim)."""
+        self.round_task = asyncio.current_task()
+
+    def install(self, runner) -> None:
+        runner._inbox = _MonDict(self, "_inbox", runner._inbox)
+        runner._poked = _MonSet(self, "_poked", runner._poked)
+
+    def record(self, attr: str, op: str) -> None:
+        task = asyncio.current_task()
+        loop = events._get_running_loop()
+        label = (
+            loop.label_of_task(task)
+            if isinstance(loop, SimLoop)
+            else "task"
+        )
+        in_recv = False
+        site: Optional[int] = None
+        frame = sys._getframe(1)
+        while frame is not None:
+            code = frame.f_code
+            if code.co_name == "_recv_step":
+                in_recv = True
+            if site is None and code.co_filename.endswith(
+                "async_runtime.py"
+            ):
+                site = frame.f_lineno
+            frame = frame.f_back
+        self.events.append(MutEvent(
+            attr=attr, op=op, task_label=label,
+            on_round_task=(
+                self.round_task is not None and task is self.round_task
+            ),
+            in_recv_step=in_recv, site=site,
+        ))
+
+
+class _MonDict(dict):
+    def __init__(self, monitor: ClaimMonitor, attr: str, init):
+        super().__init__(init)
+        self._monitor = monitor
+        self._attr = attr
+
+    def __delitem__(self, key):
+        self._monitor.record(self._attr, "remove")
+        super().__delitem__(key)
+
+    def pop(self, *args):
+        self._monitor.record(self._attr, "remove")
+        return super().pop(*args)
+
+    def clear(self):
+        self._monitor.record(self._attr, "remove")
+        super().clear()
+
+
+class _MonSet(set):
+    def __init__(self, monitor: ClaimMonitor, attr: str, init):
+        super().__init__(init)
+        self._monitor = monitor
+        self._attr = attr
+
+    def add(self, item):
+        self._monitor.record(self._attr, "add")
+        super().add(item)
+
+    def discard(self, item):
+        self._monitor.record(self._attr, "remove")
+        super().discard(item)
+
+    def remove(self, item):
+        self._monitor.record(self._attr, "remove")
+        super().remove(item)
+
+    def pop(self):
+        self._monitor.record(self._attr, "remove")
+        return super().pop()
+
+    def clear(self):
+        self._monitor.record(self._attr, "remove")
+        super().clear()
+
+
+# --------------------------------------------------------------------- #
+# Static extraction: await-point model + sched claims                   #
+# --------------------------------------------------------------------- #
+def _dotted(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return "{}.{}".format(base, node.attr) if base else node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _dotted(node.func)
+    if isinstance(node, ast.Await):
+        return _dotted(node.value)
+    return None
+
+
+def _sched_hot_names(tree: ast.Module) -> Optional[List[str]]:
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "SCHED_HOT"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            names = []
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    names.append(elt.value)
+            return names
+    return None
+
+
+def _function_index(tree: ast.Module) -> Dict[str, List[ast.AST]]:
+    """name -> defs and "Class.method" -> def, for SCHED_HOT lookup."""
+    index: Dict[str, List[ast.AST]] = {}
+
+    def add(key: str, node: ast.AST) -> None:
+        index.setdefault(key, []).append(node)
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add(node.name, node)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    add(sub.name, sub)
+                    add("{}.{}".format(node.name, sub.name), sub)
+    return index
+
+
+def _await_labels(fn: ast.AST) -> List[str]:
+    """The ordered await points of one coroutine, labeled by the dotted
+    name of the awaited callee (source order; names, never line numbers,
+    so an unrelated edit above cannot fake a model drift)."""
+    awaits = [
+        node
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Await)
+    ]
+    awaits.sort(key=lambda n: (n.lineno, n.col_offset))
+    return [_dotted(a.value) or "<dynamic>" for a in awaits]
+
+
+def extract_model(
+    repo_root: str = REPO_ROOT,
+) -> Tuple[Dict[str, Dict[str, List[str]]], List[Finding]]:
+    """{file: {coroutine: [await labels]}} over the SCHED_HOT
+    annotations of every sched file, plus extraction findings."""
+    model: Dict[str, Dict[str, List[str]]] = {}
+    findings: List[Finding] = []
+    for rel in SCHED_FILES:
+        path = os.path.join(repo_root, rel)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            findings.append(Finding(
+                PIN_RULE, rel, 1,
+                "sched file missing — SCHED_FILES lists a module that "
+                "does not exist",
+            ))
+            continue
+        tree = ast.parse(source)
+        hot = _sched_hot_names(tree)
+        if hot is None:
+            findings.append(Finding(
+                PIN_RULE, rel, 1,
+                "no module-level SCHED_HOT tuple: every sched file "
+                "must annotate its hot coroutines so their await-point "
+                "model pins under sched_model",
+            ))
+            continue
+        index = _function_index(tree)
+        entry: Dict[str, List[str]] = {}
+        for name in hot:
+            nodes = index.get(name, [])
+            if len(nodes) != 1:
+                findings.append(Finding(
+                    PIN_RULE, rel, 1,
+                    "SCHED_HOT entry {!r} matches {} definitions — "
+                    "name it uniquely (Class.method) so the await "
+                    "model is unambiguous".format(name, len(nodes)),
+                ))
+                continue
+            node = nodes[0]
+            if not isinstance(node, ast.AsyncFunctionDef):
+                findings.append(Finding(
+                    PIN_RULE, rel, node.lineno,
+                    "SCHED_HOT entry {!r} is not an async def — only "
+                    "coroutines have await points to model".format(name),
+                ))
+                continue
+            entry[name] = _await_labels(node)
+        model[rel] = entry
+    return model, findings
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedClaimSite:
+    """One task-shared-mutation suppression, resolved to a checkable
+    claim: which function mutates which attribute under which claimed
+    serialization discipline."""
+
+    key: str  # "<path>::<func>.<attr>" — stable across line drift
+    path: str
+    line: int
+    func: str
+    attr: str
+    kind: str  # "turn" | "service-point"
+
+    @property
+    def site(self) -> str:
+        return "{}:{}".format(self.path, self.line)
+
+
+def _enclosing_function(
+    tree: ast.Module, line: int
+) -> Optional[ast.AST]:
+    best = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    return best
+
+
+def collect_claims(
+    repo_root: str = REPO_ROOT,
+) -> Tuple[Dict[str, SchedClaimSite], List[Finding]]:
+    """Every task-shared-mutation suppression in the sched files as a
+    :class:`SchedClaimSite`; an unparseable reason (or a suppressed
+    line with no recognizable self-attribute mutation) is a
+    turn-discipline-claim finding — a claim nothing can check is debt,
+    not a pass (the PR 12 rule for collective claims)."""
+    from tools.graftlint.claims import inventory
+    from tools.graftlint.concurrency import TaskSharedMutation
+
+    _mutations = TaskSharedMutation()._mutations
+
+    claims: Dict[str, SchedClaimSite] = {}
+    findings: List[Finding] = []
+    paths = [os.path.join(repo_root, rel) for rel in SCHED_FILES]
+    records = inventory(
+        paths=[p for p in paths if os.path.exists(p)],
+        repo_root=repo_root,
+    )
+    for record in records:
+        if TASK_MUTATION_RULE not in record.rules:
+            continue
+        claim = parse_sched_claim(record.reason)
+        if claim is None:
+            findings.append(Finding(
+                TURN_RULE, record.path, record.line,
+                "task-shared-mutation suppression reason parses into "
+                "no sched claim (expected a 'turn discipline' or "
+                "'service point'/'FIFO discipline' phrase naming the "
+                "serialization the line relies on): {!r}".format(
+                    record.reason
+                ),
+            ))
+            continue
+        with open(
+            os.path.join(repo_root, record.path), "r", encoding="utf-8"
+        ) as fh:
+            tree = ast.parse(fh.read())
+        fn = _enclosing_function(tree, record.line)
+        attrs = (
+            [a for a, ln in _mutations(fn) if ln == record.line]
+            if fn is not None
+            else []
+        )
+        if fn is None or not attrs:
+            findings.append(Finding(
+                TURN_RULE, record.path, record.line,
+                "task-shared-mutation suppression covers a line with "
+                "no recognizable self-attribute mutation — the claim "
+                "is unanchored and cannot be verified",
+            ))
+            continue
+        site = SchedClaimSite(
+            key="{}::{}.{}".format(record.path, fn.name, attrs[0]),
+            path=record.path, line=record.line,
+            func=fn.name, attr=attrs[0], kind=claim.kind,
+        )
+        claims[site.key] = site
+    return claims, findings
+
+
+# --------------------------------------------------------------------- #
+# Schedule execution + finding synthesis                                #
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass
+class RunResult:
+    """One scenario under one schedule."""
+
+    scenario: str
+    schedule: str  # "seed=3" | "prefix=(0, 2)"
+    trace: str
+    choices: Tuple[int, ...]
+    branch_sizes: Tuple[int, ...]
+    vtime: float
+    goal_failures: List[str]
+    deadlock: Optional[str]
+    events: List[MutEvent]
+    loop_errors: List[str]
+
+
+def execute(
+    scenario, policy, schedule: str, mutate=None,
+    max_steps: int = MAX_STEPS,
+) -> RunResult:
+    """Run one corpus scenario to completion under a schedule policy
+    on a fresh SimLoop."""
+    loop = SimLoop(policy, max_steps=max_steps)
+    monitor = ClaimMonitor()
+    goal_failures: List[str] = []
+    deadlock: Optional[str] = None
+    try:
+        try:
+            goal_failures = list(
+                loop.run_until_complete(scenario.fn(monitor, mutate))
+            )
+        except DeadlockError as exc:
+            deadlock = exc.snapshot
+    finally:
+        loop.drain()
+        loop.close()
+    return RunResult(
+        scenario=scenario.name,
+        schedule=schedule,
+        trace=loop.trace_text(),
+        choices=tuple(loop.choices),
+        branch_sizes=tuple(loop.branch_sizes),
+        vtime=loop.time(),
+        goal_failures=goal_failures,
+        deadlock=deadlock,
+        events=monitor.events,
+        loop_errors=list(loop.errors),
+    )
+
+
+def _claim_findings(
+    result: RunResult, claims: Dict[str, SchedClaimSite]
+) -> List[Finding]:
+    by_attr: Dict[str, List[SchedClaimSite]] = {}
+    for site in claims.values():
+        by_attr.setdefault(site.attr, []).append(site)
+    findings: List[Finding] = []
+    flagged = set()
+    for event in result.events:
+        if event.op != "remove":
+            continue
+        for site in by_attr.get(event.attr, []):
+            holds = (
+                event.on_round_task
+                if site.kind == "turn"
+                else event.on_round_task and event.in_recv_step
+            )
+            if holds or site.key in flagged:
+                continue
+            flagged.add(site.key)
+            why = []
+            if not event.on_round_task:
+                why.append(
+                    "executed on task {!r}, not the round task".format(
+                        event.task_label
+                    )
+                )
+            if site.kind == "service-point" and not event.in_recv_step:
+                why.append(
+                    "no _recv_step frame on the stack (outside the "
+                    "dispatch service point)"
+                )
+            findings.append(Finding(
+                TURN_RULE, site.path, site.line,
+                "claimed {} serialization of {} contradicted in "
+                "scenario {!r} under schedule {}: {}{} — replay with "
+                "this scenario + schedule to reproduce".format(
+                    site.kind, site.attr, result.scenario,
+                    result.schedule, "; ".join(why),
+                    ""
+                    if event.site is None
+                    else " (mutation reached from async_runtime.py:{})"
+                    .format(event.site),
+                ),
+            ))
+    return findings
+
+
+def _run_findings(
+    result: RunResult, claims: Dict[str, SchedClaimSite]
+) -> List[Finding]:
+    """Everything one executed schedule can report."""
+    findings = _claim_findings(result, claims)
+    if result.deadlock is not None:
+        findings.append(Finding(
+            DEADLOCK_RULE, CORPUS_REL, 1,
+            "[deadlock] scenario {!r} under schedule {}: {}".format(
+                result.scenario, result.schedule, result.deadlock
+            ),
+        ))
+    for failure in result.goal_failures:
+        findings.append(Finding(
+            DEADLOCK_RULE, CORPUS_REL, 1,
+            "[goal] scenario {!r} under schedule {}: end-state goal "
+            "unmet: {}".format(
+                result.scenario, result.schedule, failure
+            ),
+        ))
+    for error in result.loop_errors:
+        findings.append(Finding(
+            DEADLOCK_RULE, CORPUS_REL, 1,
+            "[goal] scenario {!r} under schedule {}: unhandled "
+            "exception escaped a task: {}".format(
+                result.scenario, result.schedule, error
+            ),
+        ))
+    return findings
+
+
+def explore_exhaustive(
+    scenario, claims: Dict[str, SchedClaimSite], mutate=None,
+    max_depth: int = 12, max_schedules: int = 200,
+) -> Tuple[List[Finding], int]:
+    """Bounded preemption-exhaustive DFS over the scenario's choice
+    points: systematically flip each of the first ``max_depth``
+    scheduler decisions, depth-first, until a finding appears or the
+    schedule budget runs out.  Returns (findings of the first failing
+    schedule, schedules explored)."""
+    stack: List[Tuple[int, ...]] = [()]
+    tried = {()}
+    explored = 0
+    while stack and explored < max_schedules:
+        prefix = stack.pop()
+        result = execute(
+            scenario, ReplayPolicy(prefix),
+            "prefix={}".format(prefix), mutate,
+        )
+        explored += 1
+        findings = _run_findings(result, claims)
+        if findings:
+            return findings, explored
+        for k in range(len(prefix), min(len(result.branch_sizes),
+                                        max_depth)):
+            base = prefix + (0,) * (k - len(prefix))
+            for alt in range(1, result.branch_sizes[k]):
+                candidate = base + (alt,)
+                if candidate not in tried:
+                    tried.add(candidate)
+                    stack.append(candidate)
+    return [], explored
+
+
+# --------------------------------------------------------------------- #
+# Corpus orchestration: clean runs, determinism, mutation power         #
+# --------------------------------------------------------------------- #
+def _corpus():
+    # Imported lazily: pulls the comm modules (numpy etc.), which the
+    # pure-static surfaces (claim_statuses, extract_model) never need.
+    from tools.graftlint import sched_corpus
+
+    return sched_corpus
+
+
+def run_corpus(
+    claims: Dict[str, SchedClaimSite],
+) -> Tuple[List[Finding], Dict[str, Dict[str, str]]]:
+    """The dynamic half of the stage: every scenario under its seeded
+    schedules (claims asserted on each), a byte-identity determinism
+    replay per scenario, and the mutation-power self-test.  Returns
+    (findings, per-claim status map for the pin)."""
+    corpus = _corpus()
+    findings: List[Finding] = []
+    exercised: Dict[str, bool] = {key: False for key in claims}
+    contradicted = set()
+    for scenario in corpus.SCENARIOS.values():
+        for seed in scenario.seeds:
+            result = execute(
+                scenario, SeededPolicy(seed), "seed={}".format(seed)
+            )
+            run_findings = _run_findings(result, claims)
+            findings.extend(run_findings)
+            for finding in run_findings:
+                if finding.rule == TURN_RULE:
+                    for key, site in claims.items():
+                        if (finding.path, finding.line) == (
+                            site.path, site.line
+                        ):
+                            contradicted.add(key)
+            for event in result.events:
+                if event.op != "remove":
+                    continue
+                for key, site in claims.items():
+                    if site.attr == event.attr:
+                        exercised[key] = True
+        # Determinism: the first seed, replayed — traces must be
+        # byte-identical.
+        seed = scenario.seeds[0]
+        first = execute(
+            scenario, SeededPolicy(seed), "seed={}".format(seed)
+        )
+        second = execute(
+            scenario, SeededPolicy(seed), "seed={}".format(seed)
+        )
+        if first.trace != second.trace:
+            findings.append(Finding(
+                NONDET_RULE, CORPUS_REL, 1,
+                "scenario {!r} under schedule seed={} produced two "
+                "DIFFERENT event traces ({}) — a wall-clock or "
+                "iteration-order leak makes schedules unreplayable"
+                .format(
+                    scenario.name, seed,
+                    _first_divergence(first.trace, second.trace),
+                ),
+            ))
+    for name, mutation in corpus.MUTATIONS.items():
+        caught = _search_mutation(corpus, name, mutation, claims)
+        if not caught:
+            findings.append(Finding(
+                mutation.expected_rule, CORPUS_REL, 1,
+                "seeded mutation {!r} ({}) no longer produces a "
+                "{} finding within its schedule budget — the schedule "
+                "explorer lost the power to catch the race it exists "
+                "to catch".format(
+                    name, mutation.description, mutation.expected_rule
+                ),
+            ))
+    statuses = {
+        key: {
+            "kind": claims[key].kind,
+            "status": (
+                "contradicted"
+                if key in contradicted
+                else "verified" if exercised[key] else "unexercised"
+            ),
+        }
+        for key in claims
+    }
+    return findings, statuses
+
+
+def _first_divergence(a: str, b: str) -> str:
+    a_lines, b_lines = a.splitlines(), b.splitlines()
+    for i, (la, lb) in enumerate(zip(a_lines, b_lines)):
+        if la != lb:
+            return "first divergence at step {}: {!r} != {!r}".format(
+                i, la, lb
+            )
+    return "length {} != {}".format(len(a_lines), len(b_lines))
+
+
+def _search_mutation(
+    corpus, name: str, mutation, claims: Dict[str, SchedClaimSite]
+) -> List[Finding]:
+    """Findings of the first schedule that catches the mutation ([] =
+    power lost).  Nondeterminism mutations are caught by trace
+    comparison; the rest by seeded search, then bounded-exhaustive
+    DFS."""
+    scenario = corpus.SCENARIOS[mutation.scenario]
+    if mutation.expected_rule == NONDET_RULE:
+        seed = mutation.seeds[0]
+        first = execute(
+            scenario, SeededPolicy(seed),
+            "seed={}".format(seed), mutation.apply,
+        )
+        second = execute(
+            scenario, SeededPolicy(seed),
+            "seed={}".format(seed), mutation.apply,
+        )
+        if first.trace != second.trace:
+            return [Finding(
+                NONDET_RULE, CORPUS_REL, 1,
+                "mutation {!r}: same-seed traces diverged ({})".format(
+                    name, _first_divergence(first.trace, second.trace)
+                ),
+            )]
+        return []
+
+    def matches(findings: List[Finding]) -> List[Finding]:
+        return [
+            f
+            for f in findings
+            if f.rule == mutation.expected_rule
+            and mutation.expected_token in f.message
+        ]
+
+    for seed in mutation.seeds:
+        result = execute(
+            scenario, SeededPolicy(seed),
+            "seed={}".format(seed), mutation.apply,
+        )
+        found = matches(_run_findings(result, claims))
+        if found:
+            return found
+    if mutation.exhaustive_depth:
+        findings, _ = explore_exhaustive(
+            scenario, claims, mutation.apply,
+            max_depth=mutation.exhaustive_depth,
+        )
+        found = matches(findings)
+        if found:
+            return found
+    return []
+
+
+# --------------------------------------------------------------------- #
+# Pin lifecycle (the proto_extract.py shape)                            #
+# --------------------------------------------------------------------- #
+def check(
+    repo_root: str = REPO_ROOT,
+    expected_path: str = EXPECTED_PATH,
+    with_corpus: Optional[bool] = None,
+) -> List[Finding]:
+    """Run the stage: model extraction + claim collection, the corpus
+    (clean schedules, determinism, mutation power), and the sched_model
+    pin comparison.  ``with_corpus`` defaults to True for the real repo
+    and False for copied trees (tests exercising extraction drift),
+    where the installed comm modules would not match the tree."""
+    findings: List[Finding] = []
+    model, model_findings = extract_model(repo_root)
+    findings.extend(model_findings)
+    claims, claim_findings = collect_claims(repo_root)
+    findings.extend(claim_findings)
+    if with_corpus is None:
+        with_corpus = os.path.abspath(repo_root) == os.path.abspath(
+            REPO_ROOT
+        )
+    if with_corpus:
+        corpus_findings, statuses = run_corpus(claims)
+        findings.extend(corpus_findings)
+    else:
+        statuses = {
+            key: {"kind": site.kind, "status": "unexercised"}
+            for key, site in claims.items()
+        }
+    observed = {"model": model, "claims": statuses}
+    pin_rel = os.path.relpath(expected_path, repo_root).replace(
+        os.sep, "/"
+    )
+    expected = {}
+    if os.path.exists(expected_path):
+        with open(expected_path, "r", encoding="utf-8") as fh:
+            expected = json.load(fh)
+    pinned = expected.get("sched_model")
+    if pinned is None:
+        findings.append(Finding(
+            PIN_RULE, pin_rel, 1,
+            "sched await-point model has no pin recorded; run "
+            "'python -m tools.graftlint --audit-write' to record it",
+        ))
+        return findings
+    pinned_observed = {
+        "model": pinned.get("model"), "claims": pinned.get("claims")
+    }
+    if pinned_observed != observed:
+        gone = {
+            k: pinned_observed[k]
+            for k in pinned_observed
+            if pinned_observed[k] != observed.get(k)
+        }
+        new = {
+            k: observed[k]
+            for k in observed
+            if pinned_observed.get(k) != observed[k]
+        }
+        findings.append(Finding(
+            PIN_RULE, pin_rel, 1,
+            "sched model drifted from its pin: expected "
+            "{} but observed {} — if the await-point or claim change "
+            "is intentional, acknowledge it with "
+            "'python -m tools.graftlint --audit-write'".format(
+                json.dumps(gone, sort_keys=True),
+                json.dumps(new, sort_keys=True),
+            ),
+        ))
+    return findings
+
+
+def write_pin(
+    repo_root: str = REPO_ROOT, expected_path: str = EXPECTED_PATH
+) -> List[Finding]:
+    """Record the observed await-point model + claim statuses as the
+    pin (the --audit-write path).  Corpus findings still fail: a pin
+    must never freeze a contradicted claim, a deadlocking schedule, or
+    lost mutation power."""
+    findings: List[Finding] = []
+    model, model_findings = extract_model(repo_root)
+    findings.extend(model_findings)
+    claims, claim_findings = collect_claims(repo_root)
+    findings.extend(claim_findings)
+    corpus_findings, statuses = run_corpus(claims)
+    findings.extend(corpus_findings)
+    if findings:
+        return findings
+    expected = {}
+    if os.path.exists(expected_path):
+        with open(expected_path, "r", encoding="utf-8") as fh:
+            expected = json.load(fh)
+    expected["sched_model"] = {
+        "kind": "sched-model",
+        "model": model,
+        "claims": statuses,
+        "verified": True,
+        "provenance": "await-point extraction from the SCHED_HOT comm "
+        "coroutines + corpus run (tools/graftlint/schedsim.py); every "
+        "schedule explored clean and every seeded race mutation was "
+        "still caught at pin time",
+    }
+    with open(expected_path, "w", encoding="utf-8") as fh:
+        json.dump(expected, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return []
+
+
+def claim_statuses(
+    expected_path: str = EXPECTED_PATH,
+) -> Dict[str, Dict[str, str]]:
+    """The pinned per-claim verification statuses (the --suppressions
+    status column reads these without running the corpus); {} when
+    unpinned."""
+    if not os.path.exists(expected_path):
+        return {}
+    with open(expected_path, "r", encoding="utf-8") as fh:
+        expected = json.load(fh)
+    return expected.get("sched_model", {}).get("claims", {}) or {}
+
+
+def main() -> int:
+    """Standalone report: scenarios, claims, mutations, pin."""
+    claims, claim_findings = collect_claims()
+    corpus = _corpus()
+    rc = 0
+    for scenario in corpus.SCENARIOS.values():
+        bad = 0
+        for seed in scenario.seeds:
+            result = execute(
+                scenario, SeededPolicy(seed), "seed={}".format(seed)
+            )
+            bad += len(_run_findings(result, claims))
+        status = "ok" if not bad else "FAIL"
+        rc = rc or (0 if not bad else 1)
+        print("{:24s} seeds={!s:12s} {}".format(
+            scenario.name, scenario.seeds, status
+        ))
+    for name, mutation in corpus.MUTATIONS.items():
+        found = _search_mutation(corpus, name, mutation, claims)
+        status = "caught (expected)" if found else "NOT CAUGHT"
+        rc = rc or (0 if found else 1)
+        print("{:24s} -> {:22s} {}".format(
+            name, mutation.expected_rule, status
+        ))
+        for finding in found[:1]:
+            print("  {}".format(finding.message))
+    all_findings = check()
+    for finding in all_findings:
+        print("{}:{}: [{}] {}".format(
+            finding.path, finding.line, finding.rule, finding.message
+        ))
+    rc = rc or (1 if all_findings else 0)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

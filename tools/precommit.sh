@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Pre-commit gate: the jax-free graftlint stages (AST rules, the
-# Python<->C++ wire-contract check when a contract file changed, and
-# the protocol role-model extraction + bounded model check) over
+# Python<->C++ wire-contract check when a contract file changed, the
+# protocol role-model extraction + bounded model check, and the
+# controlled-loop schedule exploration of the comm control plane) over
 # exactly the files modified vs. HEAD.  Deleted/renamed paths are
 # skipped with a notice; a clean tree exits 0 in a few seconds.
 #
@@ -11,12 +12,13 @@
 # Extra flags pass through, e.g.:
 #   bash tools/precommit.sh --sarif lint.sarif
 #
-# --proto is always on: the protocol stage imports no jax, finishes in
-# about a second, and its model-checker self-test (the re-seeded PR 8
-# bugs) must never rot silently between commits.  The jaxpr audit
-# (--audit) and the sanitizer replay (--native) are NOT run here — they
-# need jax / a toolchain and belong to tier-1 and CI, not the commit
-# hot path (docs/static_analysis.md §Stages).
+# --proto and --sched are always on: both stages import no jax, finish
+# in seconds, and their self-tests (the re-seeded PR 8 protocol bugs;
+# the seeded race mutations of the schedule explorer) must never rot
+# silently between commits.  The jaxpr audit (--audit) and the
+# sanitizer replay (--native) are NOT run here — they need jax / a
+# toolchain and belong to tier-1 and CI, not the commit hot path
+# (docs/static_analysis.md §Stages).
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
-exec python -m tools.graftlint --changed --proto "$@"
+exec python -m tools.graftlint --changed --proto --sched "$@"
