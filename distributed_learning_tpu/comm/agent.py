@@ -39,6 +39,7 @@ from distributed_learning_tpu.obs import (
     ObsDeltaSource,
     emit_flow,
     get_registry,
+    trace_keep,
 )
 
 __all__ = [
@@ -117,6 +118,7 @@ class ConsensusAgent:
         obs: Optional[MetricsRegistry] = None,
         trace: bool = False,
         trace_run_id: int = 0,
+        trace_sample: float = 1.0,
     ):
         if bf16_wire and int8_wire:
             raise ValueError("bf16_wire and int8_wire are mutually exclusive")
@@ -267,6 +269,14 @@ class ConsensusAgent:
         # (benchmarks/bench_async_gossip.py) measures exactly this flag.
         self.trace = bool(trace)
         self._trace_run_id = int(trace_run_id)
+        # Consistent flow sampling (docs/observability.md §Fleet-scale
+        # plane): keep/drop is a pure function of the frame's
+        # wire-carried (run_id, origin, seq) identity (spans.trace_keep),
+        # so every hop of a flow agrees without coordination and chains
+        # are never half-sampled.  1.0 (the default) short-circuits
+        # before hashing — bit-identical to unsampled tracing; dropped
+        # hops count as ``obs.sampled_out``, never vanish silently.
+        self.trace_sample = float(trace_sample)
         # One per-agent frame counter: (run_id, origin, seq) is then
         # fleet-unique without per-edge bookkeeping.
         self._trace_seq = 0
@@ -308,7 +318,19 @@ class ConsensusAgent:
                    **fields) -> None:
         """One frame-lifecycle hop into the default registry (and the
         per-agent ``obs=`` registry) — the same dual-mirror discipline
-        as :meth:`_count`."""
+        as :meth:`_count`.
+
+        Sampling gate: ``trace_sample < 1.0`` keeps or drops the WHOLE
+        flow by its wire identity (every hop of a frame — here and at
+        the peer — computes the same decision from the same trailer),
+        bounding trace volume at fleet scale; suppressed hops count as
+        ``obs.sampled_out``."""
+        if not trace_keep(tc.run_id, tc.origin, tc.seq,
+                          self.trace_sample):
+            get_registry().inc("obs.sampled_out")
+            if self._obs is not None and self._obs is not get_registry():
+                self._obs.inc("obs.sampled_out")
+            return
         emit_flow(
             get_registry(), phase, origin=tc.origin, seq=tc.seq,
             run_id=tc.run_id, edge=edge, **fields,

@@ -36,6 +36,7 @@ from distributed_learning_tpu.comm.tensor_codec import (
 # the versioned layout, exactly like a message's binary fields.
 from distributed_learning_tpu.obs.aggregate import (  # noqa: F401
     OBS_PAYLOAD_KIND,
+    OBS_PAYLOAD_SECTIONS,
     OBS_PAYLOAD_VERSION,
     is_obs_payload,
 )
@@ -65,6 +66,7 @@ __all__ = [
     "pack_message",
     "unpack_message",
     "OBS_PAYLOAD_KIND",
+    "OBS_PAYLOAD_SECTIONS",
     "OBS_PAYLOAD_VERSION",
     "is_obs_payload",
 ]

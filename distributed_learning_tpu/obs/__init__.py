@@ -63,12 +63,20 @@ from distributed_learning_tpu.obs.registry import (
 )
 from distributed_learning_tpu.obs.aggregate import (
     OBS_PAYLOAD_KIND,
+    OBS_PAYLOAD_SECTIONS,
     OBS_PAYLOAD_VERSION,
+    SKETCH_SERIES,
     ObsDeltaSource,
     RunAggregator,
+    SubAggregator,
     edge_profile_from_registry,
     is_obs_payload,
     straggler_profile_from_registry,
+)
+from distributed_learning_tpu.obs.sketch import (
+    DEFAULT_ALPHA,
+    LabelRollup,
+    QuantileSketch,
 )
 from distributed_learning_tpu.obs.flight import FlightRecorder
 from distributed_learning_tpu.obs.health import (
@@ -88,6 +96,7 @@ from distributed_learning_tpu.obs.spans import (
     get_tracer,
     set_tracer,
     span,
+    trace_keep,
 )
 
 __all__ = [
@@ -121,9 +130,15 @@ __all__ = [
     "format_run_report",
     "obs_report_main",
     "OBS_PAYLOAD_KIND",
+    "OBS_PAYLOAD_SECTIONS",
     "OBS_PAYLOAD_VERSION",
+    "SKETCH_SERIES",
+    "DEFAULT_ALPHA",
+    "QuantileSketch",
+    "LabelRollup",
     "ObsDeltaSource",
     "RunAggregator",
+    "SubAggregator",
     "FlightRecorder",
     "is_obs_payload",
     "straggler_profile_from_registry",
@@ -132,6 +147,7 @@ __all__ = [
     "FLOW_PHASES",
     "emit_flow",
     "flow_key",
+    "trace_keep",
     "HealthBreach",
     "HealthRule",
     "HealthSentinel",

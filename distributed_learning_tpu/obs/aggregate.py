@@ -45,15 +45,23 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from distributed_learning_tpu.obs.flight import FlightRecorder
 from distributed_learning_tpu.obs.registry import MetricsRegistry
+from distributed_learning_tpu.obs.sketch import (
+    DEFAULT_ALPHA,
+    LabelRollup,
+    QuantileSketch,
+)
 from distributed_learning_tpu.obs.spans import FLOW_EVENT, FLOW_PHASES
 from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
 
 __all__ = [
     "OBS_PAYLOAD_KIND",
     "OBS_PAYLOAD_VERSION",
+    "OBS_PAYLOAD_SECTIONS",
+    "SKETCH_SERIES",
     "is_obs_payload",
     "ObsDeltaSource",
     "RunAggregator",
+    "SubAggregator",
     "straggler_profile_from_registry",
     "edge_profile_from_registry",
 ]
@@ -64,7 +72,41 @@ OBS_PAYLOAD_KIND = "obs.delta"
 #: Schema version inside the payload (``payload["v"]``).  Bump on
 #: incompatible layout changes; the aggregator records-but-skips
 #: payloads from the future instead of crashing a running master.
-OBS_PAYLOAD_VERSION = 1
+#: v2 (fleet-scale plane): adds the ``sketches``/``rollups`` sections
+#: and the ``agg`` sub-aggregator flag; v1 payloads still merge (the
+#: new sections are simply absent, and the aggregator derives sketches
+#: from the raw series they carry).
+OBS_PAYLOAD_VERSION = 2
+
+#: The payload's section keys, in wire order — part of the declared
+#: wire surface (re-exported by ``comm/protocol.py``, cross-checked and
+#: pinned by graftlint's wire-contract stage): adding/renaming a
+#: section is a schema change and must ride a version bump through
+#: ``--audit-write``.
+OBS_PAYLOAD_SECTIONS = ("counters", "gauges", "events", "sketches", "rollups")
+
+#: Series (by name, or ``name/<label>``) summarized as mergeable
+#: quantile sketches in v2 deltas — the straggler/edge/latency paths
+#: whose percentiles the profiles render.  Everything else (loss
+#: curves, residual trends) keeps raw points: order matters there.
+SKETCH_SERIES = (
+    "straggler.lag_s",
+    "straggler.skew_s",
+    "comm.agent.round_s",
+    "comm.agent.async_round_s",
+    "comm.agent.staleness",
+    "comm.edge.latency_s",
+    "comm.edge.staleness",
+    "comm.master.round_s",
+)
+
+
+def _sketched(name: str) -> bool:
+    """Whether series ``name`` belongs to a sketched metric family."""
+    for base in SKETCH_SERIES:
+        if name == base or name.startswith(base + "/"):
+            return True
+    return False
 
 #: Round-latency histogram bucket upper bounds (seconds; last is +inf).
 LATENCY_BUCKETS_S = (
@@ -93,10 +135,22 @@ class ObsDeltaSource:
     the buffered event stream (a sink registered on the registry, so
     packing is O(new events), never a rescan).  ``seq`` increments per
     pack; gaps tell the aggregator how many deltas a flaky wire lost.
+
+    v2 (fleet-scale plane): points of the :data:`SKETCH_SERIES`
+    families additionally fold into per-pack
+    :class:`~distributed_learning_tpu.obs.sketch.QuantileSketch` deltas
+    (``payload["sketches"]``, drained each pack — the aggregator merges
+    them by pure addition, so seq dedup/gap accounting carries over
+    unchanged).  ``raw_series=False`` is the fleet mode: sketched
+    series stop travelling as raw points entirely, making the delta's
+    byte size O(metrics) instead of O(samples); the substitution is
+    disclosed per pack (``series_sketched``), never silent.
     """
 
     def __init__(self, registry: MetricsRegistry, *,
-                 max_buffer: int = 4096, backfill: bool = True):
+                 max_buffer: int = 4096, backfill: bool = True,
+                 sketch: bool = True, sketch_alpha: float = DEFAULT_ALPHA,
+                 raw_series: bool = True):
         self._registry = registry
         self._lock = threading.Lock()
         self._buffer: collections.deque = collections.deque(
@@ -105,28 +159,57 @@ class ObsDeltaSource:
         self._dropped = 0
         self._seq = 0
         self._closed = False
+        self._sketch = bool(sketch)
+        self._sketch_alpha = float(sketch_alpha)
+        self._raw_series = bool(raw_series)
+        self._pending_sketches: Dict[str, QuantileSketch] = {}
+        self._suppressed = 0
         if backfill:
             # A late-attached source still ships the registry's retained
             # history in its first delta (events recorded before the
             # sink existed would otherwise be invisible to the run).
-            self._buffer.extend(
-                dict(ev) for ev in registry.recent_events()
-            )
+            for ev in registry.recent_events():
+                self._ingest(dict(ev))
         registry.add_sink(self._sink)
 
     def _sink(self, event: Mapping[str, Any]) -> None:
+        self._ingest(dict(event))
+
+    def _ingest(self, event: dict) -> None:
         with self._lock:
+            if (event.get("kind") == "series"
+                    and _sketched(event.get("name", ""))):
+                if self._sketch:
+                    name = event["name"]
+                    sk = self._pending_sketches.get(name)
+                    if sk is None:
+                        sk = self._pending_sketches[name] = QuantileSketch(
+                            self._sketch_alpha
+                        )
+                    sk.add(float(event.get("value", 0.0)))
+                if not self._raw_series:
+                    # Fleet mode: the sketch IS the wire form of this
+                    # point; count the substitution so it is visible.
+                    self._suppressed += 1
+                    return
             if (self._buffer.maxlen is not None
                     and len(self._buffer) >= self._buffer.maxlen):
                 self._dropped += 1
-            self._buffer.append(dict(event))
+            self._buffer.append(event)
 
     def pack(self) -> dict:
-        """One delta payload; drains the event buffer."""
+        """One delta payload; drains the event buffer and the pending
+        sketch deltas."""
         with self._lock:
             events = list(self._buffer)
             self._buffer.clear()
             dropped, self._dropped = self._dropped, 0
+            suppressed, self._suppressed = self._suppressed, 0
+            sketches = {
+                name: sk.to_dict()
+                for name, sk in sorted(self._pending_sketches.items())
+            }
+            self._pending_sketches.clear()
             self._seq += 1
             seq = self._seq
         snap = self._registry.snapshot()
@@ -139,8 +222,12 @@ class ObsDeltaSource:
             "gauges": snap["gauges"],
             "events": events,
         }
+        if sketches:
+            payload["sketches"] = sketches
         if dropped:
             payload["events_dropped"] = dropped
+        if suppressed:
+            payload["series_sketched"] = suppressed
         return payload
 
     def close(self) -> None:
@@ -192,6 +279,14 @@ class RunAggregator(TelemetryProcessor):
         self._lock = threading.Lock()
         self._max_spans = int(max_spans_per_agent)
         self._views: Dict[str, _AgentView] = {}
+        #: Merged quantile sketches, keyed like the merged series
+        #: (``name/<token>`` per agent + the bare run-wide ``name``).
+        #: Constant-size per metric and eviction-immune — the profile
+        #: paths read quantiles from here, the raw rings stay as the
+        #: small-run exact oracle.
+        self.sketches: Dict[str, QuantileSketch] = {}
+        #: Merged bounded label rollups (sub-aggregator exports).
+        self.rollups: Dict[str, LabelRollup] = {}
 
     # ------------------------------------------------------------------ #
     def agents(self) -> List[str]:
@@ -219,6 +314,12 @@ class RunAggregator(TelemetryProcessor):
             # fatal — the rest of the plane keeps running.
             self.registry.inc("obs.unknown_version")
             return
+        # Sub-aggregator export (payload["agg"], SubAggregator): names
+        # already carry their per-agent labels from the sub's merge, so
+        # everything lands as-is — no relabel, no run-wide duplication.
+        # That pass-through is exactly what makes aggregate-of-
+        # aggregates equal the flat merge.
+        agg = bool(payload.get("agg"))
         view = self._view(token)
         seq = int(payload.get("seq", view.last_seq + 1))
         if seq <= view.last_seq:
@@ -229,16 +330,36 @@ class RunAggregator(TelemetryProcessor):
         view.last_seq = seq
         view.last_wall = payload.get("wall")
 
-        self._merge_counters(token, view, payload.get("counters") or {})
+        self._merge_counters(
+            token, view, payload.get("counters") or {}, relabel=not agg
+        )
         for name, value in (payload.get("gauges") or {}).items():
-            self.registry.gauge(f"{name}/{token}", float(value))
+            if not agg:
+                self.registry.gauge(f"{name}/{token}", float(value))
             self.registry.gauge(name, float(value))
+        for name, d in sorted((payload.get("sketches") or {}).items()):
+            self._merge_sketch_dict(token, name, d, relabel=not agg)
+        for name, d in sorted((payload.get("rollups") or {}).items()):
+            self._merge_rollup_dict(name, d)
+        # A payload that carries sketch sections is the authority on its
+        # sketched series; one that does not (v1 producers, offline
+        # merge_registry replays, sketch-less sources) gets them derived
+        # from its raw points here — either way the sketch state covers
+        # every point exactly once.
+        sketch_series = (not agg) and ("sketches" not in payload)
         for ev in payload.get("events") or ():
-            self._merge_event(token, view, ev)
+            self._merge_event(
+                token, view, ev,
+                relabel=not agg, sketch_series=sketch_series,
+            )
         if payload.get("events_dropped"):
             self.registry.inc(
                 f"obs.delta_events_dropped/{token}",
                 payload["events_dropped"],
+            )
+        if payload.get("series_sketched"):
+            self.registry.inc(
+                "obs.series_sketched", payload["series_sketched"]
             )
         # Self-contained stream marker: carries this agent's absolute
         # counter totals, so a JsonlSink'd aggregate file replays into
@@ -250,7 +371,8 @@ class RunAggregator(TelemetryProcessor):
         self.registry.inc("obs.deltas_merged")
 
     def _merge_counters(self, token: str, view: _AgentView,
-                        counters: Mapping[str, Any]) -> None:
+                        counters: Mapping[str, Any], *,
+                        relabel: bool = True) -> None:
         for name, total in counters.items():
             total = float(total)
             prev = view.counters.get(name, 0.0)
@@ -261,24 +383,99 @@ class RunAggregator(TelemetryProcessor):
                 self.registry.inc("obs.counter_resets")
                 diff = total
             if diff:
-                self.registry.inc(f"{name}/{token}", diff)
+                if relabel:
+                    self.registry.inc(f"{name}/{token}", diff)
                 self.registry.inc(name, diff)
             view.counters[name] = total
 
+    # ------------------------------------------------------------------ #
+    # Sketch / rollup state.  These two hooks are the ONE write path    #
+    # into the merged sketch maps — SubAggregator overrides them to    #
+    # also accumulate its pending upstream delta.                       #
+    # ------------------------------------------------------------------ #
+    def _sketch_point(self, key: str, value: float) -> None:
+        with self._lock:
+            sk = self.sketches.get(key)
+            if sk is None:
+                sk = self.sketches[key] = QuantileSketch()
+            sk.add(value)
+
+    def sketch(self, key: str) -> Optional[QuantileSketch]:
+        """A copy of the merged sketch under ``key`` (``name/<token>``
+        or the bare run-wide ``name``), or None."""
+        with self._lock:
+            sk = self.sketches.get(key)
+            return None if sk is None else sk.copy()
+
+    def _sketch_merge(self, key: str, sk: QuantileSketch) -> None:
+        mismatch = False
+        with self._lock:
+            cur = self.sketches.get(key)
+            if cur is None:
+                self.sketches[key] = sk.copy()
+            else:
+                try:
+                    cur.merge(sk)
+                except ValueError:
+                    # Geometry mismatch (foreign α): visible, not fatal.
+                    mismatch = True
+        if mismatch:
+            self.registry.inc("obs.sketch_errors")
+
+    def _rollup_merge(self, name: str, ru: LabelRollup) -> None:
+        with self._lock:
+            cur = self.rollups.get(name)
+            if cur is None:
+                self.rollups[name] = ru.copy()
+            else:
+                cur.merge(ru)
+
+    def rollup(self, name: str) -> Optional[LabelRollup]:
+        """A copy of the merged label rollup for counter family
+        ``name``, or None."""
+        with self._lock:
+            ru = self.rollups.get(name)
+            return None if ru is None else ru.copy()
+
+    def _merge_sketch_dict(self, token: str, name: str, d: Any, *,
+                           relabel: bool) -> None:
+        try:
+            sk = QuantileSketch.from_dict(d)
+        except (TypeError, ValueError, AttributeError):
+            self.registry.inc("obs.sketch_errors")
+            return
+        if relabel:
+            self._sketch_merge(f"{name}/{token}", sk)
+        self._sketch_merge(name, sk)
+
+    def _merge_rollup_dict(self, name: str, d: Any) -> None:
+        try:
+            ru = LabelRollup.from_dict(d)
+        except (TypeError, ValueError, AttributeError):
+            self.registry.inc("obs.sketch_errors")
+            return
+        self._rollup_merge(name, ru)
+
     def _merge_event(self, token: str, view: _AgentView,
-                     ev: Mapping[str, Any]) -> None:
+                     ev: Mapping[str, Any], *, relabel: bool = True,
+                     sketch_series: bool = False) -> None:
         kind = ev.get("kind")
         name = ev.get("name", "")
+        flight_token = token
         if kind == "series":
+            value = float(ev.get("value", 0.0))
             self.registry.observe(
-                f"{name}/{token}", float(ev.get("value", 0.0)),
+                f"{name}/{token}" if relabel else name, value,
                 step=ev.get("step"),
             )
+            if sketch_series and _sketched(name):
+                self._sketch_point(f"{name}/{token}", value)
+                self._sketch_point(name, value)
         elif kind == "span":
             dur = float(ev.get("value", 0.0))
             t0 = ev.get("t0")
             self.registry.record_span(
-                f"{name}/{token}", dur,
+                f"{name}/{token}" if relabel else name, dur,
                 depth=int(ev.get("depth", 0)), t0=t0,
             )
             if t0 is not None:
@@ -290,14 +487,36 @@ class RunAggregator(TelemetryProcessor):
                 k: v for k, v in ev.items()
                 if k not in ("kind", "name", "ts")
             }
-            self.registry.event(name, token=token,
-                                agent_ts=ev.get("ts"), **fields)
+            if relabel:
+                # A replayed *aggregated* dump (``obs-report --merge``
+                # over pod registries) already carries the original
+                # agent attribution in the fields — keep it rather
+                # than relabeling every event with the pod's token.
+                inner = fields.pop("token", None)
+                inner_ts = fields.pop("agent_ts", None)
+                if inner is not None:
+                    flight_token = str(inner)
+                self.registry.event(
+                    name, token=flight_token,
+                    agent_ts=(inner_ts if inner_ts is not None
+                              else ev.get("ts")),
+                    **fields)
+            else:
+                # Sub-aggregator pass-through: token/agent_ts already
+                # ride inside the fields from the sub's own merge.
+                self.registry.event(name, **fields)
+                flight_token = str(fields.get("token", token))
             if name == FLOW_EVENT:
                 # Frame-lifecycle hop: keep it (with the emitting
                 # agent's wall stamp) for the merged trace's arrows.
                 flow = dict(fields)
-                flow["agent"] = token
-                flow["ts"] = ev.get("ts")
+                if relabel:
+                    flow["agent"] = flight_token
+                    flow["ts"] = (inner_ts if inner_ts is not None
+                                  else ev.get("ts"))
+                else:
+                    flow.setdefault("agent", flight_token)
+                    flow["ts"] = fields.get("agent_ts", ev.get("ts"))
                 view.flows.append(flow)
         elif kind in ("counter", "gauge"):
             # Snapshot lines from a replayed dump file: totals already
@@ -305,7 +524,7 @@ class RunAggregator(TelemetryProcessor):
             # offline merge would double-count.
             return
         if self.flight is not None:
-            self.flight.record(token, ev)
+            self.flight.record(flight_token, ev)
 
     # ------------------------------------------------------------------ #
     def merge_registry(self, token: str,
@@ -342,9 +561,11 @@ class RunAggregator(TelemetryProcessor):
             self.registry.observe(
                 f"straggler.lag_s/{token}", t - t_first, step=round_id
             )
+            self._sketch_point(f"straggler.lag_s/{token}", t - t_first)
         self.registry.observe(
             "straggler.skew_s", t_last - t_first, step=round_id
         )
+        self._sketch_point("straggler.skew_s", t_last - t_first)
         slowest = max(arrivals, key=lambda t: arrivals[t])
         self.registry.inc(f"straggler.slowest/{slowest}")
         if self.flight is not None:
@@ -361,6 +582,7 @@ class RunAggregator(TelemetryProcessor):
         self.registry.observe(
             "comm.master.round_s", float(dur_s), step=round_id
         )
+        self._sketch_point("comm.master.round_s", float(dur_s))
         self.registry.record_span(
             "comm.master.round", float(dur_s), t0=wall_t0
         )
@@ -370,13 +592,23 @@ class RunAggregator(TelemetryProcessor):
             )
 
     # ------------------------------------------------------------------ #
+    def _sketch_snapshot(self) -> Dict[str, QuantileSketch]:
+        with self._lock:
+            return {k: sk.copy() for k, sk in self.sketches.items()}
+
     def straggler_profile(self) -> dict:
-        """See :func:`straggler_profile_from_registry`."""
-        return straggler_profile_from_registry(self.registry)
+        """See :func:`straggler_profile_from_registry` (the aggregator
+        hands over its merged sketches, so quantiles stay
+        constant-memory and eviction-immune at fleet scale)."""
+        return straggler_profile_from_registry(
+            self.registry, sketches=self._sketch_snapshot()
+        )
 
     def edge_profile(self) -> dict:
         """See :func:`edge_profile_from_registry`."""
-        return edge_profile_from_registry(self.registry)
+        return edge_profile_from_registry(
+            self.registry, sketches=self._sketch_snapshot()
+        )
 
     # ------------------------------------------------------------------ #
     def to_chrome_trace(self) -> dict:
@@ -498,6 +730,170 @@ class RunAggregator(TelemetryProcessor):
 
 
 # ---------------------------------------------------------------------- #
+# Hierarchical tier: the per-pod sub-aggregator                          #
+# ---------------------------------------------------------------------- #
+class SubAggregator(RunAggregator):
+    """A mid-tier aggregator that re-exports its merged state upstream.
+
+    A per-pod sub-master merges its own agents' ``obs.delta`` payloads
+    exactly like :class:`RunAggregator` (same dedup, same labels, same
+    local profiles), and periodically :meth:`export_delta`\\ s ONE
+    bounded payload for a root aggregator — the aggregate-of-aggregates
+    shape the sharded-master control plane needs.  The export is itself
+    a v2 ``obs.delta``:
+
+    * ``agg: True`` tells the root to merge it as-is (names already
+      carry their ``/token`` labels from this tier's merge — no
+      relabel, no run-wide duplication), which is what makes the
+      two-tier merge equal the flat one;
+    * counters/gauges travel as absolute totals (idempotent at the
+      root, same as an agent delta); ``obs.*`` plane bookkeeping is
+      filtered — each tier keeps its own merge-health counters;
+    * sketch state travels as per-export DELTAS mirrored at merge time
+      (:meth:`_sketch_point` / :meth:`_sketch_merge` overrides), so the
+      root's merge is pure addition and seq gap/dedup accounting
+      carries over unchanged;
+    * ``forward_raw_series=False`` is the fleet mode: sketched-series
+      points stop riding the event stream upstream (the sketch IS
+      their wire form), making export bytes O(metrics);
+    * ``rollup_labels=N`` additionally folds per-label counter deltas
+      (``name/<label>``, label cardinality unbounded under churn) into
+      bounded :class:`LabelRollup` sections, keeping only the bare
+      run-wide counters exact.  Edge-shaped labels (``src->dst``) stay
+      exact — the per-edge observatory depends on them.
+    """
+
+    def __init__(self, *, registry: Optional[MetricsRegistry] = None,
+                 flight: Optional[FlightRecorder] = None,
+                 max_spans_per_agent: int = 4096,
+                 forward_raw_series: bool = True,
+                 rollup_labels: int = 0):
+        super().__init__(
+            registry=registry, flight=flight,
+            max_spans_per_agent=max_spans_per_agent,
+        )
+        #: Sketch/rollup growth since the last export (drained by
+        #: :meth:`export_delta`; the merged totals stay in
+        #: ``self.sketches`` for this tier's own profiles).
+        self._pending_sketches: Dict[str, QuantileSketch] = {}
+        self._pending_rollups: Dict[str, LabelRollup] = {}
+        self._rollup_labels = int(rollup_labels)
+        #: Last-export absolute totals of the labeled counters folded
+        #: into rollups (delta accounting with reset handling, same
+        #: contract as the root's per-view counter diff).
+        self._rollup_base: Dict[str, float] = {}
+        # sketch=False: this tier's merge hooks own the sketch state
+        # (below); the source still buffers events and, in fleet mode,
+        # suppresses raw sketched-series points.
+        self._source = ObsDeltaSource(
+            self.registry, sketch=False, backfill=True,
+            raw_series=bool(forward_raw_series),
+        )
+
+    # The ONE write path into the sketch maps, mirrored into the
+    # pending upstream delta.
+    def _sketch_point(self, key: str, value: float) -> None:
+        super()._sketch_point(key, value)
+        with self._lock:
+            sk = self._pending_sketches.get(key)
+            if sk is None:
+                sk = self._pending_sketches[key] = QuantileSketch()
+            sk.add(value)
+
+    def _sketch_merge(self, key: str, sk: QuantileSketch) -> None:
+        super()._sketch_merge(key, sk)
+        with self._lock:
+            cur = self._pending_sketches.get(key)
+            if cur is None:
+                self._pending_sketches[key] = sk.copy()
+            else:
+                try:
+                    cur.merge(sk)
+                except ValueError:
+                    pass  # geometry mismatch already counted by super
+
+    def _rollup_merge(self, name: str, ru: LabelRollup) -> None:
+        super()._rollup_merge(name, ru)
+        with self._lock:
+            cur = self._pending_rollups.get(name)
+            if cur is None:
+                self._pending_rollups[name] = ru.copy()
+            else:
+                cur.merge(ru)
+
+    # ------------------------------------------------------------------ #
+    def export_delta(self) -> dict:
+        """One upstream ``obs.delta`` for the root aggregator: the
+        registry's growth since the last export plus the pending
+        sketch/rollup deltas, marked ``agg: True``."""
+        payload = self._source.pack()
+        payload["agg"] = True
+        with self._lock:
+            sketches = {
+                name: sk.to_dict()
+                for name, sk in sorted(self._pending_sketches.items())
+            }
+            self._pending_sketches.clear()
+            rollups = dict(self._pending_rollups)
+            self._pending_rollups.clear()
+        counters = {
+            name: total for name, total in payload["counters"].items()
+            if not name.startswith("obs.")
+        }
+        if self._rollup_labels > 0:
+            counters = self._fold_label_counters(counters, rollups)
+        payload["counters"] = counters
+        payload["gauges"] = {
+            name: v for name, v in payload["gauges"].items()
+            if not name.startswith("obs.")
+        }
+        # This tier's own stream markers are per-tier bookkeeping; the
+        # root stamps its own when it merges this export.
+        payload["events"] = [
+            ev for ev in payload["events"]
+            if not (ev.get("kind") == "event"
+                    and ev.get("name") == "obs.delta")
+        ]
+        if sketches:
+            payload["sketches"] = sketches
+        if rollups:
+            payload["rollups"] = {
+                name: ru.to_dict() for name, ru in sorted(rollups.items())
+            }
+        return payload
+
+    def _fold_label_counters(
+            self, counters: Dict[str, Any],
+            rollups: Dict[str, LabelRollup]) -> Dict[str, Any]:
+        kept: Dict[str, Any] = {}
+        with self._lock:
+            for name, total in counters.items():
+                base, slash, label = name.partition("/")
+                if not slash or "->" in label:
+                    kept[name] = total
+                    continue
+                total = float(total)
+                prev = self._rollup_base.get(name, 0.0)
+                diff = total - prev
+                if diff < 0:
+                    # Restarted source (elastic rejoin): new life
+                    # counts from zero, same as the root's view diff.
+                    diff = total
+                self._rollup_base[name] = total
+                if diff:
+                    ru = rollups.get(base)
+                    if ru is None:
+                        ru = rollups[base] = LabelRollup(
+                            self._rollup_labels
+                        )
+                    ru.add(label, diff)
+        return kept
+
+    def close(self) -> None:
+        self._source.close()
+
+
+# ---------------------------------------------------------------------- #
 # Straggler profile                                                      #
 # ---------------------------------------------------------------------- #
 def _pct(sorted_vals: List[float], q: float) -> float:
@@ -530,9 +926,24 @@ def _series_by_token(registry: MetricsRegistry,
     return out
 
 
+def _sketches_by_token(
+        sketches: Mapping[str, QuantileSketch],
+        prefix: str) -> Dict[str, QuantileSketch]:
+    """Non-empty sketches keyed ``<prefix><token>`` (no further label
+    dimension), by token."""
+    out = {}
+    for name, sk in sketches.items():
+        if name.startswith(prefix) and sk.n:
+            token = name[len(prefix):]
+            if "/" not in token:
+                out[token] = sk
+    return out
+
+
 def straggler_profile_from_registry(
         registry: MetricsRegistry, *,
-        counters: Optional[Mapping[str, float]] = None) -> dict:
+        counters: Optional[Mapping[str, float]] = None,
+        sketches: Optional[Mapping[str, QuantileSketch]] = None) -> dict:
     """Who is slow, how slow, and how often — from a merged run
     registry.
 
@@ -548,18 +959,37 @@ def straggler_profile_from_registry(
     registry's own totals for callers that reconstructed them from a
     replayed stream (``obs-monitor``, where counter totals travel as
     delta markers, not events).
+
+    ``sketches`` (the aggregator's merged quantile sketches) switch the
+    per-agent latency/staleness statistics to the sketch path: counts
+    and percentiles come from the eviction-immune
+    :class:`~distributed_learning_tpu.obs.sketch.QuantileSketch` state
+    (``max`` stays exact — the sketch tracks it), and every entry says
+    which path produced it (``"quantiles": "sketch" | "exact"``).
+    Without sketches the exact nearest-rank path over the raw rings is
+    used — the small-run oracle — and each entry carries the ring's
+    ``evicted`` point count so a truncated percentile is never
+    presented as a complete one.
     """
     if counters is None:
         counters = registry.counters
-    lag = _series_by_token(registry, "straggler.lag_s/")
+    sketches = sketches or {}
+    dropped = registry.points_dropped
+    lag_prefix = "straggler.lag_s/"
+    lag = _series_by_token(registry, lag_prefix)
+    lag_sk = _sketches_by_token(sketches, lag_prefix)
     source = "master-arrival-lag"
-    if not lag:
-        lag = _series_by_token(registry, "comm.agent.round_s/")
+    if not lag and not lag_sk:
+        lag_prefix = "comm.agent.round_s/"
+        lag = _series_by_token(registry, lag_prefix)
+        lag_sk = _sketches_by_token(sketches, lag_prefix)
         source = "agent-round-wall"
-    if not lag:
+    if not lag and not lag_sk:
         # Pure async runs have no master-gated rounds at all: fall back
         # to the async runtime's per-round wall times.
-        lag = _series_by_token(registry, "comm.agent.async_round_s/")
+        lag_prefix = "comm.agent.async_round_s/"
+        lag = _series_by_token(registry, lag_prefix)
+        lag_sk = _sketches_by_token(sketches, lag_prefix)
         source = "agent-async-round-wall"
     # Per-round grouping for attribution (step == round id).
     rounds: Dict[Any, List[Tuple[str, float]]] = {}
@@ -587,17 +1017,35 @@ def straggler_profile_from_registry(
     # residual trends, so the trade-off τ buys is readable from one
     # merged JSONL.
     staleness = _series_by_token(registry, "comm.agent.staleness/")
+    stale_sk = _sketches_by_token(sketches, "comm.agent.staleness/")
     residual = _series_by_token(registry, "consensus.residual/")
 
     per_agent = {}
-    for token in sorted(set(lag) | set(staleness) | set(residual)):
-        vals = sorted(v for _, v in lag.get(token, ()))
-        entry = {
-            "count": len(vals),
-            "p50_s": _pct(vals, 0.50),
-            "p95_s": _pct(vals, 0.95),
-            "max_s": vals[-1] if vals else 0.0,
-            "hist": _hist(vals),
+    tokens = (set(lag) | set(lag_sk) | set(staleness) | set(stale_sk)
+              | set(residual))
+    for token in sorted(tokens):
+        sk = lag_sk.get(token)
+        if sk is not None:
+            entry = {
+                "count": sk.n,
+                "p50_s": sk.quantile(0.50),
+                "p95_s": sk.quantile(0.95),
+                "max_s": sk.max,
+                "hist": sk.histogram(LATENCY_BUCKETS_S),
+                "quantiles": "sketch",
+            }
+        else:
+            vals = sorted(v for _, v in lag.get(token, ()))
+            entry = {
+                "count": len(vals),
+                "p50_s": _pct(vals, 0.50),
+                "p95_s": _pct(vals, 0.95),
+                "max_s": vals[-1] if vals else 0.0,
+                "hist": _hist(vals),
+                "quantiles": "exact",
+            }
+        entry["evicted"] = int(dropped.get(lag_prefix + token, 0))
+        entry.update({
             "slowest_rounds": slowest_counts.get(token, 0),
             "stale_dropped": counters.get(
                 f"comm.agent.stale_requests_dropped/{token}", 0
@@ -605,9 +1053,16 @@ def straggler_profile_from_registry(
             "deferred": counters.get(
                 f"comm.agent.requests_deferred/{token}", 0
             ),
-        }
+        })
+        ssk = stale_sk.get(token)
         spts = [v for _, v in staleness.get(token, ())]
-        if spts:
+        if ssk is not None:
+            entry["staleness"] = {
+                "n": ssk.n,
+                "mean": ssk.mean,
+                "max": ssk.max,
+            }
+        elif spts:
             buckets: Dict[int, int] = {}
             for v in spts:
                 buckets[int(v)] = buckets.get(int(v), 0) + 1
@@ -617,6 +1072,7 @@ def straggler_profile_from_registry(
                 "max": max(spts),
                 "hist": sorted(buckets.items()),
             }
+        if ssk is not None or spts:
             entry["stale_mixed"] = counters.get(
                 f"comm.agent.async_stale_mixed/{token}", 0
             )
@@ -628,25 +1084,39 @@ def straggler_profile_from_registry(
             entry["residual_first"] = rpts[0]
             entry["residual_last"] = rpts[-1]
         per_agent[token] = entry
-    skew_pts = sorted(
-        v for _, v in registry.series.get("straggler.skew_s", ())
-    )
-    skew = {
-        "p50_s": _pct(skew_pts, 0.50),
-        "p95_s": _pct(skew_pts, 0.95),
-        "max_s": skew_pts[-1] if skew_pts else 0.0,
-    }
+    skew_sk = sketches.get("straggler.skew_s")
+    if skew_sk is not None and skew_sk.n:
+        skew = {
+            "p50_s": skew_sk.quantile(0.50),
+            "p95_s": skew_sk.quantile(0.95),
+            "max_s": skew_sk.max,
+            "quantiles": "sketch",
+        }
+    else:
+        skew_pts = sorted(
+            v for _, v in registry.series.get("straggler.skew_s", ())
+        )
+        skew = {
+            "p50_s": _pct(skew_pts, 0.50),
+            "p95_s": _pct(skew_pts, 0.95),
+            "max_s": skew_pts[-1] if skew_pts else 0.0,
+            "quantiles": "exact",
+        }
     slowest_agent = (
         max(slowest_counts, key=lambda t: slowest_counts[t])
         if slowest_counts else None
     )
-    return {
+    profile = {
         "source": source,
         "rounds": len(rounds),
+        "quantiles": "sketch" if lag_sk else "exact",
         "per_agent": per_agent,
         "skew": skew,
         "slowest_agent": slowest_agent,
     }
+    if lag_sk:
+        profile["alpha"] = next(iter(lag_sk.values())).alpha
+    return profile
 
 
 # ---------------------------------------------------------------------- #
@@ -677,7 +1147,8 @@ def _bare_edge(name: str, prefix: str) -> Optional[str]:
 
 def edge_profile_from_registry(
         registry: MetricsRegistry, *,
-        counters: Optional[Mapping[str, float]] = None) -> dict:
+        counters: Optional[Mapping[str, float]] = None,
+        sketches: Optional[Mapping[str, QuantileSketch]] = None) -> dict:
     """The per-edge wire observatory: which directed link moved how
     many bytes/frames, how slowly, and how unreliably — from a merged
     run registry.
@@ -689,7 +1160,10 @@ def edge_profile_from_registry(
     send stamp, so it needs tracing on); per-edge mix staleness from
     ``comm.edge.staleness/<edge>``; injected-fault attribution from the
     ``comm.faults.<kind>/<edge>`` counters.  ``counters`` overrides the
-    registry totals for replayed streams, exactly like
+    registry totals for replayed streams, and ``sketches`` switches the
+    latency/staleness statistics to the merged-sketch path (marked per
+    edge as ``"quantiles": "sketch" | "exact"``, with ring ``evicted``
+    counts disclosed on the exact path), exactly like
     :func:`straggler_profile_from_registry`.  This is the measured
     per-link cost picture topology/schedule choices key off
     (arxiv.org/pdf/2002.01119 §3; the two-tier link split of
@@ -697,6 +1171,8 @@ def edge_profile_from_registry(
     """
     if counters is None:
         counters = registry.counters
+    sketches = sketches or {}
+    dropped = registry.points_dropped
     edges: Dict[str, dict] = {}
 
     def entry(edge: str) -> dict:
@@ -729,20 +1205,56 @@ def edge_profile_from_registry(
                 edge = name[len(prefix):].split("/", 1)[0]
                 if "->" in edge:
                     dest.setdefault(edge, []).extend(v for _, v in pts)
-    for edge, vals in lat.items():
-        vals.sort()
-        entry(edge)["latency"] = {
-            "n": len(vals),
-            "p50_s": _pct(vals, 0.50),
-            "p95_s": _pct(vals, 0.95),
-            "max_s": vals[-1] if vals else 0.0,
-        }
-    for edge, vals in stale.items():
-        entry(edge)["staleness"] = {
-            "n": len(vals),
-            "mean": sum(vals) / len(vals) if vals else 0.0,
-            "max": max(vals) if vals else 0,
-        }
+    # Merged per-edge sketches: the BARE ``<family>/<src>-><dst>`` keys
+    # (labeled ``.../<token>`` copies exist too; the bare key is the
+    # edge total, mirroring the raw path's bare-counter convention).
+    lat_sk: Dict[str, QuantileSketch] = {}
+    stale_sk: Dict[str, QuantileSketch] = {}
+    for name, sk in sketches.items():
+        for prefix, dest in (("comm.edge.latency_s/", lat_sk),
+                             ("comm.edge.staleness/", stale_sk)):
+            if name.startswith(prefix) and sk.n:
+                edge = name[len(prefix):]
+                if "->" in edge and "/" not in edge:
+                    dest[edge] = sk
+    for edge in sorted(set(lat) | set(lat_sk)):
+        sk = lat_sk.get(edge)
+        if sk is not None:
+            entry(edge)["latency"] = {
+                "n": sk.n,
+                "p50_s": sk.quantile(0.50),
+                "p95_s": sk.quantile(0.95),
+                "max_s": sk.max,
+                "quantiles": "sketch",
+            }
+        else:
+            vals = sorted(lat[edge])
+            entry(edge)["latency"] = {
+                "n": len(vals),
+                "p50_s": _pct(vals, 0.50),
+                "p95_s": _pct(vals, 0.95),
+                "max_s": vals[-1] if vals else 0.0,
+                "quantiles": "exact",
+            }
+        entry(edge)["latency"]["evicted"] = sum(
+            n for name, n in dropped.items()
+            if name.startswith("comm.edge.latency_s/" + edge)
+        )
+    for edge in sorted(set(stale) | set(stale_sk)):
+        sk = stale_sk.get(edge)
+        if sk is not None:
+            entry(edge)["staleness"] = {
+                "n": sk.n,
+                "mean": sk.mean,
+                "max": sk.max,
+            }
+        else:
+            vals = stale[edge]
+            entry(edge)["staleness"] = {
+                "n": len(vals),
+                "mean": sum(vals) / len(vals) if vals else 0.0,
+                "max": max(vals) if vals else 0,
+            }
 
     # Throughput window: the wall spread of the merged event stream
     # (agents' own stamps when the events travelled a delta; the
@@ -760,7 +1272,11 @@ def edge_profile_from_registry(
         e["bytes_out_per_s"] = (
             e["bytes_out"] / window if window > 0 else 0.0
         )
-    return {
+    profile = {
         "edges": {k: edges[k] for k in sorted(edges)},
         "window_s": window,
+        "quantiles": "sketch" if lat_sk else "exact",
     }
+    if lat_sk:
+        profile["alpha"] = next(iter(lat_sk.values())).alpha
+    return profile

@@ -46,6 +46,15 @@ class FlightRecorder:
         Where dump artifacts land (created if missing).
     capacity:
         Events retained per agent (ring: oldest evicted first).
+    global_capacity:
+        Optional cap on TOTAL retained events across all agents — the
+        fleet-scale memory bound.  Past it, every agent's effective
+        ring length shrinks proportionally
+        (``max(8, global_capacity // n_agents)``, never above
+        ``capacity``), so 500 churning agents cannot multiply the
+        recorder's footprint 500x; the shed tail counts into the same
+        per-agent eviction ledger the dumps disclose.  ``None`` (the
+        default) keeps the pre-fleet behavior: per-agent rings only.
     clock:
         Wall-clock source for dump/note timestamps — wall clock on
         purpose: artifacts from different processes must line up on one
@@ -53,10 +62,14 @@ class FlightRecorder:
     """
 
     def __init__(self, directory: str, *, capacity: int = 256,
+                 global_capacity: Optional[int] = None,
                  clock=time.time):
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.capacity = int(capacity)
+        self.global_capacity = (
+            None if global_capacity is None else int(global_capacity)
+        )
         self._clock = clock
         self._lock = threading.Lock()
         self._rings: Dict[str, collections.deque] = {}
@@ -65,6 +78,27 @@ class FlightRecorder:
         #: Paths of every artifact written so far (newest last).
         self.dumped: List[str] = []
 
+    def _per_agent_capacity(self, n_agents: int) -> int:
+        """Effective ring length at ``n_agents`` under the global cap."""
+        if self.global_capacity is None or n_agents <= 0:
+            return self.capacity
+        share = max(8, self.global_capacity // n_agents)
+        return min(self.capacity, share)
+
+    def _resize_rings_locked(self, cap: int) -> None:
+        """Shrink/regrow every ring to ``cap`` (deques are recreated —
+        maxlen is immutable); the tail shed by a shrink counts as
+        evictions, same ledger as ring overwrites."""
+        for agent, ring in list(self._rings.items()):
+            if ring.maxlen == cap:
+                continue
+            shed = max(0, len(ring) - cap)
+            if shed:
+                self._dropped[agent] = (
+                    self._dropped.get(agent, 0) + shed
+                )
+            self._rings[agent] = collections.deque(ring, maxlen=cap)
+
     # ------------------------------------------------------------------ #
     def record(self, agent: str, event: Mapping[str, Any]) -> None:
         """Append one event dict to ``agent``'s ring."""
@@ -72,10 +106,15 @@ class FlightRecorder:
         with self._lock:
             ring = self._rings.get(agent)
             if ring is None:
+                cap = self._per_agent_capacity(len(self._rings) + 1)
+                # A new agent may tighten everyone's share (no-op
+                # whenever the cap did not actually change).
+                self._resize_rings_locked(cap)
                 ring = self._rings[agent] = collections.deque(
-                    maxlen=self.capacity
+                    maxlen=cap
                 )
-            if len(ring) >= self.capacity:
+            cap = ring.maxlen if ring.maxlen is not None else self.capacity
+            if len(ring) >= cap:
                 self._dropped[agent] = self._dropped.get(agent, 0) + 1
             ring.append(dict(event))
 
@@ -116,6 +155,8 @@ class FlightRecorder:
             "events": sum(len(v) for v in snapshot.values()),
             "capacity": self.capacity,
         }
+        if self.global_capacity is not None:
+            header["global_capacity"] = self.global_capacity
         if dropped:
             header["ring_evictions"] = dropped
         header.update(context)
@@ -132,6 +173,24 @@ class FlightRecorder:
         return path
 
     # ------------------------------------------------------------------ #
+    def snapshot(self) -> dict:
+        """The recorder's current footprint: agents tracked, configured
+        caps, the effective per-agent ring length, total retained
+        events (``occupancy``), and the per-agent eviction ledger —
+        the visibility half of the global-cap contract."""
+        with self._lock:
+            n = len(self._rings)
+            return {
+                "agents": n,
+                "capacity": self.capacity,
+                "global_capacity": self.global_capacity,
+                "per_agent_capacity": self._per_agent_capacity(n),
+                "occupancy": sum(
+                    len(r) for r in self._rings.values()
+                ),
+                "evictions": dict(self._dropped),
+            }
+
     def agents(self) -> List[str]:
         with self._lock:
             return sorted(self._rings)

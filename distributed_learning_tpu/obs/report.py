@@ -11,8 +11,10 @@ The run-wide plane adds three modes (all jax-free):
 
 * ``obs-report --merge a.jsonl b.jsonl ...`` — merge per-agent event
   logs into ONE run report with per-agent labels plus the straggler
-  profile (each file's stem names its agent; ``--trace out.json``
-  additionally writes the merged Perfetto trace);
+  profile (each file's stem names its agent; a DIRECTORY argument
+  expands to its sorted ``*.jsonl`` members, so a fleet harness's
+  output dir is one argument; ``--trace out.json`` additionally writes
+  the merged Perfetto trace);
 * ``obs-report --bench BENCH_r*.json`` — the driver's benchmark
   trajectory as one table of headline samples/sec per round with
   regression flagging;
@@ -31,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence
@@ -112,10 +115,16 @@ def _bar(value: float, top: float, width: int = 24) -> str:
 
 def format_straggler_profile(profile: dict) -> str:
     """Render :func:`straggler_profile_from_registry` output."""
-    lines = [
+    head = (
         f"straggler profile — {profile['rounds']} rounds, "
         f"source: {profile['source']}"
-    ]
+    )
+    if profile.get("quantiles") == "sketch":
+        # Which statistics path produced the percentiles (the sketch's
+        # relative-error guarantee vs the exact small-run oracle).
+        alpha = profile.get("alpha", 0.01)
+        head += f", quantiles: sketch(α={alpha * 100:g}%)"
+    lines = [head]
     skew = profile.get("skew") or {}
     if profile["rounds"]:
         lines.append(
@@ -137,6 +146,17 @@ def format_straggler_profile(profile: dict) -> str:
                 f"{a['p95_s']:9.4f} {a['max_s']:9.4f} "
                 f"{a['slowest_rounds']:8d} {_fmt(a['stale_dropped']):>6} "
                 f"{_fmt(a['deferred']):>6}  {_bar(a['p95_s'], top)}"
+            )
+        evicted = sum(
+            int(a.get("evicted", 0)) for a in per_agent.values()
+        )
+        if evicted and profile.get("quantiles") != "sketch":
+            # Exact-path percentiles cover the retained ring only; the
+            # dropped tail is disclosed, never silently absorbed (the
+            # sketch path is eviction-immune and needs no caveat).
+            lines.append(
+                f"  ! {evicted} series points evicted — exact "
+                f"percentiles cover the retained window only"
             )
     # Staleness vs convergence (docs/async_runtime.md): what the async
     # runtime mixed stale/dropped, next to where each agent's consensus
@@ -186,6 +206,9 @@ def format_edge_profile(profile: dict) -> str:
     head = f"edge profile — {len(edges)} directed edges"
     if window:
         head += f" over {window:.1f}s"
+    if profile.get("quantiles") == "sketch":
+        alpha = profile.get("alpha", 0.01)
+        head += f", quantiles: sketch(α={alpha * 100:g}%)"
     lines = [head]
     if not edges:
         return "\n".join(lines)
@@ -222,16 +245,37 @@ def _token_from_path(path: str) -> str:
     return stem
 
 
+def _expand_log_paths(paths: Sequence[str]) -> List[str]:
+    """Expand directory arguments into their sorted ``*.jsonl`` files
+    (one fleet-harness output directory is one ``--merge`` argument);
+    plain file paths pass through unchanged."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            names = sorted(
+                n for n in os.listdir(path) if n.endswith(".jsonl")
+            )
+            if not names:
+                raise FileNotFoundError(
+                    f"--merge directory {path!r} holds no .jsonl logs"
+                )
+            out.extend(os.path.join(path, n) for n in names)
+        else:
+            out.append(path)
+    return out
+
+
 def merge_agent_logs(paths: Sequence[str]) -> RunAggregator:
     """Merge per-agent JSONL event logs (file stem == agent token) into
-    one :class:`RunAggregator`.  The merged registry re-stamps nothing:
+    one :class:`RunAggregator`.  Directory arguments expand to their
+    sorted ``*.jsonl`` members.  The merged registry re-stamps nothing:
     its clock is pinned to 0 because offline-merge timestamps are the
     agents' own (carried inside the replayed events), and a
     deterministic clock keeps merged reports reproducible."""
     agg = RunAggregator(
         registry=MetricsRegistry(clock=lambda: 0.0)
     )
-    for path in paths:
+    for path in _expand_log_paths(paths):
         agg.merge_registry(
             _token_from_path(path), MetricsRegistry.from_jsonl(path)
         )
@@ -332,7 +376,8 @@ def obs_report_main(argv: Optional[Sequence[str]] = None) -> int:
                     help="emit the raw report dict as JSON")
     ap.add_argument("--merge", action="store_true",
                     help="merge per-agent logs (file stem == agent "
-                         "token) into one run report + straggler "
+                         "token; a directory expands to its *.jsonl "
+                         "files) into one run report + straggler "
                          "profile")
     ap.add_argument("--trace", default=None, metavar="OUT.json",
                     help="with --merge: also write the merged "

@@ -41,6 +41,7 @@ __all__ = [
     "FLOW_PHASES",
     "emit_flow",
     "flow_key",
+    "trace_keep",
 ]
 
 # ---------------------------------------------------------------------- #
@@ -80,6 +81,41 @@ def emit_flow(registry: MetricsRegistry, phase: str, *,
         FLOW_EVENT, phase=phase, origin=origin, seq=int(seq),
         run=int(run_id), edge=edge, **fields,
     )
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer: a fixed, platform-independent 64-bit
+    mix.  NOT Python's ``hash()`` — that is salted per process
+    (PYTHONHASHSEED), and the whole point is that every process
+    computes the same bits for the same flow identity."""
+    x &= 0xFFFFFFFFFFFFFFFF
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & 0xFFFFFFFFFFFFFFFF
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EB & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+def trace_keep(run_id: int, origin: str, seq: int,
+               rate: float) -> bool:
+    """Consistent flow-sampling decision: keep this frame's trace?
+
+    Derived deterministically from the wire-carried ``TraceContext``
+    identity ``(run_id, origin, seq)`` — the SAME triple every hop of
+    the frame sees — so the sender and every receiver agree on
+    keep/drop without coordination, and a sampled flow chain is always
+    complete (encode→send→recv→decode→mix all present or all absent;
+    a partially-sampled chain would render as broken arrows).
+    ``rate >= 1.0`` short-circuits to True before any hashing: the
+    neutral knob is bit-identical to no sampling at all.
+    """
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    h = _mix64(int(run_id) * 0x9E3779B97F4A7C15 + int(seq))
+    for ch in origin:
+        h = _mix64(h ^ ord(ch))
+    # Top 53 bits -> uniform float in [0, 1).
+    return (h >> 11) * (1.0 / (1 << 53)) < rate
 
 
 @dataclasses.dataclass(frozen=True)

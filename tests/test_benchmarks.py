@@ -478,3 +478,42 @@ def test_bench_robust_gossip_smoke(capsys):
             "robust_async_byzantine_honest_error"} <= metrics
     for r in lines:
         assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
+
+
+def test_bench_obs_plane_smoke(capsys, tmp_path):
+    """ISSUE 17 fleet gate at smoke width: the two-tier aggregator
+    tree merges payloads above the throughput floor, reproduces the
+    flat merge's rendered quantiles exactly (aggregate-of-aggregates
+    oracle), keeps every sketch quantile inside the documented α
+    relative-error bound, and holds the bounded-memory/bounded-bytes
+    contract (bucket saturation, fleet-mode raw-series suppression,
+    sub-linear delta growth).  The artifact dir round-trips through
+    the directory form of ``obs-report --merge``."""
+    from benchmarks import bench_obs_plane
+    from distributed_learning_tpu.obs.report import merge_agent_logs
+
+    out_dir = tmp_path / "fleet"
+    out = bench_obs_plane.run(n_agents=24, packs=2, points_per_pack=15,
+                              n_subs=4, out_dir=str(out_dir))
+    assert out["gate_passed"], out
+    assert out["payloads_per_sec"] >= bench_obs_plane.MERGE_GATE_PAYLOADS_PER_SEC
+    assert out["two_tier_exact"], out
+    assert out["counters_ok"], out
+    assert out["alpha_ok"], out
+    assert out["sketch_rel_err_max"] <= out["alpha"] + 1e-12, out
+    assert out["memory_flat"], out
+    assert out["no_raw_series"], out
+    assert out["delta_bytes_flat"], out
+    assert out["export_bounded"], out
+    # One command inspects the whole fleet run: --merge on the dir.
+    agg = merge_agent_logs([str(out_dir)])
+    prof = agg.straggler_profile()
+    assert len(prof["per_agent"]) == 24
+    assert prof["quantiles"] == "sketch"
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    metrics = {r["metric"] for r in lines}
+    assert {"obs_plane_merge_payloads_per_sec",
+            "obs_plane_export_bytes"} <= metrics
+    for r in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(r)

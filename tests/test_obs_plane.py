@@ -645,3 +645,198 @@ def test_obs_monitor_once_renders_dashboard(tmp_path, capsys):
     assert b_row.split()[6] == "4", b_row
     assert main(["obs-monitor", str(tmp_path / "missing.jsonl"),
                  "--once"]) == 2
+
+
+# ---------------------------------------------------------------------- #
+# Fleet-scale plane (ISSUE 17): sketches, hierarchy, fleet mode          #
+# ---------------------------------------------------------------------- #
+def _agent_payloads(token, vals, *, packs=1, sketch=True,
+                    raw_series=True):
+    """``packs`` delta payloads from one synthetic agent registry."""
+    from distributed_learning_tpu.obs.aggregate import ObsDeltaSource
+
+    reg = MetricsRegistry(clock=lambda: 0.0)
+    src = ObsDeltaSource(reg, sketch=sketch, raw_series=raw_series)
+    out = []
+    chunk = max(1, len(vals) // packs)
+    for p in range(packs):
+        for v in vals[p * chunk:(p + 1) * chunk]:
+            reg.observe("comm.agent.round_s", float(v))
+        reg.inc("comm.agent.rounds_run", chunk)
+        out.append(src.pack())
+    src.close()
+    return out
+
+
+def test_sketch_quantiles_are_eviction_immune():
+    """The PR 6 regression the sketches fix: ring eviction at the
+    merged registry used to silently bias percentiles toward the
+    retained window.  The sketch path covers every point exactly once
+    regardless of the ring, and the eviction is disclosed either way."""
+    from distributed_learning_tpu.obs.report import (
+        format_straggler_profile,
+    )
+
+    vals = [0.01] * 90 + [1.0] * 10  # true p50 = 0.01
+
+    # Registry-direct exact path (obs-monitor's live view) with a tiny
+    # ring: the window only sees the last 8 points (all 1.0) — p50
+    # collapses to the slow tail.
+    from distributed_learning_tpu.obs.aggregate import (
+        straggler_profile_from_registry,
+    )
+
+    reg = MetricsRegistry(max_points=8, clock=lambda: 0.0)
+    for v in vals:
+        reg.observe("comm.agent.round_s/a", v)
+    prof = straggler_profile_from_registry(reg)
+    entry = prof["per_agent"]["a"]
+    assert prof["quantiles"] == "exact"
+    assert entry["count"] == 8 and entry["p50_s"] == 1.0
+    assert entry["evicted"] == 92  # the bias is disclosed ...
+    text = format_straggler_profile(prof)
+    assert "92 series points evicted" in text  # ... and rendered
+
+    # The delta path, same tiny merged ring: sketch quantiles cover
+    # all 100 points no matter what the ring evicted.
+    agg2 = RunAggregator(registry=MetricsRegistry(max_points=8,
+                                                  clock=lambda: 0.0))
+    for payload in _agent_payloads("a", vals, sketch=True):
+        agg2.process("a", payload)
+    prof2 = agg2.straggler_profile()
+    entry2 = prof2["per_agent"]["a"]
+    assert prof2["quantiles"] == "sketch"
+    assert entry2["count"] == 100
+    assert entry2["p50_s"] == pytest.approx(0.01, rel=0.01)
+    assert entry2["max_s"] == 1.0  # extremes stay exact
+    text2 = format_straggler_profile(prof2)
+    assert "quantiles: sketch" in text2
+    assert "evicted" not in text2  # sketch path has nothing to warn
+
+
+def test_v1_payload_without_sketch_section_still_sketches():
+    """Version compatibility: a v1 producer (no ``sketches`` section)
+    merges fine — the aggregator derives the sketch state from the raw
+    series points, so mixed-version fleets keep one coherent profile."""
+    agg = RunAggregator(registry=MetricsRegistry(clock=lambda: 0.0))
+    payload = {
+        "kind": "obs.delta", "v": 1, "seq": 1,
+        "counters": {"comm.agent.rounds_run": 3.0},
+        "gauges": {},
+        "events": [
+            {"kind": "series", "name": "comm.agent.round_s",
+             "value": v, "ts": 0.0}
+            for v in (0.1, 0.2, 0.3)
+        ],
+    }
+    agg.process("old", payload)
+    sk = agg.sketch("comm.agent.round_s/old")
+    assert sk is not None and sk.n == 3
+    assert agg.straggler_profile()["per_agent"]["old"]["count"] == 3
+    # A payload that DOES carry the section is authoritative: the
+    # aggregator must not re-sketch its raw points (double count).
+    agg2 = RunAggregator(registry=MetricsRegistry(clock=lambda: 0.0))
+    for p in _agent_payloads("new", [0.1, 0.2, 0.3]):
+        agg2.process("new", p)
+    sk2 = agg2.sketch("comm.agent.round_s/new")
+    assert sk2 is not None and sk2.n == 3  # not 6
+
+
+def test_two_tier_aggregation_matches_flat_merge():
+    """Aggregate-of-aggregates oracle at unit scale (the 500-agent
+    version is gated in benchmarks/bench_obs_plane.py): pods forward
+    merged sketch deltas upstream and the root renders exactly the
+    flat merge's per-agent quantiles."""
+    from distributed_learning_tpu.obs import SubAggregator
+
+    streams = {
+        f"t{i}": _agent_payloads(f"t{i}", [0.01 * (i + 1)] * 20, packs=2)
+        for i in range(6)
+    }
+    flat = RunAggregator(registry=MetricsRegistry(clock=lambda: 0.0))
+    subs = [
+        SubAggregator(registry=MetricsRegistry(clock=lambda: 0.0))
+        for _ in range(2)
+    ]
+    root = RunAggregator(registry=MetricsRegistry(clock=lambda: 0.0))
+    for p in range(2):
+        for i, (token, payloads) in enumerate(sorted(streams.items())):
+            flat.process(token, payloads[p])
+            subs[i % 2].process(token, payloads[p])
+        for s, sub in enumerate(subs):
+            root.process(f"pod{s}", sub.export_delta())
+    fp = flat.straggler_profile()["per_agent"]
+    rp = root.straggler_profile()["per_agent"]
+    assert set(fp) == set(rp)
+    for token in fp:
+        for key in ("count", "p50_s", "p95_s", "max_s"):
+            assert fp[token][key] == rp[token][key], (token, key)
+    assert (flat.registry.counters["comm.agent.rounds_run"]
+            == pytest.approx(
+                root.registry.counters["comm.agent.rounds_run"]))
+
+
+def test_subaggregator_export_filters_tier_bookkeeping():
+    """A pod's upstream delta must carry the fleet's signal, not the
+    pod's own merge accounting: ``obs.*`` counters and the per-payload
+    ``obs.delta`` stream markers stay local to the tier."""
+    from distributed_learning_tpu.obs import SubAggregator
+
+    sub = SubAggregator(registry=MetricsRegistry(clock=lambda: 0.0),
+                        forward_raw_series=False)
+    for token in ("a", "b"):
+        for p in _agent_payloads(token, [0.1, 0.2], packs=1):
+            sub.process(token, p)
+    export = sub.export_delta()
+    assert export["agg"] is True
+    assert is_obs_payload(export)
+    assert not any(n.startswith("obs.") for n in export["counters"])
+    assert not any(e.get("name") == "obs.delta"
+                   for e in export["events"])
+    # The pod's merged per-agent sketches ride upstream.
+    assert "comm.agent.round_s/a" in export["sketches"]
+    assert "comm.agent.round_s" in export["sketches"]
+    # Fleet mode at the pod tier: no raw sketched-series events.
+    assert not any(
+        e.get("kind") == "series"
+        and e.get("name", "").startswith("comm.agent.round_s")
+        for e in export["events"]
+    )
+
+
+def test_fleet_mode_suppression_is_disclosed_not_silent():
+    """``raw_series=False``: sketched series stop travelling as raw
+    points (O(metrics) deltas), the substitution count rides in the
+    payload, and the aggregator surfaces it as ``obs.series_sketched``."""
+    agg = RunAggregator(registry=MetricsRegistry(clock=lambda: 0.0))
+    payloads = _agent_payloads("a", [0.1] * 30, raw_series=False)
+    for p in payloads:
+        assert not any(e.get("kind") == "series"
+                       and e.get("name") == "comm.agent.round_s"
+                       for e in p["events"])
+        assert p["series_sketched"] == 30
+        agg.process("a", p)
+    assert agg.registry.counters["obs.series_sketched"] == 30
+    # The profile still has the full picture — from the sketch.
+    assert agg.straggler_profile()["per_agent"]["a"]["count"] == 30
+
+
+def test_flight_recorder_global_cap_sheds_proportionally():
+    """ISSUE 17 satellite: a 500-agent fleet must not grow the flight
+    recorder 500x — the global cap shrinks the per-agent window as
+    agents appear, oldest-first, and ``snapshot()`` discloses it."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        fr = FlightRecorder(d, capacity=64, global_capacity=64)
+        for i in range(8):
+            for j in range(20):
+                fr.note(f"a{i}", "ev", j=j)
+        snap = fr.snapshot()
+        assert snap["agents"] == 8
+        assert snap["per_agent_capacity"] == 8  # 64 // 8
+        assert snap["global_capacity"] == 64
+        assert snap["occupancy"] <= 64
+        assert sum(snap["evictions"].values()) > 0
+        # The window keeps the TAIL (newest events), like the rings.
+        assert [e["j"] for e in fr.ring("a0")] == list(range(12, 20))

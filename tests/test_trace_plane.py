@@ -99,7 +99,7 @@ def test_trace_context_versions_are_pinned_cross_language():
 # ---------------------------------------------------------------------- #
 # Acceptance: merged flow-linked trace across process tracks             #
 # ---------------------------------------------------------------------- #
-def _run_traced_loopback(rounds=2, trace=True):
+def _run_traced_loopback(rounds=2, trace=True, trace_sample=1.0):
     """Master + 3 traced agents, ``rounds`` sync gossip rounds; returns
     (aggregator, final values dict)."""
     agg = RunAggregator()
@@ -113,6 +113,7 @@ def _run_traced_loopback(rounds=2, trace=True):
             t: ConsensusAgent(
                 t, host, port, obs=MetricsRegistry(),
                 trace=trace, trace_run_id=14,
+                trace_sample=trace_sample,
             )
             for t in "abc"
         }
@@ -422,3 +423,85 @@ def test_obs_monitor_health_section_matches_golden(tmp_path, capsys):
     reg2.dump_jsonl(stream2)
     assert main(["obs-monitor", stream2, "--once"]) == 0
     assert "health:" not in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------- #
+# Consistent trace-flow sampling (ISSUE 17)                              #
+# ---------------------------------------------------------------------- #
+def test_trace_keep_is_deterministic_and_calibrated():
+    """The sampling decision is a pure function of the wire identity
+    ``(run_id, origin, seq)`` — every hop of a frame agrees with no
+    coordination — and the empirical keep fraction tracks the rate."""
+    from distributed_learning_tpu.obs import trace_keep
+
+    # Pure / stable: same identity, same verdict, every time.
+    for seq in range(50):
+        assert (trace_keep(14, "a", seq, 0.5)
+                == trace_keep(14, "a", seq, 0.5))
+    # Degenerate rates short-circuit (1.0 MUST be decision-free so the
+    # default path stays bit-identical to the pre-sampling plane).
+    assert all(trace_keep(14, "a", s, 1.0) for s in range(100))
+    assert not any(trace_keep(14, "a", s, 0.0) for s in range(100))
+    # Calibration: over many identities the keep fraction approaches
+    # the rate (splitmix64 finalizer, not PYTHONHASHSEED-salted hash).
+    for rate in (0.1, 0.5, 0.9):
+        kept = sum(
+            trace_keep(run, origin, seq, rate)
+            for run in (1, 14) for origin in ("a", "b", "agent-17")
+            for seq in range(2000)
+        )
+        assert abs(kept / 12000 - rate) < 0.02, (rate, kept)
+    # Distinct identities decide independently: flipping any one
+    # component reshuffles the verdict set.
+    base = [trace_keep(14, "a", s, 0.5) for s in range(200)]
+    assert base != [trace_keep(15, "a", s, 0.5) for s in range(200)]
+    assert base != [trace_keep(14, "b", s, 0.5) for s in range(200)]
+
+
+def test_sampled_out_run_keeps_metrics_but_drops_flows():
+    """``trace_sample=0.0``: no flow events reach the merged trace,
+    the suppression is counted (``obs.sampled_out``), and the
+    NON-flow telemetry — per-edge latency observatory, counters —
+    is untouched: sampling sheds trace volume, never metrics."""
+    agg, _vals = _run_traced_loopback(trace_sample=0.0)
+    events = agg.to_chrome_trace()["traceEvents"]
+    assert not [e for e in events
+                if e["ph"] == "X" and e["name"].startswith("frame.")]
+    assert not [e for e in events if e.get("cat") == FLOW_EVENT]
+    reg = agg.registry
+    assert reg.counters.get("obs.sampled_out", 0) > 0
+    # The edge observatory still populated from the wire trailers.
+    edges = edge_profile_from_registry(reg)["edges"]
+    assert edges, "sampling must not drop edge latency metrics"
+    assert any(e.get("latency", {}).get("n", 0) > 0
+               for e in edges.values())
+
+
+def test_partial_sampling_keeps_only_consistent_chains():
+    """``trace_sample=0.5``: every kept flow is hop-consistent —
+    origin and destination made the SAME keep/drop call from the
+    wire-carried identity.  The disagreement signature (a destination
+    kept a frame its origin dropped: recv/decode/mix without
+    encode/send) must never appear; origin-only chains are legitimate
+    (master-bound frames have no traced destination).  The dropped
+    remainder is visible in ``obs.sampled_out``."""
+    agg, _vals = _run_traced_loopback(rounds=4, trace_sample=0.5)
+    events = agg.to_chrome_trace()["traceEvents"]
+    anchors = [e for e in events
+               if e["ph"] == "X" and e["name"].startswith("frame.")]
+    chains = {}
+    for a in anchors:
+        key = (a["args"]["run"], a["args"]["origin"], a["args"]["seq"])
+        chains.setdefault(key, set()).add(a["name"].split(".", 1)[1])
+    assert chains, "rate 0.5 over 4 rounds kept no flows (seeded hash?)"
+    dst_phases = {"recv", "decode", "mix"}
+    for key, phases in chains.items():
+        if phases & dst_phases:
+            assert {"encode", "send"} <= phases, (
+                f"frame {key} has destination hops {sorted(phases)} "
+                "without its origin hops — hops disagreed on the "
+                "sampling verdict"
+            )
+    # At least one frame survived end-to-end, and some were shed.
+    assert any(p == set(FLOW_PHASES) for p in chains.values())
+    assert agg.registry.counters.get("obs.sampled_out", 0) > 0

@@ -290,19 +290,45 @@ def test_intentional_trace_ctx_bump_goes_through_audit_write(
 def test_real_tree_pins_the_obs_payload_surface():
     contract, findings = wc.extract()
     assert findings == [], [str(f) for f in findings]
-    assert contract["obs_payload"] == {"kind": "obs.delta", "version": 1}
+    assert contract["obs_payload"] == {
+        "kind": "obs.delta",
+        "version": 2,
+        "sections": [
+            "counters", "gauges", "events", "sketches", "rollups",
+        ],
+    }
 
 
 def test_obs_version_bump_fails_the_pin(contract_tree):
     root, expected = contract_tree
     _mutate(
         root, "distributed_learning_tpu/obs/aggregate.py",
-        r"OBS_PAYLOAD_VERSION = 1", "OBS_PAYLOAD_VERSION = 2",
+        r"OBS_PAYLOAD_VERSION = 2", "OBS_PAYLOAD_VERSION = 3",
     )
     fs = wc.check(repo_root=root, expected_path=expected)
     pin = [f for f in fs if f.rule == wc.PIN_RULE]
     assert pin, [str(f) for f in fs]
     assert "obs_payload" in pin[0].message
+
+
+def test_obs_section_rename_is_one_sided_drift(contract_tree):
+    """Seeded one-sided drift for the v2 sketch section keys: renaming
+    a section in OBS_PAYLOAD_SECTIONS without a version bump +
+    ``--audit-write`` repin must fail the pin — the section list is
+    schema, same lifecycle as the kind/version pair."""
+    root, expected = contract_tree
+    _mutate(
+        root, "distributed_learning_tpu/obs/aggregate.py",
+        r'"counters", "gauges", "events", "sketches", "rollups"',
+        '"counters", "gauges", "events", "digests", "rollups"',
+    )
+    fs = wc.check(repo_root=root, expected_path=expected)
+    pin = [f for f in fs if f.rule == wc.PIN_RULE]
+    assert pin, [str(f) for f in fs]
+    assert "obs_payload" in pin[0].message
+    # The intended lifecycle: change both sides together, then repin.
+    assert wc.write_pin(repo_root=root, expected_path=expected) == []
+    assert wc.check(repo_root=root, expected_path=expected) == []
 
 
 def test_dropping_the_obs_reexport_is_a_drift(contract_tree):

@@ -30,10 +30,12 @@ unless every shared constant matches exactly:
   authority, ``wire.cpp`` constexpr, ``dlt_abi.h`` define) and all
   three statements must agree;
 * the obs-delta payload surface (``OBS_PAYLOAD_KIND``/
-  ``OBS_PAYLOAD_VERSION``): authority ``obs/aggregate.py``, declared
-  wire surface through the ``comm/protocol.py`` re-export — the
-  re-export itself is checked (a restated copy would drift silently)
-  and the kind/version pair is pinned.
+  ``OBS_PAYLOAD_VERSION``/``OBS_PAYLOAD_SECTIONS``): authority
+  ``obs/aggregate.py``, declared wire surface through the
+  ``comm/protocol.py`` re-export — the re-export itself is checked (a
+  restated copy would drift silently) and the kind/version/section
+  surface is pinned, so adding or renaming a v2 section key is a
+  schema change that must ride ``--audit-write``.
 
 The merged contract is additionally PINNED in ``audit_expected.json``
 (key ``wire_contract``, next to the collective pins): an intentional
@@ -298,6 +300,37 @@ def _module_str_consts(tree: ast.Module) -> Dict[str, Tuple[str, int]]:
     return out
 
 
+def _module_str_tuple_consts(
+        tree: ast.Module) -> Dict[str, Tuple[List[str], int]]:
+    """name -> (list-of-strings, line) for top-level tuple-of-string
+    assignments (``OBS_PAYLOAD_SECTIONS = ("counters", ...)``)."""
+    out: Dict[str, Tuple[List[str], int]] = {}
+    for node in tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            continue
+        elts = []
+        for el in value.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                elts = None
+                break
+            elts.append(el.value)
+        if not elts:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = (elts, node.lineno)
+    return out
+
+
 def _reexports(tree: ast.Module, module_suffix: str,
                *names: str) -> bool:
     """True when the tree `from ...<module_suffix> import` ALL names."""
@@ -476,9 +509,11 @@ def _py_side(repo_root: str, ex: _Extract) -> Dict[str, object]:
     agg = ast.parse(agg_src)
     out["obs_int"] = _module_int_consts(agg)
     out["obs_str"] = _module_str_consts(agg)
+    out["obs_str_tuples"] = _module_str_tuple_consts(agg)
     out["obs_rel"] = agg_rel
     out["obs_reexported"] = _reexports(
-        proto, "obs.aggregate", "OBS_PAYLOAD_KIND", "OBS_PAYLOAD_VERSION"
+        proto, "obs.aggregate", "OBS_PAYLOAD_KIND",
+        "OBS_PAYLOAD_VERSION", "OBS_PAYLOAD_SECTIONS",
     )
     return out
 
@@ -741,23 +776,31 @@ def extract(repo_root: str = REPO_ROOT) -> Tuple[dict, List[Finding]]:
     # wire surface via the comm/protocol.py re-export.
     obs_kind = py["obs_str"].get("OBS_PAYLOAD_KIND")
     obs_ver = py["obs_int"].get("OBS_PAYLOAD_VERSION")
+    obs_sections = py["obs_str_tuples"].get("OBS_PAYLOAD_SECTIONS")
     if obs_kind is None:
         ex.fail(py["obs_rel"], 1,
                 "OBS_PAYLOAD_KIND not found in obs/aggregate.py")
     if obs_ver is None:
         ex.fail(py["obs_rel"], 1,
                 "OBS_PAYLOAD_VERSION not found in obs/aggregate.py")
+    if obs_sections is None:
+        ex.fail(py["obs_rel"], 1,
+                "OBS_PAYLOAD_SECTIONS not found in obs/aggregate.py — "
+                "the v2 payload's section keys are declared wire "
+                "surface")
     if not py["obs_reexported"]:
         ex.fail(
             py["proto_rel"], 1,
             "comm/protocol.py no longer re-exports OBS_PAYLOAD_KIND/"
-            "OBS_PAYLOAD_VERSION from obs.aggregate — the obs-delta "
-            "payload is declared wire surface and must come from the "
-            "single authority, not a restated copy",
+            "OBS_PAYLOAD_VERSION/OBS_PAYLOAD_SECTIONS from "
+            "obs.aggregate — the obs-delta payload is declared wire "
+            "surface and must come from the single authority, not a "
+            "restated copy",
         )
     contract["obs_payload"] = {
         "kind": obs_kind[0] if obs_kind else None,
         "version": obs_ver[0] if obs_ver else None,
+        "sections": list(obs_sections[0]) if obs_sections else None,
     }
     return contract, ex.findings
 
