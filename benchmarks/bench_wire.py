@@ -21,7 +21,14 @@ fallback, forced per call), at FULL MODEL WIDTH (the WRN-28-10 ravel,
   dense ``ValueResponse`` path;
 * the combined fused encode+decode speedup, gated >= 5x at full width
   by ISSUE 9 (the tier-1 rot guard in ``tests/test_benchmarks.py``
-  gates a looser 2x at smoke width so CI timing noise cannot flake).
+  gates a looser 2x at smoke width so CI timing noise cannot flake);
+* ISSUE 18 per-lever attribution for the zero-copy receive path:
+  alloc-per-frame decode vs ``decode(out=scratch)``
+  (``scratch_decode_speedup``), the production native+scratch decode vs
+  the Python codec (``zero_copy_decode_speedup`` — full width >= 3x,
+  smoke-width tier-1 gate >= 2x on decode alone), densify-then-add vs
+  the fused ``decode_apply`` scatter (``apply_vs_densify_speedup``),
+  and the two-thread decode ∥ mix microbench (``overlap_speedup``).
 
 Byte-identity is asserted in-run: the native frame must equal the
 Python oracle's frame bit for bit, both directions — a fast wrong codec
@@ -99,31 +106,93 @@ class _forced_python:
 
 def _measure_fused(flat, buckets) -> Dict[str, float]:
     frame = tc.encode_fused_sparse(flat, buckets, bf16_wire=True)
+    # Per-lever attribution (ISSUE 18): alloc-per-frame decode vs decode
+    # into a pinned scratch ravel (lever 1), and densify-then-add vs the
+    # fused in-place scatter (lever 2).  The repeated apply/add targets
+    # only accumulate ~reps * 0.5 * |x| — no overflow at _timed's caps.
+    scratch = np.empty(flat.size, np.float32)
+    target = np.zeros(flat.size, np.float32)
     enc = lambda: tc.encode_fused_sparse(flat, buckets, bf16_wire=True)
     dec = lambda: tc.decode_fused_sparse(frame)
+    dec_out = lambda: tc.decode_fused_sparse(frame, out=scratch)
+    apply_ = lambda: tc.decode_fused_apply(frame, target, scale=0.5)
+
+    def densify_add():
+        np.add(
+            target, np.float32(0.5) * tc.decode_fused_sparse(frame),
+            out=target,
+        )
+
     t_enc = _timed(enc)
     t_dec = _timed(dec)
+    t_dec_out = _timed(dec_out)
+    t_apply = _timed(apply_)
+    t_densify_add = _timed(densify_add)
     return {
         "frame_bytes": float(len(frame)),
         "encode_s": t_enc,
         "decode_s": t_dec,
+        "decode_out_s": t_dec_out,
+        "apply_s": t_apply,
+        "densify_add_s": t_densify_add,
         "encode_bytes_per_sec": len(frame) / t_enc,
         "decode_bytes_per_sec": len(frame) / t_dec,
+        "decode_out_bytes_per_sec": len(frame) / t_dec_out,
+        "apply_bytes_per_sec": len(frame) / t_apply,
         "roundtrip_bytes_per_sec": 2 * len(frame) / (t_enc + t_dec),
     }
 
 
 def _measure_dense(flat) -> Dict[str, float]:
     frame = tc.encode_tensor(flat, bf16_wire=True)
+    scratch = np.empty(flat.size, np.float32)
     enc = lambda: tc.encode_tensor(flat, bf16_wire=True)
     dec = lambda: tc.decode_tensor(frame)
+    dec_out = lambda: tc.decode_tensor(frame, out=scratch)
     t_enc = _timed(enc)
     t_dec = _timed(dec)
+    t_dec_out = _timed(dec_out)
     return {
         "frame_bytes": float(len(frame)),
+        "decode_s": t_dec,
+        "decode_out_s": t_dec_out,
         "encode_bytes_per_sec": len(frame) / t_enc,
         "decode_bytes_per_sec": len(frame) / t_dec,
+        "decode_out_bytes_per_sec": len(frame) / t_dec_out,
         "roundtrip_bytes_per_sec": 2 * len(frame) / (t_enc + t_dec),
+    }
+
+
+def _measure_overlap(frame, total: int) -> Dict[str, float]:
+    """Lever 3 microbench: decode-into-scratch on a worker thread while
+    the caller runs a memory-bound mix step (the ``_mix_pipelined``
+    shape) vs the same two steps back to back.  Both the native decode
+    (a ctypes call) and numpy's f32 ufunc loops drop the GIL, so the
+    ideal overlapped time is max(decode, mix), not their sum."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    scratch = np.empty(total, np.float32)
+    y = np.zeros(total, np.float32)
+    x = np.ones(total, np.float32)
+    dec = lambda: tc.decode_fused_sparse(frame, out=scratch)
+    mix = lambda: np.add(y, x, out=y)
+    t_dec = _timed(dec)
+    t_mix = _timed(mix)
+    pool = ThreadPoolExecutor(max_workers=1)
+
+    def both():
+        fut = pool.submit(dec)
+        mix()
+        fut.result()
+
+    t_both = _timed(both)
+    pool.shutdown()
+    return {
+        "decode_s": t_dec,
+        "mix_s": t_mix,
+        "serial_s": t_dec + t_mix,
+        "overlapped_s": t_both,
+        "overlap_speedup": (t_dec + t_mix) / t_both,
     }
 
 
@@ -158,6 +227,25 @@ def run(total: Optional[int] = None) -> dict:
         )
     )
     out["fused"]["decode_identical"] = identical_decode
+    # Zero-copy levers must preserve the same identity: decode into a
+    # DIRTY scratch (stale bytes must never leak into untouched
+    # positions) and the fused scatter-add vs decode-then-add.
+    dirty = np.full(total, np.float32(np.nan))
+    out["fused"]["decode_out_identical"] = bool(
+        np.array_equal(
+            tc.decode_fused_sparse(frame_nat, out=dirty), ravel_py,
+            equal_nan=True,
+        )
+    )
+    base = np.arange(total, dtype=np.float32)
+    applied = base.copy()
+    tc.decode_fused_apply(frame_nat, applied, scale=0.5)
+    with _forced_python():
+        applied_py = base.copy()
+        tc.decode_fused_apply(frame_nat, applied_py, scale=0.5)
+    out["fused"]["apply_identical"] = bool(
+        np.array_equal(applied, applied_py, equal_nan=True)
+    )
 
     with _forced_python():
         fused_py = _measure_fused(flat, buckets)
@@ -176,6 +264,7 @@ def run(total: Optional[int] = None) -> dict:
             frame_bytes=nat["frame_bytes"],
             encode_bytes_per_sec=nat["encode_bytes_per_sec"],
             decode_bytes_per_sec=nat["decode_bytes_per_sec"],
+            decode_out_bytes_per_sec=nat["decode_out_bytes_per_sec"],
             roundtrip_bytes_per_sec=nat["roundtrip_bytes_per_sec"],
             python_encode_bytes_per_sec=py["encode_bytes_per_sec"],
             python_decode_bytes_per_sec=py["decode_bytes_per_sec"],
@@ -185,10 +274,28 @@ def run(total: Optional[int] = None) -> dict:
             decode_speedup=(
                 nat["decode_bytes_per_sec"] / py["decode_bytes_per_sec"]
             ),
+            # Lever 1 attribution: alloc-per-frame vs pinned scratch on
+            # the SAME engine, and the production receive path (native,
+            # out=) vs the Python codec — the ISSUE 18 decode gate.
+            scratch_decode_speedup=nat["decode_s"] / nat["decode_out_s"],
+            zero_copy_decode_speedup=(
+                nat["decode_out_bytes_per_sec"] / py["decode_bytes_per_sec"]
+            ),
             roundtrip_speedup=(
                 nat["roundtrip_bytes_per_sec"] / py["roundtrip_bytes_per_sec"]
             ),
         )
+    # Lever 2 attribution: the fused in-place scatter vs densify-then-add
+    # (native side; the python column is the oracle's own apply rate).
+    out["fused"].update(
+        apply_bytes_per_sec=fused_nat["apply_bytes_per_sec"],
+        apply_vs_densify_speedup=(
+            fused_nat["densify_add_s"] / fused_nat["apply_s"]
+        ),
+        python_apply_bytes_per_sec=fused_py["apply_bytes_per_sec"],
+    )
+    # Lever 3 attribution: decode ∥ mix on two threads vs back to back.
+    out["overlap"] = _measure_overlap(frame_nat, total)
 
     for section in ("fused", "dense"):
         s = out[section]
@@ -214,6 +321,36 @@ def run(total: Optional[int] = None) -> dict:
             "speedup_vs_python": round(s["roundtrip_speedup"], 2),
             "encode_speedup": round(s["encode_speedup"], 2),
             "decode_speedup": round(s["decode_speedup"], 2),
+            # ISSUE 18 per-lever attribution columns.
+            "decode_out_bytes_per_sec": round(
+                s["decode_out_bytes_per_sec"], 1
+            ),
+            "scratch_decode_speedup": round(s["scratch_decode_speedup"], 2),
+            "zero_copy_decode_speedup": round(
+                s["zero_copy_decode_speedup"], 2
+            ),
+            **(
+                {
+                    "decode_out_identical": s["decode_out_identical"],
+                    "apply_identical": s["apply_identical"],
+                    "apply_bytes_per_sec": round(s["apply_bytes_per_sec"], 1),
+                    "apply_vs_densify_speedup": round(
+                        s["apply_vs_densify_speedup"], 2
+                    ),
+                    "python_apply_bytes_per_sec": round(
+                        s["python_apply_bytes_per_sec"], 1
+                    ),
+                    "overlap_speedup": round(
+                        out["overlap"]["overlap_speedup"], 2
+                    ),
+                    "overlap_serial_s": round(out["overlap"]["serial_s"], 6),
+                    "overlap_overlapped_s": round(
+                        out["overlap"]["overlapped_s"], 6
+                    ),
+                }
+                if section == "fused"
+                else {}
+            ),
         })
     return out
 

@@ -306,6 +306,33 @@ class ConsensusAgent:
         if self._obs is not None and self._obs is not get_registry():
             self._obs.observe(name, value, step=step)
 
+    def _count_wire(self, name: str, value: float = 1) -> None:
+        """Bump a ``comm.wire.*`` counter (decode scratch-pool and
+        zero-copy receive-path accounting, shared with the async
+        runner) with the same dual-registry mirror as :meth:`_count` —
+        but no per-agent ``counters`` entry and no ``comm.agent.``
+        prefix: these count wire-path mechanics, not agent behavior."""
+        get_registry().inc(f"comm.wire.{name}", value)
+        if self._obs is not None and self._obs is not get_registry():
+            self._obs.inc(f"comm.wire.{name}", value)
+
+    def _apply_fused(self, frame, target: np.ndarray, *,
+                     scale: float = 1.0) -> np.ndarray:
+        """Scatter-add a validated lazy fused frame straight onto live
+        state (``tensor_codec.FusedFrame.apply_into`` — the zero-copy
+        consume primitive), timed as a ``comm.wire.decode.apply`` span
+        in both registries."""
+        wall_t0 = time.time()
+        t0 = time.perf_counter()
+        out = frame.apply_into(target, scale=scale)
+        dur_s = time.perf_counter() - t0
+        regs = [get_registry()]
+        if self._obs is not None and self._obs is not regs[0]:
+            regs.append(self._obs)
+        for reg in regs:
+            reg.record_span("comm.wire.decode.apply", dur_s, t0=wall_t0)
+        return out
+
     def _on_stream_retry(self) -> None:
         """FramedStream retry hook: a transient socket error was retried
         instead of aborting the round."""
@@ -1054,13 +1081,23 @@ class ConsensusAgent:
         replicated estimates and step the iterate — in sorted-token
         order, so the recurrence is reproducible across runs and the
         async runtime's tau=0 oracle can be bit-exact."""
+        from distributed_learning_tpu.comm.tensor_codec import FusedFrame
+
         self._choco_hat_self = self._choco_hat_self + q
         out = x.copy()
         for t in sorted(neighbor_qs):
             qn = neighbor_qs[t]
-            self._choco_hat_nbrs[t] = self._choco_hat_nbrs[t] + np.asarray(
-                qn, np.float32
-            ).ravel()
+            if isinstance(qn, FusedFrame):
+                # Zero-copy consume (lazy fused receive): the frame's
+                # sections scatter-add straight onto the replicated
+                # estimate — no densified intermediate.  Ulp-identical
+                # to the dense add for the duplicate-free frames the
+                # encoder produces (see decode_fused_apply).
+                self._apply_fused(qn, self._choco_hat_nbrs[t])
+            else:
+                self._choco_hat_nbrs[t] = self._choco_hat_nbrs[
+                    t
+                ] + np.asarray(qn, np.float32).ravel()
             out += gamma * self._weights[t] * (
                 self._choco_hat_nbrs[t] - self._choco_hat_self
             )

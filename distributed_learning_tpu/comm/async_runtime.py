@@ -52,9 +52,10 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import functools
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -63,6 +64,11 @@ from distributed_learning_tpu.comm.agent import (
     AgentStatus,
     ConsensusAgent,
     ShutdownError,
+)
+from distributed_learning_tpu.comm.tensor_codec import (
+    DenseFrame,
+    FusedFrame,
+    SparseFrame,
 )
 
 __all__ = [
@@ -89,9 +95,11 @@ SCHED_HOT = (
     "_poke",
     "_recv_step",
     "_handle_master",
+    "_drain_ready",
     "_collect",
     "begin_round",
     "finish_round",
+    "_mix_pipelined",
     "run_async_round",
     "_collect_choco",
     "run_async_choco",
@@ -203,6 +211,15 @@ class AsyncGossipRunner:
         — honest peers legitimately run ahead in bounded-staleness mode;
         the bound only has to catch absurd claims (a lying peer
         advertising round 10**18 to poison staleness accounting).
+    overlap:
+        Decode/compute overlap (zero-copy wire path, docs/wire.md
+        §Zero-copy receive path).  Off (default): the dispatch loop
+        densifies each arriving frame into the edge's scratch ravel at
+        its service point.  On: frames stay lazy in the inbox and
+        :meth:`finish_round` pipelines them — the NEXT neighbor's frame
+        densifies on a worker thread (ctypes/numpy release the GIL)
+        while the round task numpy-mixes the PREVIOUS one.  Mixing
+        order and arithmetic are identical either way.
     """
 
     def __init__(
@@ -214,6 +231,7 @@ class AsyncGossipRunner:
         validate_wire: bool = True,
         quarantine_after: int = 3,
         round_slack: int = 100_000,
+        overlap: bool = False,
     ):
         if staleness_bound < 0:
             raise ValueError(
@@ -231,12 +249,31 @@ class AsyncGossipRunner:
         self.validate_wire = bool(validate_wire)
         self.quarantine_after = int(quarantine_after)
         self.round_slack = int(round_slack)
+        self.overlap = bool(overlap)
         self._round = 0
         self._inbox: Dict[str, _Inbox] = {}
         self._pub_value: Optional[np.ndarray] = None
         self._pub_round = 0
         self._poked: set = set()
         self._quarantined: set = set()
+        # Per-edge decode scratch pool (zero-copy receive path): token ->
+        # ONE idle f32 ravel awaiting the edge's next frame.  A buffer
+        # leaves the pool at the dispatch service point (decode target),
+        # rides the inbox as the decoded value, and re-enters the pool —
+        # adopt-on-supersede — when the round task replaces it as the
+        # standing value (or applies it, for CHOCO corrections).  All
+        # hand-offs run on the round task's turns, which is exactly the
+        # claim the two task-shared-mutation suppressions below carry
+        # and graftlint --sched verifies on every explored schedule.
+        # Evicted wholesale on membership realignment and per-edge on
+        # quarantine: a stale-sized buffer must miss, never corrupt.
+        self._scratch: Dict[str, np.ndarray] = {}
+        self._decode_pool = None  # 1-thread executor, built on first use
+        # In-flight detached value sends (_send_detached): tracked so a
+        # late failure is still silenced/observed, bounded by the round
+        # structure itself (a round cannot finish without the neighbors
+        # it pushed to making progress of their own).
+        self._send_tasks: set = set()
         self.last_stats = AsyncRoundStats()
 
     # ------------------------------------------------------------------ #
@@ -268,6 +305,74 @@ class AsyncGossipRunner:
             t for t in a._weights
             if t in a._neighbors and t not in self._quarantined
         )
+
+    # ------------------------------------------------------------------ #
+    # Decode scratch pool (docs/wire.md §Zero-copy receive path)         #
+    # ------------------------------------------------------------------ #
+    def _scratch_buf(
+        self, token: str, buf: Optional[np.ndarray], size: int
+    ) -> np.ndarray:
+        """Account and return a decode target for ``token``'s next
+        frame: the pool buffer the caller popped when it fits
+        (``comm.wire.scratch_hits``), else a fresh ravel (misses — the
+        first two frames of an edge, and any size change).  Each bump
+        lands twice: the bare run total and a per-edge labeled copy
+        under the frame's inbound direction (``<peer>-><self>``, the
+        same convention as ``comm.edge.*``) so the ``obs-report
+        --merge`` edge table can attribute pool behavior per link."""
+        a = self.agent
+        edge = f"{token}->{a.token}"
+        if buf is not None and buf.size == size:
+            a._count_wire("scratch_hits")
+            a._count_wire(f"scratch_hits/{edge}")
+        else:
+            buf = np.empty(size, np.float32)
+            a._count_wire("scratch_misses")
+            a._count_wire(f"scratch_misses/{edge}")
+        a._count_wire("scratch_bytes", 4 * size)
+        a._count_wire(f"scratch_bytes/{edge}", 4 * size)
+        return buf
+
+    def _densify_dispatch(
+        self, token: str, value: Any, buf: Optional[np.ndarray]
+    ) -> np.ndarray:
+        """Serial-mode dispatch decode: densify an arriving dense/sparse
+        frame into the edge's scratch ravel.  Direct-injected ndarrays
+        (tests drive ``_handle_peer_msg`` without the wire) are copied
+        into a runner-owned buffer too, so adopt-on-supersede can never
+        recycle caller memory into the pool."""
+        if isinstance(value, np.ndarray):
+            v = np.ascontiguousarray(value, np.float32).ravel()
+            out = self._scratch_buf(token, buf, v.size)
+            np.copyto(out, v)
+            return out
+        return value.densify(out=self._scratch_buf(token, buf, value.size))
+
+    def _recycle(self, token: str, old: Any, new: Any) -> None:
+        """Adopt a superseded decode buffer back into the pool (single
+        idle slot per edge; ``setdefault`` keeps an existing idle buffer
+        and simply drops the extra)."""
+        if (
+            old is not None
+            and old is not new
+            and isinstance(old, np.ndarray)
+            and old.ndim == 1
+            and old.dtype == np.float32
+            and old.flags.c_contiguous
+            and old.flags.writeable
+        ):
+            self._scratch.setdefault(token, old)
+
+    def _decode_executor(self):
+        """The overlap mode's single decode worker, built lazily (a
+        serial runner never spawns a thread)."""
+        if self._decode_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._decode_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="dlt-decode"
+            )
+        return self._decode_pool
 
     # ------------------------------------------------------------------ #
     # Wire-field validation + quarantine (docs/robustness.md)            #
@@ -332,6 +437,7 @@ class AsyncGossipRunner:
         box.queue.clear()
         box.last = None
         box.dropped = True
+        self._scratch.pop(token, None)  # the edge's decode buffer dies too
         a._mux.remove(token)
         stream = a._neighbors.pop(token, None)
         if stream is not None:
@@ -358,12 +464,19 @@ class AsyncGossipRunner:
         """Ship the current value to every active neighbor (the
         unsolicited push half of the runtime)."""
         a = self.agent
-        kind = P._ASYNC_SPARSE if (
-            a.sparse_wire and a._sparse_wins(value)
-        ) else P._ASYNC_DENSE
+        if a._fused_spans is not None:
+            # Fused CHOCO push (run_async_choco(buckets=...)): the whole
+            # correction ships as ONE fused frame — the receiver applies
+            # it straight onto its replicated estimate, no densify.
+            kind = P._ASYNC_FUSED
+        elif a.sparse_wire and a._sparse_wins(value):
+            kind = P._ASYNC_SPARSE
+        else:
+            kind = P._ASYNC_DENSE
         msg = P.AsyncValue(
             round_id=self._round, generation=a._generation,
             staleness=staleness, value=value, kind=kind,
+            buckets=a._fused_spans,
             bf16_wire=a.bf16_wire, int8_wire=a._int8_active,
         )
         a._count("async_pushes")
@@ -371,13 +484,38 @@ class AsyncGossipRunner:
             # Trace stamping is per NEIGHBOR (the edge label and seq
             # differ per destination): replace on the shared base frame.
             out = a._stamp_trace(msg, token)
+            self._send_detached(token, out)
+
+    def _send_detached(self, token: str, out) -> None:
+        """Ship one frame to ``token`` on a detached (tracked) task.
+
+        The round task must never await a neighbor's socket drain: it is
+        also the mux pump (``_recv_step``) that re-arms this agent's
+        reads.  When every agent pushes a frame larger than the kernel's
+        socket buffers at once, synchronous sends form a cycle — each
+        round task parked in ``drain()``, nobody pumping reads, every
+        reader idle — and the deployment deadlocks (observed at ~2 MB
+        frames on loopback; full model width is ~146 MB).  Detached
+        sends keep FIFO order per edge (the framer's ``_send_lock``
+        wakes waiters in acquisition order) and let the pump resume
+        immediately; a failed send marks the edge dropped exactly as the
+        inline path did."""
+        a = self.agent
+        framer = a._neighbors[token]
+
+        async def _send_one():
             try:
-                await a._neighbors[token].send(out)
+                await framer.send(out)
             except (ConnectionError, OSError):
                 self._box(token).dropped = True
-                continue
+                return
             if out.trace is not None:
                 a._emit_flow("send", out.trace, f"{a.token}->{token}")
+
+        task = asyncio.ensure_future(_send_one())
+        self._send_tasks.add(task)
+        task.add_done_callback(self._send_tasks.discard)
+        task.add_done_callback(a._silence)
 
     async def _answer_poke(self, token: str) -> None:
         """Re-send the standing published value to a poked-by neighbor
@@ -386,14 +524,23 @@ class AsyncGossipRunner:
         if self._pub_value is None or token not in a._neighbors:
             return
         a._count("pokes_answered")
-        kind = P._ASYNC_SPARSE if (
-            a.sparse_wire and a._sparse_wins(self._pub_value)
-        ) else P._ASYNC_DENSE
+        if a._fused_spans is not None:
+            # Poke answered inside the fused-push window: same bytes as
+            # the push.  Outside it, the standing (already wire-rounded)
+            # value re-encodes sparse/dense — narrowing is idempotent,
+            # so a CHOCO replay carries identical values and the
+            # exactly-once watermark dedups it.
+            kind = P._ASYNC_FUSED
+        elif a.sparse_wire and a._sparse_wins(self._pub_value):
+            kind = P._ASYNC_SPARSE
+        else:
+            kind = P._ASYNC_DENSE
         msg = a._stamp_trace(
             P.AsyncValue(
                 round_id=self._pub_round, generation=a._generation,
                 staleness=self._round - self._pub_round,
                 value=self._pub_value, kind=kind,
+                buckets=a._fused_spans,
                 bf16_wire=a.bf16_wire, int8_wire=a._int8_active,
             ),
             token,
@@ -408,20 +555,23 @@ class AsyncGossipRunner:
     async def _poke(self, token: str) -> None:
         """The re-request half of drop-and-re-request: ask a
         staleness-bound-exceeded neighbor for a fresh push.  One poke
-        per staleness excursion (cleared when its next frame lands)."""
+        per staleness excursion (cleared when its next frame lands).
+
+        Shipped detached for the same reason value pushes are: the
+        framer's send lock may be held by an in-flight multi-MB frame
+        whose receiver has stopped reading (a peer past its last
+        round), and an inline ``send`` would park the round task behind
+        that drain forever — the deadline loop never expires and the
+        round never finishes."""
         a = self.agent
         if token in self._poked or token not in a._neighbors:
             return
         self._poked.add(token)
         a._count("pokes_sent")
-        try:
-            await a._neighbors[token].send(
-                P.AsyncPoke(
-                    round_id=self._round, generation=a._generation
-                )
-            )
-        except (ConnectionError, OSError):
-            pass
+        self._send_detached(
+            token,
+            P.AsyncPoke(round_id=self._round, generation=a._generation),
+        )
 
     async def _recv_step(self, timeout: Optional[float]) -> bool:
         """Receive + handle ONE message from the master or any neighbor;
@@ -452,8 +602,13 @@ class AsyncGossipRunner:
         a = self.agent
         if isinstance(msg, P.NeighborhoodData):
             # Membership generation broadcast: realign weights/streams;
-            # inboxes of removed edges die with their streams.
+            # inboxes of removed edges die with their streams, and the
+            # WHOLE decode scratch pool is evicted — replacement peers
+            # may publish a different model width, and a stale-sized
+            # buffer must cost one miss, never a corrupt decode.
             await a._apply_neighborhood(msg)
+            # graftlint: disable=task-shared-mutation -- generation-realignment turn discipline: _handle_master runs inside the round task's own _recv_step await, so no pipelined decode is writing into a pooled buffer while the pool empties (the round task is HERE, not in _mix_pipelined) and the next dispatch simply takes misses
+            self._scratch.clear()
             for token in list(self._inbox):
                 if token not in a._weights:
                     # graftlint: disable=task-shared-mutation -- membership turn discipline: _handle_master runs inside the round task's own _recv_step await (never concurrently with _consume/_mix_plain, which only run after _collect returns), so evicting a removed edge's inbox here cannot race the round's reads
@@ -489,9 +644,21 @@ class AsyncGossipRunner:
             ):
                 self._on_violation(token)
                 return
+            value = msg.value
+            if not self.overlap and not isinstance(value, FusedFrame):
+                # Serial mode: densify dense/sparse frames HERE, into
+                # the edge's scratch ravel — one pinned buffer per peer
+                # stream instead of an allocation per frame.  Fused
+                # frames stay lazy in either mode: the CHOCO consume
+                # applies their sections straight onto the replicated
+                # estimate.  In overlap mode everything stays lazy and
+                # _mix_pipelined decodes off the event loop.
+                # graftlint: disable=task-shared-mutation -- scratch-pool turn discipline: every pop of an idle decode buffer runs on one of the round task's own turns (dispatch executes inside its _recv_step await; pipelined decode pops on the round task itself), and a buffer only re-enters the pool after that same task supersedes the value decoded into it, so no other task ever holds or writes these buffers
+                buf = self._scratch.pop(token, None)
+                value = self._densify_dispatch(token, value, buf)
             box = self._box(token)
             box.queue.append(
-                (msg.value, msg.round_id, msg.staleness, msg.trace)
+                (value, msg.round_id, msg.staleness, msg.trace)
             )
             box.dropped = False
             if a.trace and msg.trace is not None:
@@ -533,11 +700,24 @@ class AsyncGossipRunner:
             return False
         return box.last is None or box.times_mixed > self.tau
 
+    async def _drain_ready(self) -> None:
+        """Dispatch every ALREADY-COMPLETED read before computing the
+        round's requirements.  Sticky drops only clear at dispatch, so
+        a round that requires nothing (every neighbor dropped, or all
+        within tau) must still consume what the persistent reader tasks
+        finished while the round task was elsewhere — otherwise a
+        fully-dropped excursion never polls the mux again and the
+        poke/re-push recovery path is a lost wakeup: frames pile up
+        parsed-but-undelivered while every round free-runs on self."""
+        while await self._recv_step(0):
+            pass
+
     async def _collect(self) -> None:
         """Wait (deadline-bounded) until no active neighbor is required
         to deliver a fresh frame; expiry drops the stragglers for this
         round and pokes them."""
         a = self.agent
+        await self._drain_ready()
         deadline = (
             None if self.deadline_s is None
             else asyncio.get_event_loop().time() + self.deadline_s
@@ -558,11 +738,16 @@ class AsyncGossipRunner:
             if not await self._recv_step(timeout):
                 continue  # deadline re-checked at the loop head
 
-    def _consume(self, token: str, stats: AsyncRoundStats) -> _Inbox:
+    def _consume(
+        self, token: str, stats: AsyncRoundStats, *, densify: bool = True
+    ) -> _Inbox:
         """Advance ``token``'s inbox for this round: tau=0 consumes the
         OLDEST unread frame (lock-step order — exactly one frame per
         sender round), tau>0 jumps to the latest (mix the newest
-        information, count the skips)."""
+        information, count the skips).  The superseded standing buffer
+        re-enters the scratch pool (adopt-on-supersede); a still-lazy
+        payload densifies into edge scratch here unless the pipelined
+        mixer (``densify=False``) is about to decode it off-loop."""
         box = self._box(token)
         if box.queue:
             if self.tau == 0:
@@ -571,6 +756,16 @@ class AsyncGossipRunner:
                 stats.skipped += len(box.queue) - 1
                 value, _, sent_stale, trace = box.queue[-1]
                 box.queue.clear()
+            if densify and not isinstance(value, np.ndarray):
+                # A FUSED push consumed by a plain round (deployment
+                # mismatch — tolerated, the frame is self-describing):
+                # densify on the round task.  _consume is round-owned,
+                # so the pool hand-off needs no suppression here.
+                buf = self._scratch.pop(token, None)
+                value = value.densify(
+                    out=self._scratch_buf(token, buf, value.size)
+                )
+            self._recycle(token, box.last, value)
             box.last = value
             box.last_trace = trace
             box.times_mixed = 0
@@ -620,6 +815,86 @@ class AsyncGossipRunner:
             )
         return out
 
+    async def _mix_pipelined(self, y: np.ndarray) -> np.ndarray:
+        """Overlap-mode twin of :meth:`_mix_plain`: identical queue
+        discipline, accumulation order, and arithmetic, but the inbox
+        still holds LAZY frames (dispatch skipped the densify), so each
+        frame decodes into edge scratch on the single worker thread
+        (``loop.run_in_executor`` — the ctypes engine and numpy release
+        the GIL) while the round task numpy-mixes the PREVIOUS
+        neighbor's contribution.  At most two decodes are in flight:
+        one running, one queued behind it.  The decoded array replaces
+        ``box.last`` so stale re-mixes in later rounds never re-decode.
+        """
+        a = self.agent
+        loop = asyncio.get_event_loop()
+        stats = self.last_stats
+        tokens = sorted(a._weights)
+        # Stage 1 (sync, round task): advance every inbox — the frames
+        # to decode this round, in mixing order.
+        boxes = {t: self._consume(t, stats, densify=False) for t in tokens}
+        jobs = [
+            t for t in tokens if not isinstance(
+                boxes[t].last, (np.ndarray, type(None))
+            )
+        ]
+        inflight: Dict[str, Any] = {}
+        nxt = 0
+
+        def _submit(t: str) -> None:
+            frame = boxes[t].last
+            # Round-task turn: the pool hand-off happens HERE, not on
+            # the worker — the thread only ever writes the buffer it
+            # was handed (the scratch-pool turn-discipline claim).
+            buf = self._scratch.pop(t, None)
+            buf = self._scratch_buf(t, buf, frame.size)
+            inflight[t] = loop.run_in_executor(
+                self._decode_executor(),
+                functools.partial(frame.densify, out=buf),
+            )
+
+        if jobs:
+            _submit(jobs[0])
+            nxt = 1
+        total_w = sum(a._weights.values())
+        out = (1.0 - total_w) * y
+        for token in tokens:
+            box = boxes[token]
+            if token in inflight:
+                # Keep the pipe full BEFORE blocking on this decode.
+                while nxt < len(jobs) and len(inflight) < 2:
+                    _submit(jobs[nxt])
+                    nxt += 1
+                box.last = await inflight.pop(token)
+            w = a._weights[token]
+            s = box.times_mixed
+            usable = (
+                box.last is not None and not box.dropped and s <= self.tau
+            )
+            if not usable:
+                stats.dropped.append(token)
+                a._count("async_stale_dropped")
+                out = out + w * y
+            elif s == 0:
+                stats.mixed[token] = 0
+                out = out + w * box.last
+            else:
+                stats.mixed[token] = s
+                a._count("async_stale_mixed")
+                w_eff = w / (1.0 + s)
+                out = out + w_eff * box.last + (w - w_eff) * y
+            if usable and s == 0 and box.last_trace is not None:
+                a._emit_flow("mix", box.last_trace, f"{token}->{a.token}")
+                box.last_trace = None
+            box.times_mixed += 1
+            stale_pt = float(s if usable else self.tau + 1)
+            a._observe("comm.agent.staleness", stale_pt, step=self._round)
+            a._observe(
+                f"comm.edge.staleness/{token}->{a.token}",
+                stale_pt, step=self._round,
+            )
+        return out
+
     async def begin_round(self, value: np.ndarray) -> None:
         """Open an async round: advance the round counter and push the
         value.  Run local compute between ``begin_round`` and
@@ -637,11 +912,15 @@ class AsyncGossipRunner:
 
     async def finish_round(self) -> np.ndarray:
         """Close the round: deadline-bounded collect, then the
-        stale-weighted mix of the published value against the inbox."""
+        stale-weighted mix of the published value against the inbox
+        (pipelined with the neighbor decodes in ``overlap`` mode)."""
         a = self.agent
         t0 = time.perf_counter()
         await self._collect()
-        out = self._mix_plain(self._pub_value)
+        if self.overlap:
+            out = await self._mix_pipelined(self._pub_value)
+        else:
+            out = self._mix_plain(self._pub_value)
         a._observe(
             "comm.agent.async_round_s",
             time.perf_counter() - t0, step=self._round,
@@ -679,6 +958,7 @@ class AsyncGossipRunner:
 
     async def _collect_choco(self) -> None:
         a = self.agent
+        await self._drain_ready()
         deadline = (
             None if self.deadline_s is None
             else asyncio.get_event_loop().time() + self.deadline_s
@@ -707,6 +987,7 @@ class AsyncGossipRunner:
         compressor: Callable[[np.ndarray], np.ndarray],
         *,
         gamma: float = 0.3,
+        buckets: Optional[Tuple] = None,
     ) -> np.ndarray:
         """One asynchronous CHOCO-GOSSIP round: push the compressed
         correction ``q = C(x - x̂_self)``, apply whatever neighbor
@@ -722,6 +1003,15 @@ class AsyncGossipRunner:
         in one batch when it catches up), and a deadline expiry simply
         proceeds on the standing estimates — a CHOCO round without a
         fresh correction is still exact.
+
+        ``buckets`` (``TreeSpec.dtype_buckets()`` spans) engages the
+        fused sparse wire under ``sparse_wire``: the correction ships
+        as ONE fused frame per neighbor (``_ASYNC_FUSED``), and an
+        arriving fused correction scatter-adds straight onto the
+        replicated estimate (``FusedFrame.apply_into``) with no dense
+        intermediate — the zero-copy consume path.  All agents of a
+        deployment must agree on ``buckets`` (the usual TreeSpec
+        deployment invariant).
         """
         a = self.agent
         x = a._choco_begin(value, require_aligned=False)
@@ -732,12 +1022,15 @@ class AsyncGossipRunner:
             compressor(x - a._choco_hat_self), np.float32
         ).ravel()
         a._int8_active = a.int8_wire
+        if buckets is not None and a.sparse_wire:
+            a._fused_spans = tuple(buckets)
         try:
             q = a._wire_round(q)
             self._pub_value, self._pub_round = q, self._round
             await self._push(q)
         finally:
             a._int8_active = False
+            a._fused_spans = None
         a._choco_hat_self = a._choco_hat_self + q
         for t in a._weights:
             a._choco_hat_nbrs.setdefault(t, np.zeros_like(x))
@@ -767,11 +1060,22 @@ class AsyncGossipRunner:
                         # once (the choco-replay-apply contract).
                         a._count("async_choco_replay_skipped")
                         stats.skipped += 1
+                        self._recycle(token, qn, None)
                         continue
                     box.choco_applied_round = q_round
-                    a._choco_hat_nbrs[token] = a._choco_hat_nbrs[
-                        token
-                    ] + np.asarray(qn, np.float32).ravel()
+                    if isinstance(qn, FusedFrame):
+                        # Zero-copy consume: the frame's sections
+                        # scatter-add straight onto the replicated
+                        # estimate (validated at unpack; a CodecError
+                        # can no longer happen here).
+                        a._apply_fused(qn, a._choco_hat_nbrs[token])
+                    else:
+                        a._choco_hat_nbrs[token] = a._choco_hat_nbrs[
+                            token
+                        ] + np.asarray(qn, np.float32).ravel()
+                        # The applied correction buffer is dead — back
+                        # to the pool for this edge's next frame.
+                        self._recycle(token, qn, None)
                     applied += 1
                     if a.trace and qtrace is not None:
                         # Applying the correction is this frame's mix hop.

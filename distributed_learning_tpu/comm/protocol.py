@@ -530,8 +530,10 @@ class ValueResponseFusedSparse(Message):
     Collapses the per-leaf framing/CRC/header overhead of gossiping a
     tree leaf by leaf to one frame per round.  ``buckets`` (the
     ``TreeSpec.dtype_buckets()`` spans) is encode-side only: the frame
-    is self-describing on decode, which returns the densified f32 wire
-    vector."""
+    is self-describing on decode.  Receive side, ``value`` is a lazy
+    (but fully validated) ``tensor_codec.FusedFrame``: densify with
+    ``np.asarray`` / ``densify(out=scratch)``, or skip the dense
+    intermediate entirely with ``apply_into(target, scale=...)``."""
 
     TYPE_CODE: ClassVar[int] = 15
     round_id: int = 0
@@ -564,14 +566,19 @@ class ValueResponseFusedSparse(Message):
 
     @classmethod
     def _unpack(cls, buf: bytes) -> "ValueResponseFusedSparse":
-        from distributed_learning_tpu.comm.tensor_codec import (
-            decode_fused_sparse,
-        )
+        from distributed_learning_tpu.comm.tensor_codec import FusedFrame
 
         r, i, n = struct.unpack_from("<qqI", buf, 0)
+        # Lazy receive (zero-copy wire path): the frame is VALIDATED
+        # here — CRC, section walk, bounds — so the CodecError drop
+        # discipline is unchanged, but densify/scatter is deferred to
+        # the consumer, which can decode into its own scratch ravel or
+        # apply the sections straight onto a live target
+        # (FusedFrame.apply_into).  ``np.asarray(msg.value)`` densifies
+        # on demand for spec-less callers.
         return cls(
             round_id=r, iteration=i,
-            value=decode_fused_sparse(buf[20 : 20 + n]),
+            value=FusedFrame(buf[20 : 20 + n]),
             trace=_unpack_trace(buf, 20 + n),
         )
 
@@ -643,18 +650,27 @@ class AsyncValue(Message):
     @classmethod
     def _unpack(cls, buf: bytes) -> "AsyncValue":
         from distributed_learning_tpu.comm.tensor_codec import (
-            decode_fused_sparse,
-            decode_sparse,
+            DenseFrame,
+            FusedFrame,
+            SparseFrame,
         )
 
         r, gen, stale, kind, n = struct.unpack_from("<qqqBI", buf, 0)
         body = buf[29 : 29 + n]
+        # Lazy receive (zero-copy wire path): construction VALIDATES
+        # the payload (so unpack_message's CodecError drop discipline
+        # is unchanged — a corrupt frame still dies here, on the mux
+        # task, before any consumer sees it), but the densify is
+        # deferred: the async runner decodes dense/sparse payloads into
+        # its per-peer scratch ravel at dispatch, and fused payloads
+        # scatter straight onto the CHOCO target (apply_into) with no
+        # dense intermediate at all.
         if kind == _ASYNC_SPARSE:
-            value = decode_sparse(body)
+            value = SparseFrame(body)
         elif kind == _ASYNC_FUSED:
-            value = decode_fused_sparse(body)
+            value = FusedFrame(body)
         else:
-            value = decode_tensor(body)
+            value = DenseFrame(body)
         return cls(
             round_id=r, generation=gen, staleness=stale,
             value=value, kind=kind, trace=_unpack_trace(buf, 29 + n),

@@ -47,6 +47,10 @@ __all__ = [
     "decode_sparse",
     "encode_fused_sparse",
     "decode_fused_sparse",
+    "decode_fused_apply",
+    "FusedFrame",
+    "DenseFrame",
+    "SparseFrame",
     "top_k_sparse",
     "FLAG_BF16_COMPRESSED",
     "FLAG_INT8_COMPRESSED",
@@ -167,8 +171,27 @@ def encode_tensor(x: np.ndarray, *, bf16_wire: bool = False,
     return header + prefix + payload.tobytes()
 
 
-def decode_tensor(buf: bytes) -> np.ndarray:
-    """Inverse of :func:`encode_tensor` (bf16 wire data returns as f32)."""
+def _check_out(out: np.ndarray, count: int) -> None:
+    """Validate a caller-supplied scratch ravel for the ``out=`` decode
+    contract: C-contiguous writable f32 of exactly ``count`` elements.
+    A mismatch is a caller bug (ValueError), never a wire error."""
+    if not isinstance(out, np.ndarray):
+        raise ValueError("out= must be a numpy ndarray")
+    if out.dtype != np.dtype(np.float32):
+        raise ValueError(f"out= must be float32, got {out.dtype}")
+    if not out.flags["C_CONTIGUOUS"] or not out.flags["WRITEABLE"]:
+        raise ValueError("out= must be C-contiguous and writable")
+    if out.size != count:
+        raise ValueError(
+            f"out= holds {out.size} elements, frame decodes {count}"
+        )
+
+
+def _parse_tensor(buf: bytes):
+    """Header parse + full length validation of a dense tensor frame —
+    the O(1) half of :func:`decode_tensor`, shared with the lazy
+    :class:`DenseFrame` payload.  Returns ``(code, flags, dims, dtype,
+    scale, payload_offset, count, data)``."""
     if len(buf) < 4:
         raise ValueError("tensor frame too short")
     code, flags, ndim, _ = struct.unpack_from("<BBBB", buf, 0)
@@ -193,9 +216,24 @@ def decode_tensor(buf: bytes) -> np.ndarray:
             f"tensor frame truncated: want {expect} payload bytes, "
             f"have {len(data)}"
         )
+    return code, flags, dims, dtype, scale, offset, count, data
+
+
+def decode_tensor(buf: bytes, *, out: "np.ndarray" = None) -> np.ndarray:
+    """Inverse of :func:`encode_tensor` (bf16 wire data returns as f32).
+
+    ``out=`` (optional) is a reusable f32 scratch ravel of exactly the
+    frame's element count: the decode writes into it (every element —
+    prior contents never leak) and returns it reshaped, skipping the
+    per-frame allocation.  Bytes are identical to the allocating path.
+    """
+    code, flags, dims, dtype, scale, offset, count, data = \
+        _parse_tensor(buf)
+    if out is not None:
+        _check_out(out, count)
     if (
         flags & (FLAG_BF16_COMPRESSED | FLAG_INT8_COMPRESSED)
-        and len(buf) == offset + expect
+        and len(buf) == offset + len(data)
         and code in (5, 7)
     ):
         # Native whole-frame decode for the converting layouts (bf16 and
@@ -204,14 +242,21 @@ def decode_tensor(buf: bytes) -> np.ndarray:
         # (tolerated here) also stays on the Python path.
         eng = _wire_engine()
         if eng is not None:
-            out = np.empty(dims, np.float32)
-            if eng.decode_dense(buf, out) == 0:
-                return out
+            target = out.reshape(dims) if out is not None \
+                else np.empty(dims, np.float32)
+            if eng.decode_dense(buf, target) == 0:
+                return target
     x = np.frombuffer(data, dtype=dtype).reshape(dims)
     if flags & FLAG_BF16_COMPRESSED:
-        x = native.bf16_to_f32(x)
+        # The converters ravel: reshape back so the 0-d/N-d frame shape
+        # survives the fallback path exactly as it does in-engine.
+        x = native.bf16_to_f32(x).reshape(dims)
     elif flags & FLAG_INT8_COMPRESSED:
-        x = native.i8_to_f32(x, scale)
+        x = native.i8_to_f32(x, scale).reshape(dims)
+    if out is not None:
+        ret = out.reshape(dims)
+        np.copyto(ret, x, casting="unsafe")
+        return ret
     return x
 
 
@@ -260,8 +305,9 @@ def encode_sparse(x: np.ndarray, *, bf16_wire: bool = False,
     )
 
 
-def decode_sparse(buf: bytes) -> np.ndarray:
-    """Inverse of :func:`encode_sparse`; returns the densified array."""
+def _parse_sparse(buf: bytes):
+    """The O(k) half of :func:`decode_sparse`: full validation + value
+    decode, NO densification.  Returns ``(dims, count, idx, vals)``."""
     if len(buf) < 4:
         raise ValueError("sparse frame too short")
     magic, _flags, ndim, _ = struct.unpack_from("<BBBB", buf, 0)
@@ -291,14 +337,33 @@ def decode_sparse(buf: bytes) -> np.ndarray:
     if len(idx_bytes) != 4 * k:
         raise ValueError("sparse frame truncated in indices")
     idx = np.frombuffer(idx_bytes, dtype=np.uint32)
+    offset += 4 * k
     if k and int(idx.max()) >= count:
         raise ValueError("sparse index out of range")
-    vals = decode_tensor(buf[offset + 4 * k :])
+    vals = decode_tensor(buf[offset:])
     if vals.shape != (k,):
         raise ValueError(f"sparse frame value count {vals.shape} != {k}")
-    out = np.zeros(count, dtype=vals.dtype)
-    out[idx] = vals
-    return out.reshape(dims)
+    return dims, count, idx, vals
+
+
+def decode_sparse(buf: bytes, *, out: "np.ndarray" = None) -> np.ndarray:
+    """Inverse of :func:`encode_sparse`; returns the densified array.
+
+    ``out=`` (optional) is a reusable f32 scratch ravel of the frame's
+    dense element count: the decode zero-fills it, scatters into it,
+    and returns it reshaped — prior (dirty) contents never leak.  The
+    result dtype is then f32 regardless of the value section's dtype
+    (the scatter casts on assignment, same values as the allocating
+    path for the f32-sourced wire modes)."""
+    dims, count, idx, vals = _parse_sparse(buf)
+    if out is not None:
+        _check_out(out, count)
+        out.fill(0.0)
+        out[idx] = vals
+        return out.reshape(dims)
+    dense = np.zeros(count, dtype=vals.dtype)
+    dense[idx] = vals
+    return dense.reshape(dims)
 
 
 # --------------------------------------------------------------------- #
@@ -440,14 +505,9 @@ def _encode_fused_sparse_py(flat: np.ndarray, buckets, modes) -> bytes:
     return body + struct.pack("<I", native.crc32(body))
 
 
-def decode_fused_sparse(buf: bytes) -> np.ndarray:
-    """Inverse of :func:`encode_fused_sparse`; returns the densified flat
-    f32 wire vector (the receiver rebuilds the pytree via its own
-    ``TreeSpec`` — the deployment invariant: same model, same spec).
-
-    Corruption discipline (native and Python paths alike): the frame crc
-    is verified and every section header bounds-checked BEFORE the first
-    scatter write; violations raise :class:`CodecError`."""
+def _parse_fused_header(buf: bytes) -> Tuple[int, int]:
+    """Shared header prelude of the fused read paths: returns
+    ``(nbuckets, total)`` or raises :class:`CodecError`."""
     if len(buf) < 12:
         raise CodecError("fused sparse frame too short")
     magic, version, nbuckets, _r, total = struct.unpack_from(
@@ -464,12 +524,39 @@ def decode_fused_sparse(buf: bytes) -> np.ndarray:
         raise CodecError(
             f"unsupported fused sparse frame version {version}"
         )
+    return nbuckets, total
+
+
+def decode_fused_sparse(buf: bytes, *, out: "np.ndarray" = None) -> np.ndarray:
+    """Inverse of :func:`encode_fused_sparse`; returns the densified flat
+    f32 wire vector (the receiver rebuilds the pytree via its own
+    ``TreeSpec`` — the deployment invariant: same model, same spec).
+
+    ``out=`` (optional) is a reusable f32 scratch ravel of ``total``
+    elements (the zero-copy receive path): the decode zero-fills it
+    between validation and scatter, so dirty scratch never leaks into
+    untouched positions, and returns it instead of allocating.
+
+    Corruption discipline (native and Python paths alike): the frame crc
+    is verified and every section header bounds-checked BEFORE the first
+    scatter write into a freshly-allocated ravel; violations raise
+    :class:`CodecError`.  With ``out=``, a frame the ORACLE path rejects
+    mid-walk may leave earlier buckets' writes in the scratch — the
+    scratch contract is that a failed decode leaves ``out`` unspecified
+    (the caller drops the frame and the next decode zero-fills)."""
+    nbuckets, total = _parse_fused_header(buf)
+    if out is not None:
+        _check_out(out, total)
     eng = _wire_engine()
     if eng is not None:
-        out = np.zeros(total, np.float32)
-        status = eng.decode_fused(buf, out)
+        # The native decode zero-fills the ravel itself (between its
+        # validation walk and the scatter), so np.empty — not np.zeros —
+        # is correct here: the O(total) clear happens once, page-fault
+        # batched, inside the engine.
+        target = out if out is not None else np.empty(total, np.float32)
+        status = eng.decode_fused(buf, target)
         if status == 0:
-            return out
+            return target
         if status != native_wire.ERR_UNSUPPORTED:
             raise CodecError(
                 native_wire.CORRUPT_MESSAGES.get(
@@ -478,18 +565,18 @@ def decode_fused_sparse(buf: bytes) -> np.ndarray:
             )
         # A valid frame with a value dtype the native engine does not
         # speak: the Python oracle below decodes it.
-    return _decode_fused_sparse_py(buf, nbuckets, total)
+    return _decode_fused_sparse_py(buf, nbuckets, total, out=out)
 
 
-def _decode_fused_sparse_py(buf: bytes, nbuckets: int,
-                            total: int) -> np.ndarray:
-    """The authoritative Python decode (header pre-parsed): crc first,
-    then per-section bounds checks, then the scatter."""
+def _iter_fused_sections(buf: bytes, nbuckets: int, total: int):
+    """Walk a fused frame's sections with full validation (crc checked
+    FIRST, then per-section bounds/range/shape), yielding
+    ``(idx: uint32[k], vals: ndarray[k])`` per bucket — the shared core
+    of the Python decode/apply/validate paths."""
     body_end = len(buf) - 4
     (crc,) = struct.unpack_from("<I", buf, body_end)
     if native.crc32(buf[:body_end]) != crc:
         raise CodecError("fused sparse frame checksum mismatch")
-    out = np.zeros(total, np.float32)
     off = 8
     for _ in range(nbuckets):
         if body_end < off + 4:
@@ -522,10 +609,173 @@ def _decode_fused_sparse_py(buf: bytes, nbuckets: int,
             raise CodecError(
                 f"fused sparse value count {vals.shape} != {k}"
             )
-        out[idx] = vals.astype(np.float32)
+        yield idx, vals
     if off != body_end:
         raise CodecError("fused sparse frame section out of bounds")
+
+
+def _decode_fused_sparse_py(buf: bytes, nbuckets: int, total: int,
+                            out: "np.ndarray" = None) -> np.ndarray:
+    """The authoritative Python decode (header pre-parsed): crc first,
+    then per-section bounds checks, then the scatter.  ``out`` (when
+    given) is zero-filled first so dirty scratch never leaks."""
+    if out is None:
+        out = np.zeros(total, np.float32)
+    else:
+        out.fill(0.0)
+    for idx, vals in _iter_fused_sections(buf, nbuckets, total):
+        out[idx] = vals.astype(np.float32)
     return out
+
+
+def decode_fused_apply(buf: bytes, target: np.ndarray, *,
+                       scale: float = 1.0) -> np.ndarray:
+    """Scatter-ADD a fused sparse frame straight into a live f32 ravel
+    (``target[idx] += scale * vals``) with NO dense intermediate — the
+    fused consume primitive for CHOCO hat updates.
+
+    For the duplicate-free frames the encoder produces the result is
+    ulp-identical to ``target += scale * decode_fused_sparse(buf)``
+    (untouched positions keep their exact bytes, which the dense form
+    only perturbs at ``-0.0``).  Corruption discipline is strict on BOTH
+    paths here: the whole frame is validated before the first add, so a
+    :class:`CodecError` guarantees ``target`` is untouched — required,
+    since the target is live state, not scratch.  Returns ``target``."""
+    nbuckets, total = _parse_fused_header(buf)
+    _check_out(target, total)
+    scale = float(scale)
+    eng = _wire_engine()
+    if eng is not None:
+        status = eng.decode_apply(buf, target, scale)
+        if status == 0:
+            return target
+        if status != native_wire.ERR_UNSUPPORTED:
+            raise CodecError(
+                native_wire.CORRUPT_MESSAGES.get(
+                    status, f"wire status {status}"
+                )
+            )
+    # Python oracle: materialize (and thereby validate) EVERY section
+    # before the first add — a corrupt later bucket must not leave a
+    # half-applied update in live state.
+    sections = list(_iter_fused_sections(buf, nbuckets, total))
+    s = np.float32(scale)
+    for idx, vals in sections:
+        np.add.at(target, idx, s * vals.astype(np.float32))
+    return target
+
+
+# --------------------------------------------------------------------- #
+# Lazy receive payloads (zero-copy wire path)                            #
+#                                                                        #
+# The comm layer unpacks message bodies on the mux task, but the scratch #
+# ravel a frame should decode into is owned by the ROUND task (the       #
+# runner's per-edge scratch pool).  These wrappers split the pipeline:   #
+# construction VALIDATES the frame (corruption still raises CodecError   #
+# at unpack time, preserving the mux drop discipline) but defers the     #
+# O(total) densify/apply to the consumer, which passes its own ``out=``  #
+# scratch or applies the frame in place.                                 #
+# --------------------------------------------------------------------- #
+class DenseFrame:
+    """A validated, not-yet-decoded dense tensor frame.
+
+    Construction is O(1) (header + length checks); :meth:`densify` runs
+    the conversion, into ``out=`` scratch when given."""
+
+    __slots__ = ("buf", "shape", "size")
+
+    def __init__(self, buf: bytes):
+        _code, _flags, dims, _dtype, _scale, _off, count, _data = \
+            _parse_tensor(buf)
+        self.buf = buf
+        self.shape = tuple(dims)
+        self.size = count
+
+    def densify(self, out: "np.ndarray" = None) -> np.ndarray:
+        return decode_tensor(self.buf, out=out)
+
+    def __array__(self, dtype=None, copy=None):
+        dense = self.densify()
+        return dense if dtype is None else dense.astype(dtype)
+
+
+class SparseFrame:
+    """A validated sparse frame whose O(k) parse (indices + values) ran
+    at construction; only the O(total) densification is deferred."""
+
+    __slots__ = ("shape", "size", "idx", "vals")
+
+    def __init__(self, buf: bytes):
+        dims, count, idx, vals = _parse_sparse(buf)
+        self.shape = tuple(dims)
+        self.size = count
+        self.idx = idx
+        self.vals = vals
+
+    def densify(self, out: "np.ndarray" = None) -> np.ndarray:
+        if out is not None:
+            _check_out(out, self.size)
+            out.fill(0.0)
+            out[self.idx] = self.vals
+            return out.reshape(self.shape)
+        dense = np.zeros(self.size, dtype=self.vals.dtype)
+        dense[self.idx] = self.vals
+        return dense.reshape(self.shape)
+
+    def __array__(self, dtype=None, copy=None):
+        dense = self.densify()
+        return dense if dtype is None else dense.astype(dtype)
+
+
+class FusedFrame:
+    """A validated, not-yet-densified fused sparse frame.
+
+    Construction runs the full decode-side validation walk (crc +
+    section geometry + dtype support + index range — native
+    ``dlt_wire_fused_validate`` when available, the Python walk
+    otherwise) so a corrupt frame raises :class:`CodecError` at unpack
+    time and the transport drops it; the frame then densifies into
+    caller scratch (:meth:`densify`) or scatter-adds straight into live
+    state (:meth:`apply_into`) with no dense intermediate."""
+
+    __slots__ = ("buf", "nbuckets", "size")
+
+    def __init__(self, buf: bytes):
+        self.nbuckets, self.size = _parse_fused_header(buf)
+        eng = _wire_engine()
+        status = (
+            eng.validate_fused(buf, self.size)
+            if eng is not None else native_wire.ERR_UNSUPPORTED
+        )
+        if status not in (0, native_wire.ERR_UNSUPPORTED):
+            raise CodecError(
+                native_wire.CORRUPT_MESSAGES.get(
+                    status, f"wire status {status}"
+                )
+            )
+        if status != 0:
+            # No native engine (or a value dtype it does not speak):
+            # the Python walk is the validating authority.
+            for _idx, _vals in _iter_fused_sections(
+                buf, self.nbuckets, self.size
+            ):
+                pass
+        self.buf = buf
+
+    @property
+    def shape(self):
+        return (self.size,)
+
+    def densify(self, out: "np.ndarray" = None) -> np.ndarray:
+        return decode_fused_sparse(self.buf, out=out)
+
+    def apply_into(self, target: np.ndarray, *,
+                   scale: float = 1.0) -> np.ndarray:
+        return decode_fused_apply(self.buf, target, scale=scale)
+
+    def __array__(self, dtype=None, copy=None):
+        dense = self.densify()
+        return dense if dtype is None else dense.astype(dtype)
 
 
 def top_k_sparse(v: "np.ndarray", k: int):

@@ -46,7 +46,7 @@ _tried = False
 
 #: Expected ``dlt_abi_version()`` of every native library; must match
 #: DLT_ABI_VERSION in ``dlt_abi.h`` (bumped when the symbol set changes).
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _logger = logging.getLogger("dlt.native")
 

@@ -8,7 +8,10 @@
 #ifndef DLT_ABI_H_
 #define DLT_ABI_H_
 
-#define DLT_ABI_VERSION 2u
+// v3: dlt_wire_fused_apply joins the export set, and
+// dlt_wire_fused_decode's out-buffer contract changed (the decode now
+// zero-fills the ravel itself, so callers may pass dirty scratch).
+#define DLT_ABI_VERSION 3u
 
 // Transport-frame and trace-context versions, restated here so the
 // native side carries the full wire identity in one header.  The

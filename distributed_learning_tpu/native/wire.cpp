@@ -164,6 +164,20 @@ inline int8_t f32_to_i8_one(float v, float inv) {
   return static_cast<int8_t>(r);
 }
 
+// Rounding barrier: -O3 contracts ``a += s * v`` into an FMA (one
+// rounding), but the Python oracle (np.add.at of ``s * vals``) rounds
+// the multiply and the add separately.  Forcing the product through an
+// opaque register keeps the apply path bit-identical to the oracle.
+inline float fp_barrier(float x) {
+#if defined(__SSE2__)
+  __asm__("" : "+x"(x));
+#else
+  volatile float y = x;
+  x = y;
+#endif
+  return x;
+}
+
 // Python-parity int8 scale plumbing (tensor_codec.encode_tensor):
 //   scale = float(np.max(np.abs(x)) / 127.0)   # f32 max, f64 divide
 //   wire stores struct.pack('<f', scale); the kernel receives
@@ -506,6 +520,60 @@ inline uint64_t count_nonzero(const float* p, uint64_t n) {
   return k;
 }
 
+// Shared validation walk for the fused-frame read paths (decode and
+// apply): crc, then every section header bounds-checked against the
+// frame length and the ravel size — BEFORE the first write to the
+// caller's memory.  Returns 0 or a negative status.
+long long fused_validate(const uint8_t* buf, uint64_t len, uint64_t total) {
+  if (len < 12) return kErrTrunc;
+  if (buf[0] != kFusedMagic) return kErrMagic;
+  if (buf[1] != kFusedVersion) return kErrVersion;
+  const uint32_t nbuckets = buf[2];
+  if (get_u32(buf + 4) != total) return kErrTotal;
+  const uint64_t body_end = len - 4;
+  if (crc32_fast(buf, body_end, 0) != get_u32(buf + body_end)) {
+    return kErrCrc;
+  }
+  uint64_t off = 8;
+  for (uint32_t b = 0; b < nbuckets; ++b) {
+    if (off + 4 > body_end) return kErrTrunc;
+    const uint64_t k = get_u32(buf + off);
+    if (k > total) return kErrBounds;
+    off += 4;
+    if (off + 4 * k + 4 > body_end) return kErrTrunc;
+    const uint8_t* idx_p = buf + off;
+    off += 4 * k;
+    const uint64_t vlen = get_u32(buf + off);
+    off += 4;
+    if (off + vlen > body_end || vlen < 8) return kErrTrunc;
+    const uint8_t* vhdr = buf + off;
+    const uint8_t code = vhdr[0], flags = vhdr[1], ndim = vhdr[2];
+    if (ndim != 1 || get_u32(vhdr + 4) != k) return kErrBounds;
+    uint8_t mode;
+    if (code == kDtypeF32 && flags == 0) {
+      mode = kModeF32;
+    } else if (code == kDtypeBf16 && flags == kFlagBf16) {
+      mode = kModeBf16;
+    } else if (code == kDtypeI8 && flags == kFlagI8) {
+      mode = kModeI8;
+    } else {
+      return kErrUnsupported;  // caller re-decodes via the Python oracle
+    }
+    if (vlen != vlen_of(mode, k)) return kErrBounds;
+    // Branchless max over the index section (vectorizes), one compare.
+    uint32_t mx = 0;
+    for (uint64_t i = 0; i < k; ++i) {
+      const uint32_t u = get_u32(idx_p + 4 * i);
+      mx = u > mx ? u : mx;
+    }
+    if (k && mx >= total) return kErrRange;
+    off += vlen;
+  }
+  if (off != body_end) return kErrBounds;  // trailing slack between
+                                           // sections and crc
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -665,59 +733,18 @@ long long dlt_wire_fused_encode(
 // Decode: crc first, then a full bounds-checking validation walk over
 // every section header, and only then the scatter pass into the ravel —
 // a corrupt frame can never write out, let alone out of bounds.
-// ``out`` is the caller's zeroed f32 ravel of ``total`` elements.
+// ``out`` is the caller's f32 ravel of ``total`` elements; its prior
+// contents are IGNORED (the decode zero-fills between validation and
+// scatter), so per-edge scratch buffers can be handed back dirty.
 long long dlt_wire_fused_decode(const uint8_t* buf, uint64_t len, float* out,
                                 uint64_t total) {
-  if (len < 12) return kErrTrunc;
-  if (buf[0] != kFusedMagic) return kErrMagic;
-  if (buf[1] != kFusedVersion) return kErrVersion;
+  const long long st = fused_validate(buf, len, total);
+  if (st != 0) return st;
   const uint32_t nbuckets = buf[2];
-  if (get_u32(buf + 4) != total) return kErrTotal;
-  const uint64_t body_end = len - 4;
-  if (crc32_fast(buf, body_end, 0) != get_u32(buf + body_end)) {
-    return kErrCrc;
-  }
-  // Validation walk: section geometry + dtype support + index range.
-  uint64_t off = 8;
-  for (uint32_t b = 0; b < nbuckets; ++b) {
-    if (off + 4 > body_end) return kErrTrunc;
-    const uint64_t k = get_u32(buf + off);
-    if (k > total) return kErrBounds;
-    off += 4;
-    if (off + 4 * k + 4 > body_end) return kErrTrunc;
-    const uint8_t* idx_p = buf + off;
-    off += 4 * k;
-    const uint64_t vlen = get_u32(buf + off);
-    off += 4;
-    if (off + vlen > body_end || vlen < 8) return kErrTrunc;
-    const uint8_t* vhdr = buf + off;
-    const uint8_t code = vhdr[0], flags = vhdr[1], ndim = vhdr[2];
-    if (ndim != 1 || get_u32(vhdr + 4) != k) return kErrBounds;
-    uint8_t mode;
-    if (code == kDtypeF32 && flags == 0) {
-      mode = kModeF32;
-    } else if (code == kDtypeBf16 && flags == kFlagBf16) {
-      mode = kModeBf16;
-    } else if (code == kDtypeI8 && flags == kFlagI8) {
-      mode = kModeI8;
-    } else {
-      return kErrUnsupported;  // caller re-decodes via the Python oracle
-    }
-    if (vlen != vlen_of(mode, k)) return kErrBounds;
-    // Branchless max over the index section (vectorizes), one compare.
-    uint32_t mx = 0;
-    for (uint64_t i = 0; i < k; ++i) {
-      const uint32_t u = get_u32(idx_p + 4 * i);
-      mx = u > mx ? u : mx;
-    }
-    if (k && mx >= total) return kErrRange;
-    off += vlen;
-  }
-  if (off != body_end) return kErrBounds;  // trailing slack between
-                                           // sections and crc
   prefault_writable(out, total * 4);
+  std::memset(out, 0, total * 4);
   // Scatter walk: fused gather-position + wire->f32 conversion.
-  off = 8;
+  uint64_t off = 8;
   for (uint32_t b = 0; b < nbuckets; ++b) {
     const uint64_t k = get_u32(buf + off);
     const uint8_t* idx_p = buf + off + 4;
@@ -769,6 +796,65 @@ long long dlt_wire_fused_decode(const uint8_t* buf, uint64_t len, float* out,
       const int8_t* q = reinterpret_cast<const int8_t*>(val_p + 4);
       for (uint64_t i = 0; i < k; ++i) {
         out[get_u32(idx_p + 4 * i)] = static_cast<float>(q[i]) * scale;
+      }
+      off += 4 + 4 * k + 4 + 12 + k;
+    }
+  }
+  return 0;
+}
+
+// Validate-only entry: the full decode-side walk (crc + section
+// geometry + dtype support + index range) with no output buffer at all
+// — the lazy-payload path (comm/tensor_codec.py FusedFrame) rejects
+// corrupt frames at unpack time while deferring the densify/apply to
+// the consumer that owns the scratch.
+long long dlt_wire_fused_validate(const uint8_t* buf, uint64_t len,
+                                  uint64_t total) {
+  return fused_validate(buf, len, total);
+}
+
+// Apply: scatter-ADD the frame's sections straight into a live f32
+// ravel (``target[idx] += scale * val``) with no dense intermediate —
+// the CHOCO hat update consumes a correction frame without ever
+// materializing it.  Same validate-then-write discipline as decode: a
+// corrupt frame returns a negative status before the first add.
+// Accumulation is np.add.at semantics (duplicate indices add once per
+// occurrence, sequentially); honestly-encoded frames carry strictly
+// ascending positions, for which this is ulp-identical to
+// decode-then-``target += scale * dense``.  Deliberately scalar: a
+// SIMD gather-add-scatter would lose one addition per duplicate lane.
+long long dlt_wire_fused_apply(const uint8_t* buf, uint64_t len,
+                               float* target, uint64_t total, float scale) {
+  const long long st = fused_validate(buf, len, total);
+  if (st != 0) return st;
+  const uint32_t nbuckets = buf[2];
+  uint64_t off = 8;
+  for (uint32_t b = 0; b < nbuckets; ++b) {
+    const uint64_t k = get_u32(buf + off);
+    const uint8_t* idx_p = buf + off + 4;
+    const uint8_t* vhdr = buf + off + 4 + 4 * k + 4;
+    const uint8_t code = vhdr[0], flags = vhdr[1];
+    const uint8_t* val_p = vhdr + 8;
+    if (code == kDtypeF32 && flags == 0) {
+      for (uint64_t i = 0; i < k; ++i) {
+        target[get_u32(idx_p + 4 * i)] +=
+            fp_barrier(scale * get_f32(val_p + 4 * i));
+      }
+      off += 4 + 4 * k + 4 + 8 + 4 * k;
+    } else if (code == kDtypeBf16 && flags == kFlagBf16) {
+      for (uint64_t i = 0; i < k; ++i) {
+        const uint16_t bits = static_cast<uint16_t>(val_p[2 * i]) |
+                              (static_cast<uint16_t>(val_p[2 * i + 1]) << 8);
+        target[get_u32(idx_p + 4 * i)] +=
+            fp_barrier(scale * bf16_to_f32_one(bits));
+      }
+      off += 4 + 4 * k + 4 + 8 + 2 * k;
+    } else {  // int8
+      const float q_scale = get_f32(val_p);
+      const int8_t* q = reinterpret_cast<const int8_t*>(val_p + 4);
+      for (uint64_t i = 0; i < k; ++i) {
+        target[get_u32(idx_p + 4 * i)] +=
+            fp_barrier(scale * (static_cast<float>(q[i]) * q_scale));
       }
       off += 4 + 4 * k + 4 + 12 + k;
     }

@@ -38,6 +38,8 @@ __all__ = [
     "available",
     "encode_fused",
     "decode_fused",
+    "decode_apply",
+    "validate_fused",
     "encode_dense",
     "decode_dense",
     "crc32",
@@ -104,6 +106,15 @@ def _configure(lib: ctypes.CDLL) -> None:
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
     ]
     lib.dlt_wire_fused_decode.restype = ctypes.c_longlong
+    lib.dlt_wire_fused_apply.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_float,
+    ]
+    lib.dlt_wire_fused_apply.restype = ctypes.c_longlong
+    lib.dlt_wire_fused_validate.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.dlt_wire_fused_validate.restype = ctypes.c_longlong
     lib.dlt_wire_dense_encode.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint32,
         ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
@@ -213,19 +224,52 @@ def encode_fused(
 
 
 def decode_fused(buf: bytes, out: np.ndarray) -> int:
-    """Decode one fused sparse frame into the caller's ZEROED f32 ravel.
+    """Decode one fused sparse frame into the caller's f32 ravel.
 
-    Returns 0 on success or :data:`ERR_UNSUPPORTED` (caller falls back
-    to the Python oracle); corrupt frames return their negative status
-    (caller raises ``CodecError`` with :data:`CORRUPT_MESSAGES`).  The
-    native side verifies the crc and bounds-checks every section header
-    BEFORE the first scatter write.
+    The ravel's prior contents are ignored — the native side zero-fills
+    it between validation and scatter, so reused (dirty) scratch
+    buffers are safe.  Returns 0 on success or :data:`ERR_UNSUPPORTED`
+    (caller falls back to the Python oracle); corrupt frames return
+    their negative status (caller raises ``CodecError`` with
+    :data:`CORRUPT_MESSAGES`).  The native side verifies the crc and
+    bounds-checks every section header BEFORE the first write.
     """
     lib = _load()
     assert lib is not None, "decode_fused requires available()"
     return int(lib.dlt_wire_fused_decode(
         buf, ctypes.c_uint64(len(buf)),
         out.ctypes.data, ctypes.c_uint64(out.size),
+    ))
+
+
+def decode_apply(buf: bytes, target: np.ndarray, scale: float = 1.0) -> int:
+    """Scatter-ADD one fused sparse frame into a live f32 ravel
+    (``target[idx] += scale * vals``), no dense intermediate.
+
+    Same status discipline and validate-before-first-write guarantee as
+    :func:`decode_fused`; untouched positions of ``target`` keep their
+    exact bytes.  For the duplicate-free frames the encoder produces,
+    the result is ulp-identical to decode-then-``target += scale *
+    dense``.
+    """
+    lib = _load()
+    assert lib is not None, "decode_apply requires available()"
+    return int(lib.dlt_wire_fused_apply(
+        buf, ctypes.c_uint64(len(buf)),
+        target.ctypes.data, ctypes.c_uint64(target.size),
+        ctypes.c_float(scale),
+    ))
+
+
+def validate_fused(buf: bytes, total: int) -> int:
+    """Run the full decode-side validation walk (crc + section geometry
+    + dtype support + index range) with no output buffer — the
+    lazy-payload path's unpack-time corruption check.  Same status
+    discipline as :func:`decode_fused`."""
+    lib = _load()
+    assert lib is not None, "validate_fused requires available()"
+    return int(lib.dlt_wire_fused_validate(
+        buf, ctypes.c_uint64(len(buf)), ctypes.c_uint64(total),
     ))
 
 

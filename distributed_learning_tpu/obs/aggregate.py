@@ -1131,6 +1131,17 @@ _EDGE_COUNTER_FIELDS = (
     ("retries", "comm.edge.retries/"),
 )
 
+#: Decode scratch-pool attribution (docs/wire.md §Zero-copy receive
+#: path): the async runner labels every pool hit/miss with the frame's
+#: inbound edge.  Attached to an edge entry as a ``"scratch"`` sub-dict
+#: only when the counters exist, so pre-scratch streams keep their
+#: exact profile shape.
+_SCRATCH_COUNTER_FIELDS = (
+    ("hits", "comm.wire.scratch_hits/"),
+    ("misses", "comm.wire.scratch_misses/"),
+    ("bytes", "comm.wire.scratch_bytes/"),
+)
+
 
 def _bare_edge(name: str, prefix: str) -> Optional[str]:
     """The ``src->dst`` edge label of a BARE per-edge counter name
@@ -1159,7 +1170,10 @@ def edge_profile_from_registry(
     wall-clock minus the frame's wire-carried ``TraceContext.t_wall``
     send stamp, so it needs tracing on); per-edge mix staleness from
     ``comm.edge.staleness/<edge>``; injected-fault attribution from the
-    ``comm.faults.<kind>/<edge>`` counters.  ``counters`` overrides the
+    ``comm.faults.<kind>/<edge>`` counters; decode scratch-pool
+    attribution (a ``"scratch"`` sub-dict, present only when the async
+    runner's zero-copy receive path ran) from the
+    ``comm.wire.scratch_{hits,misses,bytes}/<edge>`` labeled copies.  ``counters`` overrides the
     registry totals for replayed streams, and ``sketches`` switches the
     latency/staleness statistics to the merged-sketch path (marked per
     edge as ``"quantiles": "sketch" | "exact"``, with ring ``evicted``
@@ -1195,6 +1209,12 @@ def edge_profile_from_registry(
             kind, _slash, label = rest.partition("/")
             if label and "->" in label and "/" not in label:
                 entry(label)["faults"][kind] = int(total)
+        for field, prefix in _SCRATCH_COUNTER_FIELDS:
+            edge = _bare_edge(name, prefix)
+            if edge is not None:
+                entry(edge).setdefault("scratch", {})[field] = (
+                    float(total) if field == "bytes" else int(total)
+                )
 
     lat: Dict[str, List[float]] = {}
     stale: Dict[str, List[float]] = {}

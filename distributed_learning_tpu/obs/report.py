@@ -200,7 +200,11 @@ def format_edge_profile(profile: dict) -> str:
     """Render :func:`~distributed_learning_tpu.obs.aggregate.
     edge_profile_from_registry` output: one row per directed edge —
     volume, throughput, retries, trace-derived latency percentiles,
-    mix staleness, and injected-fault attribution."""
+    mix staleness, and injected-fault attribution.  When any edge
+    carries decode scratch-pool attribution (the async runner's
+    zero-copy receive path, docs/wire.md), a second subtable breaks
+    hits/misses/bytes down per inbound edge; scratch-less profiles
+    render byte-identically to the pre-scratch table."""
     edges = profile.get("edges") or {}
     window = profile.get("window_s") or 0.0
     head = f"edge profile — {len(edges)} directed edges"
@@ -232,6 +236,26 @@ def format_edge_profile(profile: dict) -> str:
             f"{_ms(lat.get('max_s')):>8} "
             f"{stale_mean:>11} {faults:7d}"
         )
+    scratch = {
+        edge: e["scratch"] for edge, e in edges.items()
+        if e.get("scratch")
+    }
+    if scratch:
+        lines.append("  decode scratch pool (zero-copy receive path)")
+        lines.append(
+            f"  {'edge':12s} {'hits':>7} {'misses':>7} {'hit %':>7} "
+            f"{'MiB decoded':>12}"
+        )
+        for edge in sorted(scratch):
+            s = scratch[edge]
+            hits = int(s.get("hits", 0))
+            misses = int(s.get("misses", 0))
+            total = hits + misses
+            pct = f"{100.0 * hits / total:.1f}" if total else "—"
+            lines.append(
+                f"  {edge:12s} {hits:7d} {misses:7d} {pct:>7} "
+                f"{float(s.get('bytes', 0.0)) / 2**20:12.2f}"
+            )
     return "\n".join(lines)
 
 

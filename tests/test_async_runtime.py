@@ -653,6 +653,191 @@ def test_elastic_membership_death_regen_rejoin_join(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# Zero-copy receive path (ISSUE 18): scratch pool + fused CHOCO consume #
+# --------------------------------------------------------------------- #
+def test_scratch_buf_stale_size_misses_never_corrupts():
+    """The pool's size discipline, unit level: a popped buffer of the
+    wrong size must MISS (fresh ravel, miss counted), never be handed
+    back as a decode target; an exact fit is a hit and returns the very
+    same buffer."""
+    agent = ConsensusAgent("X", "127.0.0.1", 1)
+    runner = AsyncGossipRunner(agent)
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        fit = np.empty(16, np.float32)
+        assert runner._scratch_buf("p", fit, 16) is fit
+        stale = runner._scratch_buf("p", fit, 8)
+        assert stale is not fit and stale.size == 8
+        cold = runner._scratch_buf("p", None, 8)
+        assert cold.size == 8
+    counters = reg.snapshot()["counters"]
+    assert counters["comm.wire.scratch_hits"] == 1
+    assert counters["comm.wire.scratch_misses"] == 2
+    assert counters["comm.wire.scratch_bytes"] == 4 * (16 + 8 + 8)
+
+
+def test_membership_realignment_evicts_scratch_pool():
+    """The elastic-membership invalidation contract: warming rounds fill
+    the per-edge pool (misses then hits), a neighbor's death triggers a
+    generation regeneration whose NeighborhoodData broadcast EVICTS the
+    whole pool (the dead edge's buffer must not survive into the new
+    membership), and the survivors' next rounds still mix correctly —
+    the eviction costs misses, never corrupt decodes."""
+
+    async def main():
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            master = ConsensusMaster(
+                RING4, convergence_eps=1e-7, regenerate=True,
+            )
+            host, port = await master.start()
+            agents = {
+                t: ConsensusAgent(t, host, port, bf16_wire=True)
+                for t in "1234"
+            }
+            await asyncio.gather(*(a.start() for a in agents.values()))
+            runners = {
+                t: AsyncGossipRunner(
+                    agents[t], staleness_bound=1, deadline_s=0.25
+                )
+                for t in "1234"
+            }
+            rng = np.random.default_rng(3)
+            xs = {
+                t: rng.normal(size=32).astype(np.float32) for t in "1234"
+            }
+            # Six warming rounds: an edge's first buffer misses, enters
+            # the pool when its round-2 value supersedes it (end of the
+            # NEXT round), and only then can a later dispatch hit — the
+            # steady state needs a few rounds to establish.
+            for _ in range(6):
+                outs = await asyncio.gather(
+                    *(
+                        runners[t].run_async_round(xs[t])
+                        for t in "1234"
+                    )
+                )
+                xs = dict(zip("1234", outs))
+            warm = reg.snapshot()["counters"]
+            # bf16 frames densify through the pool: the first frame per
+            # edge misses, the steady state hits.
+            assert warm["comm.wire.scratch_misses"] >= 1
+            assert warm["comm.wire.scratch_hits"] >= 1
+            assert warm["comm.wire.scratch_bytes"] >= 4 * 32
+            assert any(runners[t]._scratch for t in "1234")
+            # Per-edge labeled copies (the obs-report --merge edge
+            # table's source) ride alongside the bare totals, keyed by
+            # the frame's inbound direction.
+            assert any(
+                k.startswith("comm.wire.scratch_misses/")
+                and "->" in k
+                for k in warm
+            )
+
+            # --- neighbor death -> generation realignment ------------- #
+            await agents["2"].close(drain=0)
+            deadline = asyncio.get_event_loop().time() + 10
+            while master.generation < 1:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            for t in ("1", "3", "4"):
+                for _ in range(30):
+                    if agents[t].generation == 1:
+                        break
+                    # The realignment broadcast is applied inside the
+                    # runner's own recv step: drive rounds until it
+                    # lands (the dead edge drops via deadline).
+                    xs[t] = await runners[t].run_async_round(xs[t])
+                assert agents[t].generation == 1, t
+                # The dead edge's decode buffer died with the pool; the
+                # realigned pool only ever re-admits live edges.
+                assert "2" not in runners[t]._scratch
+                assert "2" not in agents[t]._weights
+            # A few joint rounds at N-1: frame dispatch lags a round
+            # behind arrival, so the post-eviction misses need more
+            # than one round to surface in the counters.
+            for _ in range(3):
+                outs = await asyncio.gather(
+                    *(
+                        runners[t].run_async_round(xs[t])
+                        for t in ("1", "3", "4")
+                    )
+                )
+                for out in outs:
+                    assert np.isfinite(out).all() and out.shape == (32,)
+                xs.update(zip(("1", "3", "4"), outs))
+            after = reg.snapshot()["counters"]
+            # The eviction's cost model: fresh misses after realignment.
+            assert (
+                after["comm.wire.scratch_misses"]
+                > warm["comm.wire.scratch_misses"]
+            )
+            await master.shutdown()
+            for t in ("1", "3", "4"):
+                await agents[t].close(drain=0.1)
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_async_choco_fused_wire_bit_identical_to_sparse_wire():
+    """The fused-consume oracle: ``run_async_choco(buckets=...)`` under
+    ``sparse_wire`` (corrections ship as ONE fused frame and scatter-add
+    straight onto the replicated estimate — no dense intermediate) is
+    bit-identical to the same rounds on the plain sparse wire, and the
+    consume is visible as ``comm.wire.decode.apply`` spans."""
+
+    def topk(v):
+        k = max(1, v.size // 4)
+        out = np.zeros_like(v)
+        idx = np.argsort(np.abs(v))[-k:]
+        out[idx] = v[idx]
+        return out
+
+    async def run_mode(fused):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            master = ConsensusMaster(TRIANGLE, convergence_eps=1e-7)
+            host, port = await master.start()
+            agents = {
+                t: ConsensusAgent(t, host, port, sparse_wire=True)
+                for t in "ABC"
+            }
+            await asyncio.gather(*(a.start() for a in agents.values()))
+            runners = {
+                t: AsyncGossipRunner(agents[t], staleness_bound=0)
+                for t in "ABC"
+            }
+            rng = np.random.default_rng(7)
+            xs = {
+                t: rng.normal(size=24).astype(np.float32) for t in "ABC"
+            }
+            buckets = (("float32", ((0, 24),)),) if fused else None
+            for _ in range(4):
+                outs = await asyncio.gather(
+                    *(
+                        runners[t].run_async_choco(
+                            xs[t], topk, gamma=0.4, buckets=buckets
+                        )
+                        for t in "ABC"
+                    )
+                )
+                xs = dict(zip("ABC", outs))
+            spans = dict(reg.snapshot().get("spans", {}))
+            await _teardown(master, agents)
+        return xs, spans
+
+    async def main():
+        ref, ref_spans = await run_mode(fused=False)
+        got, got_spans = await run_mode(fused=True)
+        for t in "ABC":
+            assert np.array_equal(ref[t], got[t]), t
+        assert "comm.wire.decode.apply" in got_spans
+        assert "comm.wire.decode.apply" not in ref_spans
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+# --------------------------------------------------------------------- #
 # Obs: staleness feeds the straggler profile                            #
 # --------------------------------------------------------------------- #
 def test_straggler_profile_gains_staleness_vs_convergence():

@@ -181,7 +181,9 @@ def test_collect_claims_resolves_the_shipped_suppressions():
     assert findings == []
     assert {key: site.kind for key, site in claims.items()} == {
         AR_REL + "::_handle_master._inbox": "turn",
+        AR_REL + "::_handle_master._scratch": "turn",
         AR_REL + "::_handle_peer_msg._poked": "service-point",
+        AR_REL + "::_handle_peer_msg._scratch": "turn",
     }
     for site in claims.values():
         assert site.path == AR_REL
@@ -200,8 +202,12 @@ def test_unparseable_claim_reason_is_a_finding(tmp_path):
     assert len(findings) == 1
     assert findings[0].rule == schedsim.TURN_RULE
     assert "parses into no sched claim" in findings[0].message
-    # The other (untouched) suppression still resolves.
-    assert set(claims) == {AR_REL + "::_handle_peer_msg._poked"}
+    # The other (untouched) suppressions still resolve.
+    assert set(claims) == {
+        AR_REL + "::_handle_master._scratch",
+        AR_REL + "::_handle_peer_msg._poked",
+        AR_REL + "::_handle_peer_msg._scratch",
+    }
 
 
 def test_unanchored_claim_is_a_finding(tmp_path):

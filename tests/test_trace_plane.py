@@ -265,6 +265,44 @@ def test_edge_profile_table_matches_golden(capsys):
     )
 
 
+def test_edge_profile_scratch_subtable_is_conditional():
+    """ISSUE 18: the decode scratch pool attributes per inbound edge.
+    The ``"scratch"`` sub-dict (and its report subtable) appears exactly
+    when the labeled ``comm.wire.scratch_*`` counters exist — the
+    golden above pins that scratch-less profiles render unchanged."""
+    from distributed_learning_tpu.obs.report import format_edge_profile
+
+    clock = itertools.count(1000)
+    reg = MetricsRegistry(clock=lambda: float(next(clock)))
+    reg.inc("comm.edge.frames_out/a->b", 3)
+    reg.inc("comm.edge.bytes_out/a->b", 3 * 1024)
+    # The async runner's dual bump: bare run totals + the inbound-edge
+    # labeled copies (only the latter reach the edge table).
+    reg.inc("comm.wire.scratch_hits", 4)
+    reg.inc("comm.wire.scratch_hits/a->b", 4)
+    reg.inc("comm.wire.scratch_misses", 2)
+    reg.inc("comm.wire.scratch_misses/a->b", 2)
+    reg.inc("comm.wire.scratch_bytes", 6 * 1024 * 1024)
+    reg.inc("comm.wire.scratch_bytes/a->b", 6 * 1024 * 1024)
+    # A labeled-with-token copy must NOT create a phantom edge.
+    reg.inc("comm.wire.scratch_hits/a->b/a", 4)
+    profile = edge_profile_from_registry(reg)
+    assert set(profile["edges"]) == {"a->b"}
+    scr = profile["edges"]["a->b"]["scratch"]
+    assert scr == {"hits": 4, "misses": 2, "bytes": 6291456.0}
+    out = format_edge_profile(profile)
+    assert "decode scratch pool" in out
+    assert "66.7" in out          # 4 hits / 6 lookups
+    assert "6.00" in out          # MiB decoded through the pool
+    # Scratch-less profile: the subtable is absent, shape untouched.
+    bare = MetricsRegistry(clock=lambda: float(next(clock)))
+    bare.inc("comm.edge.frames_out/a->b", 1)
+    bare.inc("comm.edge.bytes_out/a->b", 64)
+    plain = edge_profile_from_registry(bare)
+    assert "scratch" not in plain["edges"]["a->b"]
+    assert "decode scratch" not in format_edge_profile(plain)
+
+
 def test_obs_report_merge_renders_edge_table(tmp_path, capsys):
     """``obs-report --merge`` shows the edge section exactly when edge
     data exists (absent -> byte-identical pre-observatory output,

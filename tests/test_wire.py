@@ -229,6 +229,165 @@ def test_wire_gauge_records_serving_path(monkeypatch):
 
 
 # --------------------------------------------------------------------- #
+# Zero-copy receive path (ISSUE 18): out= scratch, fused apply, lazy    #
+# frames                                                                #
+# --------------------------------------------------------------------- #
+def test_fused_decode_out_matrix_matches_alloc_path(wire_path):
+    """``decode_fused_sparse(out=)`` into a NaN-dirty scratch must equal
+    the allocating decode bit-for-bit across the full scenario/mode
+    matrix AND hand back the caller's scratch — the zero-copy contract:
+    no allocation, no dirty-scratch leak into untouched positions."""
+    for name, flat, buckets in _scenarios():
+        for mode in _MODES:
+            frame = encode_fused_sparse(flat, buckets, **mode)
+            ref = decode_fused_sparse(frame)
+            scratch = np.full(flat.size, np.nan, np.float32)
+            got = decode_fused_sparse(frame, out=scratch)
+            assert np.shares_memory(got, scratch) or flat.size == 0
+            np.testing.assert_array_equal(got, ref, err_msg=(name, mode))
+            # Untouched positions are exactly zero-filled, never NaN.
+            assert not np.isnan(got).any() or np.isnan(flat).any()
+
+
+def test_dense_decode_out_matrix_matches_alloc_path(wire_path):
+    """``decode_tensor(out=)``: same bytes as the allocating decode, into
+    caller scratch, for every shape and wire mode."""
+    rng = np.random.default_rng(18)
+    for shape in [(), (0,), (7,), (64, 33), (2, 3, 4)]:
+        x = rng.normal(size=shape).astype(np.float32)
+        for mode in _MODES:
+            frame = encode_tensor(x, **mode)
+            ref = decode_tensor(frame)
+            scratch = np.full(max(x.size, 1) if shape == () else x.size,
+                              np.nan, np.float32)
+            got = decode_tensor(frame, out=scratch)
+            assert got.shape == ref.shape
+            np.testing.assert_array_equal(got, ref, err_msg=(shape, mode))
+            assert np.shares_memory(got, scratch) or x.size == 0
+
+
+def test_decode_out_contract_rejects_bad_scratch(wire_path):
+    """A bad ``out=`` is a CALLER bug (ValueError before any parse work),
+    never a wire error: wrong size, dtype, layout, writability."""
+    flat = np.asarray([0.0, 1.0, 0.0, -2.0], np.float32)
+    frame = encode_fused_sparse(flat, (("float32", ((0, 4),)),))
+    with pytest.raises(ValueError, match="elements"):
+        decode_fused_sparse(frame, out=np.zeros(3, np.float32))
+    with pytest.raises(ValueError, match="float32"):
+        decode_fused_sparse(frame, out=np.zeros(4, np.float64))
+    with pytest.raises(ValueError, match="contiguous"):
+        decode_fused_sparse(frame, out=np.zeros(8, np.float32)[::2])
+    frozen = np.zeros(4, np.float32)
+    frozen.setflags(write=False)
+    with pytest.raises(ValueError, match="writ"):
+        decode_fused_sparse(frame, out=frozen)
+    with pytest.raises(ValueError, match="ndarray"):
+        decode_fused_sparse(frame, out=[0.0] * 4)
+
+
+def test_fused_apply_matches_dense_oracle_and_preserves_bytes(wire_path):
+    """``decode_fused_apply``: ulp-identical to the densify-then-add form
+    on touched positions, BYTE-identical on untouched ones (the dense
+    form perturbs ``-0.0``; the fused scatter never visits it)."""
+    for name, flat, buckets in _scenarios():
+        for mode in _MODES:
+            frame = encode_fused_sparse(flat, buckets, **mode)
+            dense = decode_fused_sparse(frame)
+            rng = np.random.default_rng(5)
+            base = rng.normal(size=flat.size).astype(np.float32)
+            sentinel = None
+            untouched = np.flatnonzero(dense == 0)
+            # Plant a -0.0 in an untouched slot: its sign bit must
+            # survive the apply (and would not survive `+= 0.5*dense`).
+            for j in untouched:
+                if flat[j] == 0:
+                    base[j] = np.float32(-0.0)
+                    sentinel = int(j)
+                    break
+            target = base.copy()
+            got = tc.decode_fused_apply(frame, target, scale=0.5)
+            assert got is target
+            ref = base + np.float32(0.5) * dense
+            np.testing.assert_array_equal(got, ref, err_msg=(name, mode))
+            if sentinel is not None:
+                assert np.signbit(got[sentinel]), (name, mode)
+
+
+def test_fused_apply_corruption_leaves_live_target_untouched(wire_path):
+    """CodecError from ``decode_fused_apply`` guarantees the target kept
+    its exact bytes — it is live CHOCO hat state, not scratch.  Replays
+    the fault-harness mutants, the adversarial crc-clean headers, and a
+    seeded corruption corpus through the apply path."""
+    rng = np.random.default_rng(77)
+    base_frames = _base_frames()
+    corpus = list(_faultplan_mutants())
+    # Seeded extra mutants: bit flips and crc-clean u32 stomps.
+    for _ in range(60):
+        frame, flat = base_frames[int(rng.integers(len(base_frames)))]
+        b = bytearray(frame)
+        if rng.integers(2):
+            pos = int(rng.integers(len(b)))
+            b[pos] ^= 1 << int(rng.integers(8))
+            corpus.append((bytes(b), flat.size))
+        else:
+            pos = int(rng.integers(8, max(9, len(b) - 8)))
+            b[pos : pos + 4] = struct.pack(
+                "<I", int(rng.choice([0xFFFFFFFF, len(b) * 2, 1 << 28]))
+            )
+            corpus.append((_recrc(bytes(b)), flat.size))
+    applied = rejected = 0
+    for mutant, total in corpus:
+        target = rng.normal(size=total).astype(np.float32)
+        before = target.tobytes()
+        try:
+            tc.decode_fused_apply(mutant, target, scale=0.5)
+            applied += 1  # survivor: landed in a value payload
+        except (CodecError, ValueError):
+            rejected += 1
+            assert target.tobytes() == before, "rejected apply wrote"
+    assert rejected >= len(_faultplan_mutants())  # all harness mutants
+
+
+def test_lazy_frames_validate_at_construction_and_defer_densify(
+    wire_path,
+):
+    """The lazy receive payloads: construction validates (corrupt frames
+    raise CodecError at unpack time, preserving the mux drop
+    discipline); densify/apply defer to caller scratch and agree with
+    the eager decodes."""
+    rng = np.random.default_rng(21)
+    flat = _sparsify(rng, rng.normal(size=512).astype(np.float32))
+    buckets = (("bfloat16", ((0, 256),)), ("float32", ((256, 256),)))
+    frame = encode_fused_sparse(flat, buckets, bf16_wire=True)
+    lazy = tc.FusedFrame(frame)
+    assert lazy.size == 512 and lazy.shape == (512,)
+    ref = decode_fused_sparse(frame)
+    scratch = np.full(512, np.nan, np.float32)
+    np.testing.assert_array_equal(lazy.densify(out=scratch), ref)
+    base = rng.normal(size=512).astype(np.float32)
+    target = base.copy()
+    lazy.apply_into(target, scale=0.25)
+    np.testing.assert_array_equal(
+        target, tc.decode_fused_apply(frame, base.copy(), scale=0.25)
+    )
+    np.testing.assert_array_equal(np.asarray(lazy), ref)
+    # Corruption is caught at CONSTRUCTION, not first densify.
+    b = bytearray(frame)
+    b[12:16] = struct.pack("<I", 0xFFFFFFFF)
+    with pytest.raises(CodecError):
+        tc.FusedFrame(_recrc(bytes(b)))
+    with pytest.raises(CodecError):
+        tc.FusedFrame(frame[: len(frame) // 2])
+    # Dense twin: same contract.
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    dlazy = tc.DenseFrame(encode_tensor(x, bf16_wire=True))
+    assert dlazy.shape == (16, 8) and dlazy.size == 128
+    dref = decode_tensor(encode_tensor(x, bf16_wire=True))
+    dscratch = np.full(128, np.nan, np.float32)
+    np.testing.assert_array_equal(dlazy.densify(out=dscratch), dref)
+
+
+# --------------------------------------------------------------------- #
 # Corruption / fuzz property test                                       #
 # --------------------------------------------------------------------- #
 def _recrc(frame: bytes) -> bytes:

@@ -52,7 +52,7 @@ def test_real_tree_contract_extracts_and_passes():
     contract, findings = wc.extract()
     assert findings == [], [str(f) for f in findings]
     # The extractor must actually SEE the surface it guards.
-    assert contract["abi_version"] == 2
+    assert contract["abi_version"] == 3
     assert contract["fused_magic"] == 0xFE
     assert contract["crc_poly"] == "0xedb88320"
     assert len(contract["type_codes"]) >= 17
@@ -113,7 +113,7 @@ def test_drift_abi_version_fails_cross_language(contract_tree):
     root, expected = contract_tree
     _mutate(
         root, "distributed_learning_tpu/native/dlt_abi.h",
-        r"#define DLT_ABI_VERSION 2u", "#define DLT_ABI_VERSION 3u",
+        r"#define DLT_ABI_VERSION 3u", "#define DLT_ABI_VERSION 4u",
     )
     fs = wc.check(repo_root=root, expected_path=expected)
     drift = [f for f in fs if f.rule == wc.CONTRACT_RULE]

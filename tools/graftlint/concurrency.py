@@ -290,6 +290,7 @@ class TaskSharedMutation(Rule):
                 "_collect_choco": "round",
                 "_consume": "round",
                 "_mix_plain": "round",
+                "_mix_pipelined": "round",
                 "_push": "round",
                 "_poke": "round",
                 "_recv_step": "round",
@@ -309,6 +310,10 @@ class TaskSharedMutation(Rule):
                 "_poked": "round",
                 # Inbox map: rounds consume, dispatch fills/evicts.
                 "_inbox": "round",
+                # Decode scratch pool (zero-copy wire path): the round
+                # task pops/returns buffers, dispatch pops at its
+                # service point and clears on membership realignment.
+                "_scratch": "round",
             },
         },
     }

@@ -343,6 +343,31 @@ def _replay() -> int:
             in_cp, ctypes.c_size_t(len(mutant)),
             ctypes.c_uint32(0),
         )
+        # ABI v3 entries (zero-copy wire path): the no-output validation
+        # walk, then the fused scatter-add into an exact-size redzoned
+        # target.  validate-before-first-write is part of the contract —
+        # a rejected apply must leave the redzoned target byte-identical
+        # (the target is live CHOCO hat state in production).
+        lib.dlt_wire_fused_validate(
+            in_cp, ctypes.c_uint64(len(mutant)), ctypes.c_uint64(total),
+        )
+        tgt_ptr, tgt_n = alloc.buf(size=max(total * 4, 1))
+        before = alloc.read(tgt_ptr, tgt_n)
+        rc = int(lib.dlt_wire_fused_apply(
+            in_cp, ctypes.c_uint64(len(mutant)),
+            ctypes.c_void_p(tgt_ptr), ctypes.c_uint64(total),
+            ctypes.c_float(0.5),
+        ))
+        if rc < 0 and alloc.read(tgt_ptr, tgt_n) != before:
+            print(
+                "native-san-replay: rejected fused_apply wrote into its "
+                "target", file=sys.stderr,
+            )
+            alloc.free(tgt_ptr)
+            alloc.free(in_ptr)
+            alloc.free(out_ptr)
+            return 5
+        alloc.free(tgt_ptr)
         alloc.free(in_ptr)
         alloc.free(out_ptr)
         raw_cases += 1
@@ -383,10 +408,26 @@ def _replay() -> int:
             alloc.free(flat_ptr)
             raw_cases += 1
 
+    # --- decode_apply ↔ Python scatter oracle under the instrumented
+    # engine (ISSUE 18): the fused in-place consume must stay
+    # ulp-identical to the numpy np.add.at reference. ----------------- #
+    apply_cases = 0
+    arng = np.random.default_rng(11)
+    for frame, flat in frames:
+        base = arng.normal(size=flat.size).astype(np.float32)
+        got = base.copy()
+        tc.decode_fused_apply(frame, got, scale=0.25)
+        ref = base.copy()
+        os.environ["DLT_NO_NATIVE"] = "1"
+        tc.decode_fused_apply(frame, ref, scale=0.25)
+        del os.environ["DLT_NO_NATIVE"]
+        np.testing.assert_array_equal(got, ref)
+        apply_cases += 1
+
     print(
         "native-san-replay: ok "
         f"(oracle={oracle_cases} fuzz={fuzz_cases} rejected={rejected} "
-        f"fault={fault_cases} raw={raw_cases})"
+        f"fault={fault_cases} raw={raw_cases} apply={apply_cases})"
     )
     return 0
 

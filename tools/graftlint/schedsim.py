@@ -255,7 +255,14 @@ class SimLoop(asyncio.AbstractEventLoop):
     # -- labels: sanitized, id-free, deterministic --------------------- #
     def _label_of(self, callback) -> str:
         owner = getattr(callback, "__self__", None)
-        if owner is not None and owner in self._task_labels:
+        try:
+            known = owner is not None and owner in self._task_labels
+        except TypeError:
+            # Bound method of an unhashable owner (e.g. the runner's
+            # tracked-send set's ``discard`` as a done callback): no
+            # task label to borrow, fall through to the qualname.
+            known = False
+        if known:
             return self._task_labels[owner]
         if isinstance(callback, functools.partial):
             return "partial:" + self._label_of(callback.func)
@@ -442,7 +449,7 @@ class SimLoop(asyncio.AbstractEventLoop):
 class MutEvent:
     """One observed mutation of a claimed shared container."""
 
-    attr: str  # "_inbox" | "_poked"
+    attr: str  # "_inbox" | "_poked" | "_scratch"
     op: str  # "remove" | "add"
     task_label: str
     on_round_task: bool
@@ -451,10 +458,10 @@ class MutEvent:
 
 
 class ClaimMonitor:
-    """Replaces a runner's ``_inbox``/``_poked`` with monitored twins
-    and records, for every mutation, which task performed it and
-    whether the round task's ``_recv_step`` frame was on the stack —
-    the two facts the sched claim kinds assert."""
+    """Replaces a runner's ``_inbox``/``_poked``/``_scratch`` with
+    monitored twins and records, for every mutation, which task
+    performed it and whether the round task's ``_recv_step`` frame was
+    on the stack — the two facts the sched claim kinds assert."""
 
     def __init__(self):
         self.events: List[MutEvent] = []
@@ -468,6 +475,10 @@ class ClaimMonitor:
     def install(self, runner) -> None:
         runner._inbox = _MonDict(self, "_inbox", runner._inbox)
         runner._poked = _MonSet(self, "_poked", runner._poked)
+        # The decode scratch pool (zero-copy wire path): its pop at the
+        # dispatch service point and its wholesale eviction on
+        # membership realignment both carry turn-discipline claims.
+        runner._scratch = _MonDict(self, "_scratch", runner._scratch)
 
     def record(self, attr: str, op: str) -> None:
         task = asyncio.current_task()
