@@ -14,6 +14,14 @@ path — and reads host dispatches per epoch off the obs
 ``trainer.dispatches`` counter (>=3 per epoch at K=1, exactly 1 per
 superstep, i.e. 1/K per epoch, fused).
 
+``run_lifted`` sweeps the ISSUE 20 lift: the configs that used to fall
+back to the per-epoch loop (CHOCO compression, per-epoch round
+schedule, async gossip, robust mixing) now compile into the superstep,
+so each gets the same K=16-vs-K=1 dispatch amortization.  ``run_adaptive``
+measures the residual-adaptive controller's communication saving:
+rounds spent to hold a matched consensus residual, adaptive vs static
+(arXiv:1910.13598's adaptive periodic averaging, in-program).
+
 Run: ``python -m benchmarks.bench_superstep``
 """
 
@@ -36,11 +44,11 @@ def _titanic_shards(n_nodes: int):
     return names, split_data(X_tr, y_tr, names), (X_te, y_te)
 
 
-def _build_trainer(superstep: int, names, shards, registry):
+def _build_trainer(superstep: int, names, shards, registry, **overrides):
     from distributed_learning_tpu.parallel.topology import Topology
     from distributed_learning_tpu.training import GossipTrainer
 
-    return GossipTrainer(
+    kw = dict(
         node_names=names,
         model="ann",
         model_kwargs={"hidden_dim": 16, "output_dim": 1},
@@ -60,6 +68,8 @@ def _build_trainer(superstep: int, names, shards, registry):
         obs=registry,
         seed=0,
     )
+    kw.update(overrides)
+    return GossipTrainer(**kw)
 
 
 def run(epochs: int | None = None, ks: Sequence[int] = (1, 4, 16)) -> Dict:
@@ -117,5 +127,150 @@ def run(epochs: int | None = None, ks: Sequence[int] = (1, 4, 16)) -> Dict:
     return out
 
 
+# The ISSUE 20 lift: configs that used to fall back to the per-epoch
+# loop, now fused into the superstep scan (schedules as traced data,
+# CHOCO/async/robust state as scan carries).
+LIFTED_CONFIGS: Dict[str, Dict] = {
+    "choco": {"compression": "top_k:0.5", "compression_gamma": 0.3},
+    "sched": {"mix_times_schedule": lambda e: 1 + (e % 2)},
+    "async": {"async_gossip": {"staleness_bound": 2,
+                               "publish_period": [1, 2, 1, 3]}},
+    "robust": {"robust_mixing": {"kind": "clip", "radius": 0.1}},
+}
+
+
+def run_lifted(epochs: int | None = None, ks: Sequence[int] = (1, 16),
+               configs: Sequence[str] | None = None) -> Dict:
+    """K=max(ks) vs K=1 epochs/sec for each previously chunk-hostile
+    config; returns ``{name: {"epochs_per_sec": {K: eps}, "speedup"}}``
+    and emits one record per config.  ``configs`` selects a subset of
+    ``LIFTED_CONFIGS`` (the smoke gate runs the two headline configs;
+    the full sweep is the __main__ / session path)."""
+    if epochs is None:
+        epochs = 32 if common.full_scale() else 16
+    kmax = max(ks)
+    if any(epochs % k for k in ks):
+        raise ValueError(f"epochs={epochs} must be divisible by each K in {ks}")
+    n_nodes = 4
+    names, shards, _test = _titanic_shards(n_nodes)
+
+    out: Dict[str, Dict] = {}
+    for name, cfg in LIFTED_CONFIGS.items():
+        if configs is not None and name not in configs:
+            continue
+        eps: Dict[int, float] = {}
+        for k in ks:
+            trainer = _build_trainer(
+                k, names, shards, MetricsRegistry(), **cfg
+            )
+            trainer.initialize_nodes()
+            trainer.train_epochs(k)  # compile + warm
+            best = 0.0
+            for _ in range(3):
+                with common.stopwatch() as t:
+                    done = 0
+                    while done < epochs:
+                        trainer.train_epochs(k)
+                        done += k
+                best = max(best, epochs / t["s"])
+            eps[k] = best
+        out[name] = {
+            "epochs_per_sec": eps,
+            "speedup": eps[kmax] / eps[1],
+        }
+        common.emit(
+            {
+                "metric": f"trainer_superstep_{name}_epochs_per_sec",
+                "value": round(eps[kmax], 2),
+                "unit": "epochs/sec",
+                "vs_baseline": round(out[name]["speedup"], 3),  # vs K=1
+                "config": f"ann(16)/titanic, {n_nodes}-node ring, "
+                          f"{name} gossip, superstep K={kmax}",
+                "speedup_vs_per_epoch": round(out[name]["speedup"], 3),
+                "epochs_timed": epochs,
+            }
+        )
+    return out
+
+
+def run_adaptive(epochs: int | None = None, superstep: int = 8) -> Dict:
+    """Rounds communicated at matched final residual, adaptive vs
+    static: a static over-provisioned budget (mix_times=6) sets the
+    residual bar; the adaptive controller (same base budget, residual
+    target slightly above the static steady state) sheds rounds until
+    the residual sits at the target.  Returns rounds/residual for both
+    phases + the saving; the matched-residual claim is
+    ``adaptive_final_residual <= target``."""
+    if epochs is None:
+        epochs = 32 if common.full_scale() else 16
+    if epochs % superstep:
+        raise ValueError(f"epochs={epochs} not divisible by K={superstep}")
+    n_nodes = 4
+    names, shards, _test = _titanic_shards(n_nodes)
+    mix_times = 6
+
+    def phase(adaptive_cfg):
+        reg = MetricsRegistry()
+        trainer = _build_trainer(
+            superstep, names, shards, reg, mix_times=mix_times,
+            adaptive_comm=adaptive_cfg,
+        )
+        trainer.initialize_nodes()
+        devs = []
+        for _ in range(epochs // superstep):
+            devs += [o["deviation"] for o in trainer.train_epochs(superstep)]
+        rounds = float(reg.counters.get("consensus.rounds_run", 0.0))
+        return rounds, devs
+
+    static_rounds, static_devs = phase(None)
+    static_dev = float(static_devs[-1])
+    # Matched-residual bar: a whisker above the static run's FINAL
+    # residual.  The controller can only shed rounds on epochs whose
+    # residual already sits under this line (late training, where the
+    # shrinking local drift makes the static budget over-provisioned),
+    # so the saving is exactly the over-service — and the adaptive run
+    # must END at or under the same bar.  Everything is deterministic
+    # on the CPU harness: the rounds counts and residuals are exact
+    # reproducible numbers, not a timing race.  (A mid-training bar
+    # saves more rounds but un-matches the final residual: the
+    # proportional controller equilibrates AROUND its target.)
+    target = max(static_dev * 1.5, 1e-12)
+    adaptive_rounds, adaptive_devs = phase(
+        {"target": target, "gain": 1.0, "min_times": 1,
+         "max_times": mix_times}
+    )
+    adaptive_dev = float(adaptive_devs[-1])
+    out = {
+        "static_rounds": static_rounds,
+        "adaptive_rounds": adaptive_rounds,
+        "static_final_residual": static_dev,
+        "adaptive_final_residual": adaptive_dev,
+        "residual_target": target,
+        "rounds_saved": static_rounds - adaptive_rounds,
+        "matched": adaptive_dev <= target,
+    }
+    common.emit(
+        {
+            "metric": "trainer_superstep_adaptive_rounds_saved",
+            "value": round(out["rounds_saved"], 1),
+            "unit": "gossip rounds",
+            "vs_baseline": round(static_rounds / max(adaptive_rounds, 1.0),
+                                 3),
+            "config": f"ann(16)/titanic, {n_nodes}-node ring, mix_times="
+                      f"{mix_times} static vs residual-adaptive, "
+                      f"K={superstep}, {epochs} epochs",
+            "static_rounds": static_rounds,
+            "adaptive_rounds": adaptive_rounds,
+            "residual_target": target,
+            "adaptive_final_residual": adaptive_dev,
+            "matched_residual": out["matched"],
+            "epochs_timed": epochs,
+        }
+    )
+    return out
+
+
 if __name__ == "__main__":
     run()
+    run_lifted()
+    run_adaptive()

@@ -76,8 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--time-varying-p", type=float, default=None)
     p.add_argument("--superstep", type=int, default=None,
                    help="epochs fused into one compiled dispatch "
-                        "(train_epochs; checkpoints land on superstep "
-                        "boundaries)")
+                        "(train_epochs; every config compiles in — "
+                        "schedules ride as traced data, CHOCO/async/"
+                        "robust state as scan carries; checkpoints land "
+                        "on superstep boundaries)")
     p.add_argument("--global-avg-every", type=int, default=None,
                    help="Gossip-PGA: exact all-reduce every H-th epoch")
     p.add_argument("--compression", default=None,
@@ -88,6 +90,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fused CHOCO k budget: per-leaf keeps each "
                         "tensor's fraction (oracle-identical), global "
                         "spends one budget per fused dtype bucket")
+    p.add_argument("--compression-error-feedback", action="store_true",
+                   help="bank the mass the compressor drops and re-offer "
+                        "it next round (EF-SGD; keeps aggressive global "
+                        "budgets convergent)")
+    p.add_argument("--adaptive-target", type=float, default=None,
+                   help="residual-adaptive communication: scale each "
+                        "epoch's gossip round budget by last epoch's "
+                        "consensus residual relative to this target "
+                        "(1 + gain*(res/target - 1), clipped)")
+    p.add_argument("--adaptive-gain", type=float, default=None,
+                   help="adaptive_comm gain (default 1.0; 0 = static)")
+    p.add_argument("--adaptive-max-times", type=int, default=None,
+                   help="adaptive_comm round-budget ceiling")
     p.add_argument("--augment", action="store_true",
                    help="jitted RandomCrop+Flip train augmentation")
     p.add_argument("--remat", action="store_true",
@@ -184,6 +199,15 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             setattr(cfg, field, value)
     if args.chebyshev:
         cfg.chebyshev = True
+    if args.compression_error_feedback:
+        cfg.compression_error_feedback = True
+    if args.adaptive_target is not None:
+        adaptive = {"target": args.adaptive_target}
+        if args.adaptive_gain is not None:
+            adaptive["gain"] = args.adaptive_gain
+        if args.adaptive_max_times is not None:
+            adaptive["max_times"] = args.adaptive_max_times
+        cfg.adaptive_comm = adaptive
     if args.augment:
         cfg.augment = True
     if args.remat:

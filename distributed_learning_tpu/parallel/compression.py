@@ -598,11 +598,16 @@ class FusedCompressor:
 
 # --------------------------------------------------------------------- #
 class ChocoState(NamedTuple):
-    """Stacked CHOCO state: iterates, public estimates, PRNG key."""
+    """Stacked CHOCO state: iterates, public estimates, PRNG key, and —
+    only when the engine runs with ``error_feedback=True`` — the EF
+    residual accumulator (``ef=None`` otherwise: None is an empty
+    pytree, so the 3-field layout, checkpoints, and scan carries of the
+    default configuration are unchanged)."""
 
     x: Pytree
     xhat: Pytree
     key: jax.Array
+    ef: Any = None
 
 
 class ChocoGossipEngine:
@@ -648,6 +653,7 @@ class ChocoGossipEngine:
         axis_name: str = "agents",
         fused: bool = True,
         budget: str = "per-leaf",
+        error_feedback: bool = False,
     ):
         self.engine = ConsensusEngine(
             W, mesh=mesh, axis_name=axis_name, fused=fused
@@ -664,6 +670,20 @@ class ChocoGossipEngine:
                 "oracle is, by definition, per-leaf budgeted)"
             )
         self.budget = budget
+        # Error feedback on the CORRECTION channel (EF-SGD style,
+        # arXiv:1901.09847 composed with the CHOCO recurrence): the mass
+        # a lossy compressor drops from ``x - xhat`` is banked and
+        # re-offered next round, so an aggressive global budget (which
+        # can starve whole buckets for rounds at a time) stays
+        # convergent instead of stalling at the compressor's floor.
+        # ``False`` (default) keeps the plain recurrence bit-identical.
+        self.error_feedback = bool(error_feedback)
+        if self.error_feedback and not fused:
+            raise ValueError(
+                "error_feedback=True is the fused global-budget rescue; "
+                "it requires fused=True (the per-leaf oracle keeps each "
+                "leaf's exact compressor contract instead)"
+            )
         self._fused_comp = FusedCompressor(compressor, budget=budget)
         self._jit_run: dict = {}
 
@@ -708,10 +728,15 @@ class ChocoGossipEngine:
 
     # ------------------------------------------------------------------ #
     def init(self, x0: Pytree, *, seed: int = 0) -> ChocoState:
-        """Estimates start at zero — the standard CHOCO initialization."""
+        """Estimates start at zero — the standard CHOCO initialization
+        (so does the EF residual bank, when enabled)."""
         x = self.engine.shard(x0)
         xhat = jax.tree.map(jnp.zeros_like, x)
-        return ChocoState(x=x, xhat=xhat, key=jax.random.key(seed))
+        ef = (
+            jax.tree.map(jnp.zeros_like, x)
+            if self.error_feedback else None
+        )
+        return ChocoState(x=x, xhat=xhat, key=jax.random.key(seed), ef=ef)
 
     def _step_fused(
         self, s: ChocoState, layout, self_w, match_w
@@ -724,9 +749,16 @@ class ChocoGossipEngine:
         buffers."""
         key, sub = jax.random.split(s.key)
         delta = jax.tree.map(lambda a, b: a - b, s.x, s.xhat)
+        if s.ef is not None:
+            # EF bank: re-offer the previously dropped correction mass.
+            delta = jax.tree.map(lambda d, e: d + e, delta, s.ef)
         q = self._fused_comp.compress(
             delta, layout, sub, n=self.n,
             axis_name=None if self.mesh is None else self.axis_name,
+        )
+        ef = (
+            jax.tree.map(lambda d, qv: d - qv, delta, q)
+            if s.ef is not None else None
         )
         xhat = jax.tree.map(lambda h, qv: h + qv, s.xhat, q)
         mixed_hat = self._mix(xhat, self_w, match_w)
@@ -734,7 +766,7 @@ class ChocoGossipEngine:
             lambda xv, mh, h: xv + self.gamma * (mh - h),
             s.x, mixed_hat, xhat,
         )
-        return ChocoState(x=x, xhat=xhat, key=key)
+        return ChocoState(x=x, xhat=xhat, key=key, ef=ef)
 
     def _fused_program(self, layout, rounds: int):
         """Traceable fused-carry program ``state -> (state, trace)``:
@@ -746,37 +778,102 @@ class ChocoGossipEngine:
         engine = self.engine
 
         def scan_fused(s, self_w, match_w):
-            bx, _ = ops.flatten_stacked(s.x, layout)
-            bh, _ = ops.flatten_stacked(s.xhat, layout)
+            st0 = self._flatten_state(s, layout)
 
             def body(st, _):
                 st = self._step_fused(st, layout, self_w, match_w)
                 return st, residual(engine, st.x)
 
-            fs, trace = jax.lax.scan(
-                body, ChocoState(bx, bh, s.key), None, length=rounds
-            )
-            return (
-                ChocoState(
-                    x=ops.unflatten_stacked(fs.x, layout),
-                    xhat=ops.unflatten_stacked(fs.xhat, layout),
-                    key=fs.key,
-                ),
-                trace,
-            )
+            fs, trace = jax.lax.scan(body, st0, None, length=rounds)
+            return self._unflatten_state(fs, layout), trace
 
         if engine.mesh is None:
             return lambda s: scan_fused(s, None, None)
-        spec = P(self.axis_name)
-        st_spec = ChocoState(x=spec, xhat=spec, key=P())
+        st_spec = self._state_spec()
         inner = jax.shard_map(
             scan_fused,
             mesh=engine.mesh,
-            in_specs=(st_spec, spec, P(None, self.axis_name)),
+            in_specs=(st_spec, P(self.axis_name), P(None, self.axis_name)),
             out_specs=(st_spec, P()),
             check_vma=True,
         )
         return lambda s: inner(s, engine._self_w, engine._match_w)
+
+    def _flatten_state(self, s: ChocoState, layout) -> ChocoState:
+        """Ravel every tree-valued field of the carry onto the fused
+        buffer layout (once per program entry, never per round)."""
+        bx, _ = ops.flatten_stacked(s.x, layout)
+        bh, _ = ops.flatten_stacked(s.xhat, layout)
+        bef = None
+        if s.ef is not None:
+            bef, _ = ops.flatten_stacked(s.ef, layout)
+        return ChocoState(x=bx, xhat=bh, key=s.key, ef=bef)
+
+    def _unflatten_state(self, s: ChocoState, layout) -> ChocoState:
+        return ChocoState(
+            x=ops.unflatten_stacked(s.x, layout),
+            xhat=ops.unflatten_stacked(s.xhat, layout),
+            key=s.key,
+            ef=(
+                None if s.ef is None
+                else ops.unflatten_stacked(s.ef, layout)
+            ),
+        )
+
+    def _state_spec(self) -> ChocoState:
+        spec = P(self.axis_name)
+        return ChocoState(
+            x=spec, xhat=spec, key=P(),
+            ef=spec if self.error_feedback else None,
+        )
+
+    def superstep_program(self, layout):
+        """Traceable ``(ChocoState, times) -> ChocoState`` with a TRACED
+        round count: a ``fori_loop`` of the same per-round step the
+        jitted :meth:`run` scans (``_step_fused`` on the fused carry,
+        the per-leaf ``_step`` otherwise), so the carried state is
+        bitwise :meth:`run`'s at equal counts — only the per-round
+        residual trace (a pure readout) is dropped.  This is the body
+        the trainer's superstep embeds: the CHOCO hat-carry threads
+        through the epoch scan and each epoch's round budget arrives as
+        schedule data.  ``layout`` must be the concrete
+        :func:`ops.fused_layout` of the state (ignored when
+        ``fused=False``)."""
+        engine = self.engine
+
+        if self.fused:
+            def run_st(s, t, self_w, match_w):
+                st0 = self._flatten_state(s, layout)
+                st = jax.lax.fori_loop(
+                    0, t,
+                    lambda i, st: self._step_fused(
+                        st, layout, self_w, match_w
+                    ),
+                    st0,
+                )
+                return self._unflatten_state(st, layout)
+        else:
+            def run_st(s, t, self_w, match_w):
+                return jax.lax.fori_loop(
+                    0, t,
+                    lambda i, st: self._step(st, self_w, match_w),
+                    s,
+                )
+
+        if engine.mesh is None:
+            return lambda s, t: run_st(s, t, None, None)
+        st_spec = self._state_spec()
+        inner = jax.shard_map(
+            run_st,
+            mesh=engine.mesh,
+            in_specs=(
+                st_spec, P(), P(self.axis_name),
+                P(None, self.axis_name),
+            ),
+            out_specs=st_spec,
+            check_vma=True,
+        )
+        return lambda s, t: inner(s, t, engine._self_w, engine._match_w)
 
     def _run_fused(
         self, state: ChocoState, rounds: int

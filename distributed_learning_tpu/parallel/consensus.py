@@ -998,6 +998,234 @@ class ConsensusEngine:
         )
 
     # ------------------------------------------------------------------ #
+    # Traced-knob program bodies: round counts / schedules as DATA       #
+    # ------------------------------------------------------------------ #
+    # The ``*_times_program`` / ``*_masked_program`` builders below are
+    # the per-epoch-schedule counterparts of the static ``*_program``
+    # bodies: the round count (and, for the traced-W variants, the
+    # mixing matrix and the Chebyshev omega row) is a TRACED operand of
+    # the returned callable, so a caller can scan K epochs with a
+    # different round budget per epoch inside ONE compiled program (the
+    # trainer's superstep, ``training/trainer.py::train_epochs``).
+    # ``fori_loop`` over the same per-round body is bitwise the static
+    # unroll (same ops, same order — the ``mix_program`` contract), so
+    # every variant here stays bit-identical to its per-epoch oracle.
+
+    def mix_times_program(self):
+        """Traceable ``(state, times) -> state``: :meth:`mix_program`
+        with the round count as a traced int32 operand (``fori_loop``
+        over the same per-round update — bitwise the static unroll)."""
+        if self.mesh is None:
+            def run(x, t):
+                return self._run_times(x, t, self._dense_mix_once)
+
+            return self._fuse_state_fn(run)
+        mesh, ax = self.mesh, self.axis_name
+        sw, mw = self._self_w, self._match_w
+
+        def local(x, t, sw, mw):
+            return self._run_times(
+                x, t, lambda s: self._local_mix_once(s, sw, mw)
+            )
+
+        inner = jax.shard_map(
+            self._fuse_state_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(), P(ax), P(None, ax)),
+            out_specs=P(ax),
+        )
+        return lambda x, t: inner(x, t, sw, mw)
+
+    def mix_until_times_program(self, *, eps: float, max_rounds: int = 10_000):
+        """Traceable ``(state, min_times) -> (state, rounds_done,
+        residual)``: :meth:`mix_until_program` with the round floor as a
+        traced operand (the eps-stopping ``while_loop`` already decides
+        the count on device; only the floor becomes data)."""
+        eps_f = jnp.float32(eps)
+        mx = jnp.int32(max_rounds)
+        if self.mesh is None:
+            def run(x, mn):
+                return self._run_until(
+                    x, eps_f, mn, mx, self._dense_mix_once,
+                    self._dense_residual,
+                )
+
+            return self._fuse_state_fn(run)
+        mesh, ax = self.mesh, self.axis_name
+        sw, mw = self._self_w, self._match_w
+
+        def local(x, mn, sw, mw):
+            return self._run_until(
+                x, eps_f, mn, mx,
+                lambda s: self._local_mix_once(s, sw, mw),
+                self._local_residual,
+            )
+
+        inner = jax.shard_map(
+            self._fuse_state_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(), P(ax), P(None, ax)),
+            out_specs=(P(ax), P(), P()),
+        )
+        return lambda x, mn: inner(x, mn, sw, mw)
+
+    def mix_with_times_program(self):
+        """Traceable ``(state, W, times) -> state``: the traced-W gossip
+        of :meth:`mix_with` with a traced round count.  Under a mesh the
+        matrix is traced data, so the route is always the masked
+        all-to-all (:meth:`_local_allgather_mix`); the k-hop ring
+        decomposition needs a concrete host-side W."""
+        if self.mesh is None:
+            precision = self.precision
+
+            def run(x, W, t):
+                return self._run_times(
+                    x, t,
+                    lambda s: ops.dense_mix(s, W, precision=precision),
+                )
+
+            return self._fuse_state_fn(run)
+        mesh, ax = self.mesh, self.axis_name
+
+        def local(x, W, t):
+            i = lax.axis_index(ax)
+            W_row = lax.dynamic_index_in_dim(
+                W.astype(jnp.float32), i, keepdims=False
+            )
+            return self._run_times(
+                x, t, lambda s: self._local_allgather_mix(s, W_row)
+            )
+
+        return jax.shard_map(
+            self._fuse_state_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(), P()),
+            out_specs=P(ax),
+        )
+
+    def mix_until_with_times_program(
+        self, *, eps: float, max_rounds: int = 10_000
+    ):
+        """Traceable ``(state, W, min_times) -> (state, rounds_done,
+        residual)``: eps-stopped gossip against a traced matrix with a
+        traced round floor (the superstep's ``topology_schedule`` +
+        ``mix_eps`` composition)."""
+        eps_f = jnp.float32(eps)
+        mx = jnp.int32(max_rounds)
+        if self.mesh is None:
+            precision = self.precision
+
+            def run(x, W, mn):
+                return self._run_until(
+                    x, eps_f, mn, mx,
+                    lambda s: ops.dense_mix(s, W, precision=precision),
+                    self._dense_residual,
+                )
+
+            return self._fuse_state_fn(run)
+        mesh, ax = self.mesh, self.axis_name
+
+        def local(x, W, mn):
+            i = lax.axis_index(ax)
+            W_row = lax.dynamic_index_in_dim(
+                W.astype(jnp.float32), i, keepdims=False
+            )
+            return self._run_until(
+                x, eps_f, mn, mx,
+                lambda s: self._local_allgather_mix(s, W_row),
+                self._local_residual,
+            )
+
+        return jax.shard_map(
+            self._fuse_state_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(), P()),
+            out_specs=(P(ax), P(), P()),
+        )
+
+    def chebyshev_masked_program(self):
+        """Traceable ``(state, omegas, times) -> state``: the Chebyshev
+        recurrence over a zero-PADDED traced omega row, frozen after the
+        traced round count — collectives run every padded round (branch-
+        uniform), the recurrence state just stops updating.  The omega
+        prefix property (``chebyshev_omegas(g, t) ==
+        chebyshev_omegas(g, T)[:t]``) makes the frozen result bitwise
+        :meth:`mix_chebyshev` at ``times`` rounds."""
+        if self.mesh is None:
+            mix_once = self._dense_mix_once
+
+            def run(x, omegas, t):
+                return self._cheby_masked(x, omegas, t, mix_once)
+
+            return self._fuse_state_fn(run)
+        mesh, ax = self.mesh, self.axis_name
+        sw, mw = self._self_w, self._match_w
+
+        def local(x, omegas, t, sw, mw):
+            return self._cheby_masked(
+                x, omegas, t, lambda s: self._local_mix_once(s, sw, mw)
+            )
+
+        inner = jax.shard_map(
+            self._fuse_state_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(), P(), P(ax), P(None, ax)),
+            out_specs=P(ax),
+        )
+        return lambda x, omegas, t: inner(x, omegas, t, sw, mw)
+
+    def chebyshev_masked_with_program(self):
+        """Traceable ``(state, W, omegas, times) -> state``: the masked
+        Chebyshev recurrence against a traced per-epoch matrix (the
+        superstep's ``topology_schedule`` + ``chebyshev`` composition;
+        all-gather route, as for every traced W)."""
+        if self.mesh is None:
+            precision = self.precision
+
+            def run(x, W, omegas, t):
+                return self._cheby_masked(
+                    x, omegas, t,
+                    lambda s: ops.dense_mix(s, W, precision=precision),
+                )
+
+            return self._fuse_state_fn(run)
+        mesh, ax = self.mesh, self.axis_name
+
+        def local(x, W, omegas, t):
+            i = lax.axis_index(ax)
+            W_row = lax.dynamic_index_in_dim(
+                W.astype(jnp.float32), i, keepdims=False
+            )
+            return self._cheby_masked(
+                x, omegas, t, lambda s: self._local_allgather_mix(s, W_row)
+            )
+
+        return jax.shard_map(
+            self._fuse_state_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(), P(), P()),
+            out_specs=P(ax),
+        )
+
+    def robust_mix_times_program(self, spec):
+        """Traceable ``(state, times) -> (state, mass)``: the robust
+        gossip of :meth:`robust_mix_program` with a traced round count;
+        see :mod:`..parallel.robust`."""
+        from distributed_learning_tpu.parallel import robust
+
+        return robust.robust_mix_times_program(self, spec)
+
+    def robust_async_times_program(self, spec, *, periods):
+        """Traceable ``(stacked, state, times, tau) -> (stacked, state,
+        mass)``: the robust async gossip with traced round count and
+        staleness bound; see :mod:`..parallel.robust`."""
+        from distributed_learning_tpu.parallel import robust
+
+        return robust.robust_async_gossip_times_program(
+            self, spec, periods=periods
+        )
+
+    # ------------------------------------------------------------------ #
     # Asynchronous (stale-weighted) gossip: the device-side simulation   #
     # of the comm-layer async runtime (docs/async_runtime.md)            #
     # ------------------------------------------------------------------ #
@@ -1026,9 +1254,13 @@ class ConsensusEngine:
             rnd=jnp.int32(0),
         )
 
-    def _async_round_body(self, tau: int, periods_dev: jax.Array):
+    def _async_round_body(self, periods_dev: jax.Array):
         """One async gossip round on (x, pub, age, rnd) — layout-agnostic
-        (serves the stacked tree and the fused buffer dict alike).
+        (serves the stacked tree and the fused buffer dict alike), with
+        the staleness bound ``tau`` a per-call operand (a python int in
+        the static programs, a traced int32 in the superstep's
+        schedulable-tau variant — :func:`ops.mixing.stale_weight_matrix`
+        is knob-polymorphic).
 
         publish -> age -> stale-weighted mix: agents whose period divides
         the round copy buffer A into buffer B (their age resets), every
@@ -1038,9 +1270,8 @@ class ConsensusEngine:
         lost mass renormalized onto the self edge on device.
         """
         W_dev, precision = self._W_dev, self.precision
-        tau = int(tau)
 
-        def round_once(x, pub, age, rnd):
+        def round_once(x, pub, age, rnd, tau):
             publish = (rnd % periods_dev) == 0  # (n,) bool
 
             def select(xv, pv):
@@ -1054,6 +1285,41 @@ class ConsensusEngine:
             return x, pub, age, rnd + jnp.int32(1)
 
         return round_once
+
+    def _local_async_round(self, periods_dev: jax.Array):
+        """Sharded counterpart of :meth:`_async_round_body`: one async
+        round on this device's shard (one all_gather of the published
+        buffer per leaf/bucket), ``tau`` again a per-call operand."""
+        ax, n = self.axis_name, self.n
+        W_dev, precision = self._W_dev, self.precision
+
+        def local_round(x, pub, age, rnd, tau):
+            publish = (rnd % periods_dev) == 0
+            i = lax.axis_index(ax)
+            mine = publish[i]
+            pub = jax.tree.map(
+                lambda xv, pv: jnp.where(mine, xv, pv), x, pub
+            )
+            age = jnp.where(publish, jnp.int32(0), age + jnp.int32(1))
+            W_eff = ops.stale_weight_matrix(W_dev, age, tau=tau)
+            W_row = lax.dynamic_index_in_dim(W_eff, i, keepdims=False)
+            d = W_row[i]
+
+            def leaf(xv, pv):
+                ag = lax.all_gather(pv, ax, axis=0, tiled=True)
+                pf = ag.astype(jnp.float32).reshape(n, -1)
+                out = jnp.matmul(
+                    W_row.astype(jnp.float32), pf, precision=precision
+                )
+                xf = xv.reshape(xv.shape[0], -1).astype(jnp.float32)
+                lpf = pv.reshape(pv.shape[0], -1).astype(jnp.float32)
+                out = out[None] + d * (xf - lpf)
+                return out.reshape(xv.shape).astype(xv.dtype)
+
+            x = jax.tree.map(leaf, x, pub)
+            return x, pub, age, rnd + jnp.int32(1)
+
+        return local_round
 
     def _fuse_async_fn(self, run):
         """Fused-layout wrapper for the double-buffered programs: both
@@ -1089,13 +1355,14 @@ class ConsensusEngine:
         periods = self._normalize_periods(periods)
         times = int(times)
         periods_dev = jnp.asarray(periods, jnp.int32)
+        tau_i = int(tau)
 
         if self.mesh is None:
-            round_once = self._async_round_body(tau, periods_dev)
+            round_once = self._async_round_body(periods_dev)
 
             def run(x, pub, age, rnd):
                 def body(_, carry):
-                    return round_once(*carry)
+                    return round_once(*carry, tau_i)
 
                 return lax.fori_loop(0, times, body, (x, pub, age, rnd))
 
@@ -1107,39 +1374,12 @@ class ConsensusEngine:
 
             return program
 
-        mesh, ax, n = self.mesh, self.axis_name, self.n
-        W_dev, precision = self._W_dev, self.precision
-        tau_i = int(tau)
-
-        def local_round(x, pub, age, rnd):
-            publish = (rnd % periods_dev) == 0
-            i = lax.axis_index(ax)
-            mine = publish[i]
-            pub = jax.tree.map(
-                lambda xv, pv: jnp.where(mine, xv, pv), x, pub
-            )
-            age = jnp.where(publish, jnp.int32(0), age + jnp.int32(1))
-            W_eff = ops.stale_weight_matrix(W_dev, age, tau=tau_i)
-            W_row = lax.dynamic_index_in_dim(W_eff, i, keepdims=False)
-            d = W_row[i]
-
-            def leaf(xv, pv):
-                ag = lax.all_gather(pv, ax, axis=0, tiled=True)
-                pf = ag.astype(jnp.float32).reshape(n, -1)
-                out = jnp.matmul(
-                    W_row.astype(jnp.float32), pf, precision=precision
-                )
-                xf = xv.reshape(xv.shape[0], -1).astype(jnp.float32)
-                lpf = pv.reshape(pv.shape[0], -1).astype(jnp.float32)
-                out = out[None] + d * (xf - lpf)
-                return out.reshape(xv.shape).astype(xv.dtype)
-
-            x = jax.tree.map(leaf, x, pub)
-            return x, pub, age, rnd + jnp.int32(1)
+        mesh, ax = self.mesh, self.axis_name
+        local_round = self._local_async_round(periods_dev)
 
         def local(x, pub, age, rnd):
             def body(_, carry):
-                return local_round(*carry)
+                return local_round(*carry, tau_i)
 
             return lax.fori_loop(0, times, body, (x, pub, age, rnd))
 
@@ -1152,6 +1392,56 @@ class ConsensusEngine:
 
         def program(x, st: AsyncGossipState):
             x, pub, age, rnd = inner(x, st.pub, st.age, st.rnd)
+            return x, AsyncGossipState(pub, age, rnd)
+
+        return program
+
+    def async_gossip_times_program(self, *, periods):
+        """Traceable ``(stacked, AsyncGossipState, times, tau) ->
+        (stacked, state)``: :meth:`async_gossip_program` with the round
+        count AND the staleness bound as traced int32 operands — the
+        superstep feeds a per-epoch schedule for both, in one compiled
+        program.  Same per-round body as the static variant (bitwise at
+        equal knob values); only the publish periods stay static (they
+        shape the per-agent cadence array)."""
+        periods = self._normalize_periods(periods)
+        periods_dev = jnp.asarray(periods, jnp.int32)
+
+        if self.mesh is None:
+            round_once = self._async_round_body(periods_dev)
+
+            def run(x, pub, age, rnd, t, tau):
+                def body(_, carry):
+                    return round_once(*carry, tau)
+
+                return lax.fori_loop(0, t, body, (x, pub, age, rnd))
+
+            fused = self._fuse_async_fn(run)
+
+            def program(x, st: AsyncGossipState, t, tau):
+                x, pub, age, rnd = fused(x, st.pub, st.age, st.rnd, t, tau)
+                return x, AsyncGossipState(pub, age, rnd)
+
+            return program
+
+        mesh, ax = self.mesh, self.axis_name
+        local_round = self._local_async_round(periods_dev)
+
+        def local(x, pub, age, rnd, t, tau):
+            def body(_, carry):
+                return local_round(*carry, tau)
+
+            return lax.fori_loop(0, t, body, (x, pub, age, rnd))
+
+        inner = jax.shard_map(
+            self._fuse_async_fn(local),
+            mesh=mesh,
+            in_specs=(P(ax), P(ax), P(), P(), P(), P()),
+            out_specs=(P(ax), P(ax), P(), P()),
+        )
+
+        def program(x, st: AsyncGossipState, t, tau):
+            x, pub, age, rnd = inner(x, st.pub, st.age, st.rnd, t, tau)
             return x, AsyncGossipState(pub, age, rnd)
 
         return program
@@ -1591,6 +1881,53 @@ class ConsensusEngine:
             return (cur, nxt), None
 
         (_, xk), _ = lax.scan(body, (x_prev, xk), omegas[1:])
+        return xk
+
+    @staticmethod
+    def _cheby_masked(x: Pytree, omegas: jax.Array, t: jax.Array,
+                      mix_once) -> Pytree:
+        """Chebyshev recurrence over a zero-padded traced omega row,
+        frozen once the traced round count ``t`` is spent: every padded
+        round still runs ``mix_once`` (the collective footprint is
+        round-count invariant — branch-uniform by construction), but the
+        recurrence carry stops updating at ``r > t``.  Because the omega
+        sequence depends only on gamma — ``chebyshev_omegas(g, t)`` is a
+        prefix of ``chebyshev_omegas(g, T)`` — the frozen result is
+        bitwise :meth:`_cheby_traced` on ``omegas[:t]``."""
+        k = omegas.shape[0]
+        if k == 0:
+            return x
+        x1 = mix_once(x)
+        # times >= 1 everywhere in the trainer; the mask keeps the
+        # program total for t == 0 anyway.
+        xk = jax.tree.map(lambda a, b: jnp.where(t >= 1, b, a), x, x1)
+        if k == 1:
+            return xk
+
+        def body(carry, inp):
+            om, r = inp
+            prev, cur = carry
+            wx = mix_once(cur)
+            nxt = jax.tree.map(
+                lambda wv, pv: (
+                    om * (wv.astype(jnp.float32) - pv.astype(jnp.float32))
+                    + pv.astype(jnp.float32)
+                ).astype(wv.dtype),
+                wx,
+                prev,
+            )
+            live = r <= t
+            prev = jax.tree.map(
+                lambda c, p: jnp.where(live, c, p), cur, prev
+            )
+            cur = jax.tree.map(
+                lambda nv, c: jnp.where(live, nv, c), nxt, cur
+            )
+            return (prev, cur), None
+
+        (_, xk), _ = lax.scan(
+            body, (x, xk), (omegas[1:], jnp.arange(2, k + 1))
+        )
         return xk
 
     @staticmethod

@@ -52,7 +52,9 @@ __all__ = [
     "RobustConfig",
     "as_robust_config",
     "robust_mix_program",
+    "robust_mix_times_program",
     "robust_async_gossip_program",
+    "robust_async_gossip_times_program",
 ]
 
 _KINDS = ("clip", "trim", "median")
@@ -328,6 +330,60 @@ def robust_mix_program(engine, spec, times: int = 1):
     return lambda x: inner(x, sw, mw)
 
 
+def robust_mix_times_program(engine, spec):
+    """Traceable ``(state, times) -> (state, mass)``: the robust rounds
+    of :func:`robust_mix_program` with the round count a traced int32
+    operand (``fori_loop`` over the same per-round body, same mass
+    accumulation order — bitwise the static unroll at equal counts).
+    The trainer's superstep feeds its per-epoch round schedule here."""
+    cfg = as_robust_config(spec)
+    if engine.mesh is None:
+        round_once = _dense_robust_round(engine, cfg)
+
+        def run(x, t):
+            def body(_, carry):
+                xx, mass = carry
+                xx, m = round_once(xx)
+                return xx, mass + m
+
+            return lax.fori_loop(0, t, body, (x, jnp.float32(0.0)))
+
+        return engine._fuse_state_fn(run)
+
+    mesh, ax = engine.mesh, engine.axis_name
+    sw, mw = engine._self_w, engine._match_w
+    if cfg.kind == "clip":
+        radius = jnp.float32(cfg.radius)
+
+        def one(x, self_w, match_w):
+            return _local_clipped_once(
+                engine, x, self_w, match_w, radius, cfg.adaptive
+            )
+    else:
+        t_dev = _trim_depths(engine, cfg)
+
+        def one(x, self_w, match_w):
+            return _local_trimmed_once(engine, x, self_w, match_w, t_dev)
+
+    def local(x, t, self_w, match_w):
+        def body(_, carry):
+            xx, mass = carry
+            xx, m = one(xx, self_w, match_w)
+            return xx, mass + m
+
+        x, mass = lax.fori_loop(0, t, body, (x, jnp.float32(0.0)))
+        # graftlint: disable=raw-collective-in-shard-map -- robust statistic: total redirected edge mass over agents, the defense's detection signal
+        return x, lax.psum(mass, ax)
+
+    inner = jax.shard_map(
+        engine._fuse_state_fn(local),
+        mesh=mesh,
+        in_specs=(P(ax), P(), P(ax), P(None, ax)),
+        out_specs=(P(ax), P()),
+    )
+    return lambda x, t: inner(x, t, sw, mw)
+
+
 # --------------------------------------------------------------------- #
 # Asynchronous (stale-weighted, double-buffered) robust mixing          #
 # --------------------------------------------------------------------- #
@@ -349,37 +405,14 @@ def robust_async_gossip_program(
     periods = engine._normalize_periods(periods)
     times = int(times)
     periods_dev = jnp.asarray(periods, jnp.int32)
-    W_dev, precision = engine._W_dev, engine.precision
     tau_i = int(tau)
-    t_dev = None if cfg.kind == "clip" else _trim_depths(engine, cfg)
-    radius = jnp.float32(cfg.radius)
 
     if engine.mesh is None:
-
-        def round_once(x, pub, age, rnd, mass):
-            publish = (rnd % periods_dev) == 0
-
-            def select(xv, pv):
-                mm = publish.reshape((-1,) + (1,) * (xv.ndim - 1))
-                return jnp.where(mm, xv, pv)
-
-            pub = jax.tree.map(select, x, pub)
-            age = jnp.where(publish, jnp.int32(0), age + jnp.int32(1))
-            W_eff = ops.stale_weight_matrix(W_dev, age, tau=tau_i)
-            if cfg.kind == "clip":
-                x, m = ops.clipped_mix(
-                    x, W_eff, radius, adaptive=cfg.adaptive,
-                    published=pub, precision=precision,
-                )
-            else:
-                x, m = ops.trimmed_mix(
-                    x, W_eff, t_dev, published=pub, precision=precision
-                )
-            return x, pub, age, rnd + jnp.int32(1), mass + m
+        round_once = _dense_async_robust_round(engine, cfg, periods_dev)
 
         def run(x, pub, age, rnd):
             def body(_, carry):
-                return round_once(*carry)
+                return round_once(*carry, tau_i)
 
             return lax.fori_loop(
                 0, times, body, (x, pub, age, rnd, jnp.float32(0.0))
@@ -393,9 +426,133 @@ def robust_async_gossip_program(
 
         return program
 
-    mesh, ax, n = engine.mesh, engine.axis_name, engine.n
+    mesh, ax = engine.mesh, engine.axis_name
+    local_round = _local_async_robust_round(engine, cfg, periods_dev)
 
-    def local_round(x, pub, age, rnd, mass):
+    def local(x, pub, age, rnd):
+        def body(_, carry):
+            return local_round(*carry, tau_i)
+
+        x, pub, age, rnd, mass = lax.fori_loop(
+            0, times, body, (x, pub, age, rnd, jnp.float32(0.0))
+        )
+        # graftlint: disable=raw-collective-in-shard-map -- robust statistic: total redirected edge mass over agents, the defense's detection signal
+        return x, pub, age, rnd, lax.psum(mass, ax)
+
+    inner = jax.shard_map(
+        engine._fuse_async_fn(local),
+        mesh=mesh,
+        in_specs=(P(ax), P(ax), P(), P()),
+        out_specs=(P(ax), P(ax), P(), P(), P()),
+    )
+
+    def program(x, st: AsyncGossipState):
+        x, pub, age, rnd, mass = inner(x, st.pub, st.age, st.rnd)
+        return x, AsyncGossipState(pub, age, rnd), mass
+
+    return program
+
+
+def robust_async_gossip_times_program(engine, spec, *, periods):
+    """Traceable ``(stacked, AsyncGossipState, times, tau) -> (stacked,
+    state, mass)``: :func:`robust_async_gossip_program` with the round
+    count and staleness bound as traced int32 operands (the superstep's
+    per-epoch schedule path).  Same per-round bodies — bitwise the
+    static program at equal knob values."""
+    cfg = as_robust_config(spec)
+    periods = engine._normalize_periods(periods)
+    periods_dev = jnp.asarray(periods, jnp.int32)
+
+    if engine.mesh is None:
+        round_once = _dense_async_robust_round(engine, cfg, periods_dev)
+
+        def run(x, pub, age, rnd, t, tau):
+            def body(_, carry):
+                return round_once(*carry, tau)
+
+            return lax.fori_loop(
+                0, t, body, (x, pub, age, rnd, jnp.float32(0.0))
+            )
+
+        fused = engine._fuse_async_fn(run)
+
+        def program(x, st: AsyncGossipState, t, tau):
+            x, pub, age, rnd, mass = fused(
+                x, st.pub, st.age, st.rnd, t, tau
+            )
+            return x, AsyncGossipState(pub, age, rnd), mass
+
+        return program
+
+    mesh, ax = engine.mesh, engine.axis_name
+    local_round = _local_async_robust_round(engine, cfg, periods_dev)
+
+    def local(x, pub, age, rnd, t, tau):
+        def body(_, carry):
+            return local_round(*carry, tau)
+
+        x, pub, age, rnd, mass = lax.fori_loop(
+            0, t, body, (x, pub, age, rnd, jnp.float32(0.0))
+        )
+        # graftlint: disable=raw-collective-in-shard-map -- robust statistic: total redirected edge mass over agents, the defense's detection signal
+        return x, pub, age, rnd, lax.psum(mass, ax)
+
+    inner = jax.shard_map(
+        engine._fuse_async_fn(local),
+        mesh=mesh,
+        in_specs=(P(ax), P(ax), P(), P(), P(), P()),
+        out_specs=(P(ax), P(ax), P(), P(), P()),
+    )
+
+    def program(x, st: AsyncGossipState, t, tau):
+        x, pub, age, rnd, mass = inner(x, st.pub, st.age, st.rnd, t, tau)
+        return x, AsyncGossipState(pub, age, rnd), mass
+
+    return program
+
+
+def _dense_async_robust_round(engine, cfg: RobustConfig, periods_dev):
+    """``(x, pub, age, rnd, mass, tau) -> ...`` one dense robust async
+    round; ``tau`` is a per-call operand (python int in the static
+    program, traced int32 in the ``times``/schedulable-tau variant)."""
+    W_dev, precision = engine._W_dev, engine.precision
+    t_dev = None if cfg.kind == "clip" else _trim_depths(engine, cfg)
+    radius = jnp.float32(cfg.radius)
+
+    def round_once(x, pub, age, rnd, mass, tau):
+        publish = (rnd % periods_dev) == 0
+
+        def select(xv, pv):
+            mm = publish.reshape((-1,) + (1,) * (xv.ndim - 1))
+            return jnp.where(mm, xv, pv)
+
+        pub = jax.tree.map(select, x, pub)
+        age = jnp.where(publish, jnp.int32(0), age + jnp.int32(1))
+        W_eff = ops.stale_weight_matrix(W_dev, age, tau=tau)
+        if cfg.kind == "clip":
+            x, m = ops.clipped_mix(
+                x, W_eff, radius, adaptive=cfg.adaptive,
+                published=pub, precision=precision,
+            )
+        else:
+            x, m = ops.trimmed_mix(
+                x, W_eff, t_dev, published=pub, precision=precision
+            )
+        return x, pub, age, rnd + jnp.int32(1), mass + m
+
+    return round_once
+
+
+def _local_async_robust_round(engine, cfg: RobustConfig, periods_dev):
+    """Sharded counterpart of :func:`_dense_async_robust_round` (one
+    all_gather of the published buffer per dtype bucket, shared by the
+    distance and contraction passes); ``tau`` again per-call."""
+    ax, n = engine.axis_name, engine.n
+    W_dev, precision = engine._W_dev, engine.precision
+    t_dev = None if cfg.kind == "clip" else _trim_depths(engine, cfg)
+    radius = jnp.float32(cfg.radius)
+
+    def local_round(x, pub, age, rnd, mass, tau):
         publish = (rnd % periods_dev) == 0
         i = lax.axis_index(ax)
         mine = publish[i]
@@ -403,7 +560,7 @@ def robust_async_gossip_program(
             lambda xv, pv: jnp.where(mine, xv, pv), x, pub
         )
         age = jnp.where(publish, jnp.int32(0), age + jnp.int32(1))
-        W_eff = ops.stale_weight_matrix(W_dev, age, tau=tau_i)
+        W_eff = ops.stale_weight_matrix(W_dev, age, tau=tau)
         W_row = lax.dynamic_index_in_dim(W_eff, i, keepdims=False)
 
         # ONE all_gather per dtype bucket, reused by the distance pass
@@ -500,25 +657,4 @@ def robust_async_gossip_program(
             x = jax.tree_util.tree_unflatten(treedef, outs)
         return x, pub, age, rnd + jnp.int32(1), mass + m_dev
 
-    def local(x, pub, age, rnd):
-        def body(_, carry):
-            return local_round(*carry)
-
-        x, pub, age, rnd, mass = lax.fori_loop(
-            0, times, body, (x, pub, age, rnd, jnp.float32(0.0))
-        )
-        # graftlint: disable=raw-collective-in-shard-map -- robust statistic: total redirected edge mass over agents, the defense's detection signal
-        return x, pub, age, rnd, lax.psum(mass, ax)
-
-    inner = jax.shard_map(
-        engine._fuse_async_fn(local),
-        mesh=mesh,
-        in_specs=(P(ax), P(ax), P(), P()),
-        out_specs=(P(ax), P(ax), P(), P(), P()),
-    )
-
-    def program(x, st: AsyncGossipState):
-        x, pub, age, rnd, mass = inner(x, st.pub, st.age, st.rnd)
-        return x, AsyncGossipState(pub, age, rnd), mass
-
-    return program
+    return local_round

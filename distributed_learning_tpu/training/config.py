@@ -84,11 +84,18 @@ class ExperimentConfig:
     time_varying_p: Optional[float] = None  # erdos_renyi edge prob per epoch
     global_avg_every: Optional[int] = None  # Gossip-PGA period (2105.09080)
     superstep: int = 1  # epochs fused into one compiled dispatch
-                        # (train_epochs; schedule/compression configs
-                        # fall back to 1 with a warning)
+                        # (train_epochs; EVERY config compiles in —
+                        # schedules ride as traced data, CHOCO/async/
+                        # robust state threads through the scan carry)
     compression: Optional[str] = None  # CHOCO spec: topk:F | atopk:F | randk:F | sign | int8
     compression_gamma: float = 0.2
     compression_budget: str = "per-leaf"  # fused k budget: per-leaf | global
+    compression_error_feedback: bool = False  # EF bank on the correction
+                                              # (fused global budget rescue)
+    adaptive_comm: Optional[Dict[str, Any]] = None  # residual-adaptive gossip
+                                                    # budget: {"target": R,
+                                                    # "gain", "min_times",
+                                                    # "max_times"}
     # misc
     seed: int = 0
     dropout: bool = True
@@ -274,6 +281,8 @@ class ExperimentConfig:
             compression=self.compression,
             compression_gamma=self.compression_gamma,
             compression_budget=self.compression_budget,
+            compression_error_feedback=self.compression_error_feedback,
+            adaptive_comm=self.adaptive_comm,
             mesh=mesh,
             telemetry=telemetry,
             seed=self.seed,

@@ -47,7 +47,10 @@ from distributed_learning_tpu.obs import (
     global_norm as obs_global_norm,
 )
 from distributed_learning_tpu.ops import mixing as ops
-from distributed_learning_tpu.parallel.consensus import ConsensusEngine
+from distributed_learning_tpu.parallel.consensus import (
+    AsyncGossipState,
+    ConsensusEngine,
+)
 from distributed_learning_tpu.parallel.schedule import chebyshev_omegas
 from distributed_learning_tpu.parallel.topology import Topology, gamma as mixing_gamma
 from distributed_learning_tpu.utils.telemetry import TelemetryProcessor
@@ -303,10 +306,12 @@ class GossipTrainer:
         compression: Any = None,
         compression_gamma: float = 0.2,
         compression_budget: str = "per-leaf",
+        compression_error_feedback: bool = False,
         fused_consensus: bool = True,
         superstep: int = 1,
         async_gossip: Any = None,
         robust_mixing: Any = None,
+        adaptive_comm: Any = None,
         mesh=None,
         telemetry: Optional[TelemetryProcessor] = None,
         obs: Any = None,
@@ -467,20 +472,26 @@ class GossipTrainer:
                 self.chebyshev
                 or mix_eps is not None
                 or topology_schedule is not None
-                or mix_times_schedule is not None
                 or global_avg_every is not None
                 or compression is not None
             ):
                 raise ValueError(
                     "async_gossip applies to the plain-mix config only; "
                     "it is mutually exclusive with chebyshev, mix_eps, "
-                    "topology_schedule, mix_times_schedule, "
-                    "global_avg_every, and compression"
+                    "topology_schedule, global_avg_every, and compression "
+                    "(mix_times_schedule composes: it sets the per-epoch "
+                    "async round budget)"
                 )
+            # ``staleness_bound`` may be a callable ``epoch -> tau``
+            # (resolved per epoch, like mix_times_schedule): the bound
+            # is a traced operand of the async round body, so a tau
+            # schedule compiles into the superstep as data.
             self._async_sim = {
-                "tau": int(async_gossip.get("staleness_bound", 0)),
+                "tau": async_gossip.get("staleness_bound", 0),
                 "periods": async_gossip.get("publish_period", 1),
             }
+            if not callable(self._async_sim["tau"]):
+                self._async_sim["tau"] = int(self._async_sim["tau"])
         self._async_state = None
         # Byzantine-robust mixing (docs/robustness.md): route the gossip
         # phase through parallel/robust.py's clipped / trimmed / median
@@ -519,13 +530,6 @@ class GossipTrainer:
                     "compression is mutually exclusive with chebyshev, "
                     "topology_schedule, and mix_eps"
                 )
-            if mix_times_schedule is not None:
-                raise ValueError(
-                    "compression is mutually exclusive with "
-                    "mix_times_schedule: the CHOCO scan compiles per static "
-                    "round count, so a per-epoch schedule would recompile "
-                    "every epoch"
-                )
             if isinstance(compression, str):
                 from distributed_learning_tpu.parallel.compression import (
                     compressor_from_spec,
@@ -534,6 +538,14 @@ class GossipTrainer:
                 compression = compressor_from_spec(compression)
         self._compression = compression
         self._compression_gamma = float(compression_gamma)
+        self._compression_ef = bool(compression_error_feedback)
+        if self._compression_ef and compression is None:
+            raise ValueError(
+                "compression_error_feedback=True needs a compression "
+                "config (it banks the mass the compressor drops)"
+            )
+        self._choco_ef = None
+        self._choco_key = None
         # Compression budget of the fused CHOCO path: "per-leaf" keeps
         # each tensor's k/scale contract (the oracle-identical default),
         # "global" spends one budget across each fused dtype bucket
@@ -541,14 +553,74 @@ class GossipTrainer:
         self._compression_budget = str(compression_budget)
         # Epoch superstep (train_epochs): compile K epochs of local SGD +
         # gossip into ONE donated dispatch — start_consensus then runs the
-        # schedule in chunks of K.  1 = the per-epoch path.  Configs whose
-        # gossip needs per-epoch host logic (mix_times_schedule,
-        # topology_schedule, compression) fall back to K=1 with a warning.
+        # schedule in chunks of K.  1 = the per-epoch path.  EVERY config
+        # compiles into the superstep: per-epoch schedules
+        # (mix_times_schedule / topology_schedule / a tau schedule) ride
+        # as traced per-epoch data vectors, and the CHOCO estimates, the
+        # async double-buffer, and the robust redirected mass thread
+        # through the outer scan as explicit carries.
         self.superstep = int(superstep)
         if self.superstep < 1:
             raise ValueError(f"superstep must be >= 1, got {superstep}")
         self._superstep_cache: Dict[int, Any] = {}
-        self._superstep_warned = False
+        # Residual-adaptive communication (arXiv:1910.13598 — adapt the
+        # averaging/communication budget to consensus drift): each
+        # epoch's gossip round budget is the configured/scheduled count
+        # scaled by last epoch's post-mix residual relative to `target`
+        # (`1 + gain*(res/target - 1)`, rounded, clipped to
+        # [min_times, max_times]).  gain=0 is bit-identical to the
+        # static schedule (the oracle).  The controller runs in-program
+        # inside the superstep (the residual is the scan carry) and has
+        # an exact host mirror on the per-epoch path — both read the
+        # same consensus.residual trace the obs registry records.
+        self._adaptive_cfg = None
+        self._adaptive_res = None
+        if adaptive_comm is not None and adaptive_comm is not False:
+            if not isinstance(adaptive_comm, Mapping):
+                raise ValueError(
+                    "adaptive_comm must be a mapping with 'target' and "
+                    "optional 'gain'/'min_times'/'max_times', got "
+                    f"{adaptive_comm!r}"
+                )
+            unknown = set(adaptive_comm) - {
+                "target", "gain", "min_times", "max_times"
+            }
+            if unknown:
+                raise ValueError(
+                    f"unknown adaptive_comm keys: {sorted(unknown)}"
+                )
+            if "target" not in adaptive_comm:
+                raise ValueError(
+                    "adaptive_comm needs 'target': the consensus "
+                    "residual the controller steers toward"
+                )
+            target = float(adaptive_comm["target"])
+            if not target > 0.0:
+                raise ValueError(
+                    f"adaptive_comm target must be > 0, got {target}"
+                )
+            lo = int(adaptive_comm.get("min_times", 1))
+            hi = int(adaptive_comm.get("max_times", 10_000))
+            if lo < 1 or hi < lo:
+                raise ValueError(
+                    "adaptive_comm needs 1 <= min_times <= max_times, "
+                    f"got [{lo}, {hi}]"
+                )
+            if self.chebyshev:
+                raise ValueError(
+                    "adaptive_comm is mutually exclusive with chebyshev: "
+                    "the accelerated omega schedule is derived for a "
+                    "fixed round count, not a residual-modulated one"
+                )
+            self._adaptive_cfg = {
+                "target": target,
+                "gain": float(adaptive_comm.get("gain", 1.0)),
+                "min_times": lo,
+                "max_times": hi,
+            }
+            # Seed the feedback at the target: the first epoch runs the
+            # unmodified schedule (mult == 1 exactly) on both paths.
+            self._adaptive_res = np.float32(target)
         # Fused flat-buffer consensus (ops/mixing.py::flatten_stacked):
         # the engines ravel the stacked params once per call — and the
         # trainer gossips once per epoch, so the flatten cost is paid per
@@ -590,6 +662,7 @@ class GossipTrainer:
                 mesh=mesh,
                 fused=self.fused_consensus,
                 budget=self._compression_budget,
+                error_feedback=self._compression_ef,
             )
         if (
             self.chebyshev
@@ -861,8 +934,11 @@ class GossipTrainer:
             jax.random.key(self.seed + 1),
         )
         self._choco_xhat = None  # fresh run: CHOCO estimates restart at 0
+        self._choco_ef = None
         self._async_state = None  # fresh run: async publish buffer restarts
         self._robust_mass = None
+        if self._adaptive_cfg is not None:
+            self._adaptive_res = np.float32(self._adaptive_cfg["target"])
         return self
 
     # ------------------------------------------------------------------ #
@@ -924,6 +1000,13 @@ class GossipTrainer:
                     f"{mix_times}; must be >= 1 (0 would silently skip "
                     "gossip while reporting a mixed epoch)"
                 )
+        if self._adaptive_cfg is not None:
+            # Host mirror of the superstep's in-program controller —
+            # same float32 op order, fed by last epoch's residual
+            # (``self._adaptive_res``), so both paths compute the same
+            # round budget bit-for-bit.  For eps configs this modulates
+            # the round FLOOR (min_times); eps still decides the stop.
+            mix_times = self._adaptive_times_host(mix_times)
         rounds = mix_times
         consensus_epochs = epoch_idx + 1 - self.epoch_cons_num
         if self._async_sim is not None:
@@ -941,7 +1024,7 @@ class GossipTrainer:
                         params,
                         self._async_state,
                         spec=self._robust_cfg,
-                        tau=self._async_sim["tau"],
+                        tau=self._async_tau(epoch_idx),
                         periods=self._async_sim["periods"],
                         times=mix_times,
                     )
@@ -950,7 +1033,7 @@ class GossipTrainer:
                 params, self._async_state = self.engine.mix_async(
                     params,
                     self._async_state,
-                    tau=self._async_sim["tau"],
+                    tau=self._async_tau(epoch_idx),
                     periods=self._async_sim["periods"],
                     times=mix_times,
                 )
@@ -977,6 +1060,7 @@ class GossipTrainer:
             # they would push the now-identical params apart again next
             # epoch.  Reset — error feedback re-converges from zero.
             self._choco_xhat = None
+            self._choco_ef = None
         elif self.topology_schedule is not None:
             # Time-varying graph: resample, resolve, mix via the
             # traced-W path (no recompilation per epoch).
@@ -1016,12 +1100,14 @@ class GossipTrainer:
                 cstate = self._choco.init(params, seed=self.seed + 2)
             else:
                 cstate = ChocoState(
-                    x=params, xhat=self._choco_xhat, key=self._choco_key
+                    x=params, xhat=self._choco_xhat, key=self._choco_key,
+                    ef=self._choco_ef,
                 )
             cstate, _ = self._choco.run(cstate, mix_times)
             params = cstate.x
             self._choco_xhat = cstate.xhat
             self._choco_key = cstate.key
+            self._choco_ef = cstate.ef
         elif self.chebyshev:
             params = self.engine.mix_chebyshev(params, times=mix_times)
         elif self.mix_eps is None:
@@ -1032,6 +1118,33 @@ class GossipTrainer:
             )
             rounds = t  # device scalar; materialized at the flush
         return params, rounds
+
+    def _async_tau(self, epoch_idx: int) -> int:
+        """This epoch's staleness bound: the static int, or the tau
+        schedule resolved at ``epoch_idx`` (validated >= 0)."""
+        tau = self._async_sim["tau"]
+        if callable(tau):
+            tau = int(tau(epoch_idx))
+            if tau < 0:
+                raise ValueError(
+                    f"staleness_bound({epoch_idx}) returned {tau}; "
+                    "must be >= 0"
+                )
+            return tau
+        return int(tau)
+
+    def _adaptive_times_host(self, t: int) -> int:
+        """Host mirror of the superstep's residual-adaptive round
+        budget: ``clip(round(t * (1 + gain*(res/target - 1))),
+        min_times, max_times)`` in float32, fed by the previous epoch's
+        post-mix consensus residual.  gain=0 returns ``t`` exactly."""
+        c = self._adaptive_cfg
+        mult = np.float32(1.0) + np.float32(c["gain"]) * (
+            np.float32(self._adaptive_res) / np.float32(c["target"])
+            - np.float32(1.0)
+        )
+        te = np.floor(np.float32(t) * mult + np.float32(0.5))
+        return int(np.clip(te, c["min_times"], c["max_times"]))
 
     def _span(self, name: str):
         """Wall-clock span on the trainer's tracer (no-op when obs is
@@ -1064,13 +1177,16 @@ class GossipTrainer:
                 name="trainer.epoch", registry=registry,
             )
         k = int(k)
+        epoch0 = self._epochs_done
         modes = jnp.asarray(
-            [self._epoch_mode(self._epochs_done + j) for j in range(k)],
+            [self._epoch_mode(epoch0 + j) for j in range(k)],
             dtype=jnp.int32,
         )
         return profile_fn(
-            self._build_superstep(k), self._state, self._Xs, self._ys,
-            self._superstep_indices(self._epochs_done, k), modes,
+            self._build_superstep(k), self._state,
+            self._superstep_carry(), self._Xs, self._ys,
+            self._superstep_indices(epoch0, k), modes,
+            self._superstep_sched(epoch0, k),
             name=f"trainer.superstep{k}", registry=registry,
         )
 
@@ -1206,6 +1322,11 @@ class GossipTrainer:
             "mix_rounds": mix_rounds,
             "deviation": float(self.engine.max_deviation(params)),
         }
+        if self._adaptive_cfg is not None:
+            # Feed the controller: next epoch's round budget is scaled
+            # by this epoch's post-mix residual (float -> float32 is
+            # exact, so the mirror matches the superstep's carry).
+            self._adaptive_res = np.float32(payload["deviation"])
         if self._obs_registry is not None:
             # Per-chunk consensus metrics (the arXiv 2105.09080 headline
             # traces): residual after mixing, rounds spent getting there.
@@ -1289,21 +1410,139 @@ class GossipTrainer:
             return 2
         return 1
 
-    def _superstep_supported(self) -> bool:
-        """Whether this config's gossip compiles into the superstep.
-        ``mix_times_schedule`` / ``topology_schedule`` / compression /
-        async gossip / robust mixing run host logic between epochs
-        (per-epoch python schedules, CHOCO's and the async carry's
-        cross-epoch bookkeeping, the robust redirected-mass flush) —
-        inherently chunk-hostile, so they keep the per-epoch path
-        rather than silently changing semantics."""
-        return (
-            self.mix_times_schedule is None
-            and self.topology_schedule is None
-            and self._choco is None
-            and self._async_sim is None
-            and self._robust_cfg is None
+    def _adaptive_times_traced(self, t: jax.Array, res: jax.Array):
+        """In-program residual-adaptive round budget — the traced twin
+        of :meth:`_adaptive_times_host` (same float32 op order, so the
+        two paths agree bit-for-bit).  Identity when the controller is
+        off."""
+        c = self._adaptive_cfg
+        if c is None:
+            return t
+        mult = jnp.float32(1.0) + jnp.float32(c["gain"]) * (
+            res / jnp.float32(c["target"]) - jnp.float32(1.0)
         )
+        te = jnp.floor(t.astype(jnp.float32) * mult + jnp.float32(0.5))
+        return jnp.clip(
+            te, jnp.float32(c["min_times"]), jnp.float32(c["max_times"])
+        ).astype(jnp.int32)
+
+    def _superstep_carry(self):
+        """The superstep's cross-epoch gossip carry ``{"mix": ...,
+        "res": f32}`` seeded from the trainer's host mirrors: the CHOCO
+        estimate/key/EF trees, the async double-buffer, or ``()`` for
+        carry-free configs, plus the adaptive controller's last
+        residual.  Fresh CHOCO/async carries are built exactly as the
+        per-epoch path's lazy init would (zeros estimates and
+        ``key(seed+2)``; an all-publish-at-round-0 buffer — zeros, NOT
+        an aliased copy of params, so donating the carry never aliases
+        the donated state)."""
+        params = self._state[0]
+        if self._choco is not None:
+            if self._choco_xhat is None:
+                xhat = jax.tree.map(jnp.zeros_like, params)
+                key = jax.random.key(self.seed + 2)
+                ef = (
+                    jax.tree.map(jnp.zeros_like, params)
+                    if self._choco.error_feedback else None
+                )
+            else:
+                xhat, key, ef = (
+                    self._choco_xhat, self._choco_key, self._choco_ef
+                )
+            mix = {"xhat": xhat, "key": key, "ef": ef}
+        elif self._async_sim is not None:
+            mix = self._async_state
+            if mix is None:
+                # Round 0 publishes every agent (0 is a multiple of all
+                # periods) before any read, so the zeros never survive
+                # a mix — bit-identical to init_async_state's copy.
+                mix = AsyncGossipState(
+                    pub=jax.tree.map(jnp.zeros_like, params),
+                    age=jnp.zeros((len(self.node_names),), jnp.int32),
+                    rnd=jnp.int32(0),
+                )
+        else:
+            mix = ()
+        res0 = (
+            self._adaptive_res if self._adaptive_res is not None
+            else np.float32(0.0)
+        )
+        return {"mix": mix, "res": jnp.float32(res0)}
+
+    def _superstep_sched(self, epoch0: int, k: int):
+        """Per-epoch schedule data for one superstep — the host-side
+        schedules resolved for epochs ``[epoch0, epoch0+k)`` and stacked
+        into traced arrays the scan body indexes: ``times`` (k,) always;
+        ``W`` (k, n, n) and (chebyshev) ``omegas`` (k, Tmax) under a
+        ``topology_schedule``; ``omegas`` alone for chebyshev with a
+        ``mix_times_schedule``; ``tau`` (k,) for async gossip.  Epochs
+        the mode vector routes away from the mixing branch (mode 0)
+        get dead rows and skip schedule validation — exactly the epochs
+        the per-epoch path never resolves a schedule for."""
+        n = len(self.node_names)
+        modes = [self._epoch_mode(epoch0 + j) for j in range(k)]
+        times = []
+        for j in range(k):
+            t = self.mix_times
+            if self.mix_times_schedule is not None and modes[j] != 0:
+                t = int(self.mix_times_schedule(epoch0 + j))
+                if t < 1:
+                    raise ValueError(
+                        f"mix_times_schedule({epoch0 + j}) returned "
+                        f"{t}; must be >= 1 (0 would silently skip "
+                        "gossip while reporting a mixed epoch)"
+                    )
+            times.append(t)
+        sched = {"times": jnp.asarray(times, dtype=jnp.int32)}
+        tmax = max(times)
+        if self.topology_schedule is not None:
+            Ws, omegas = [], []
+            for j in range(k):
+                if modes[j] != 1:
+                    Ws.append(np.eye(n, dtype=np.float32))
+                    omegas.append(np.zeros(tmax, np.float32))
+                    continue
+                W_e = resolve_mixing_matrix(
+                    self.topology_schedule(epoch0 + j), self.node_names
+                )
+                Ws.append(np.asarray(W_e, dtype=np.float32))
+                if self.chebyshev:
+                    g_e = mixing_gamma(W_e)
+                    if not (0.0 <= g_e < 1.0):
+                        raise ValueError(
+                            f"topology_schedule({epoch0 + j}) produced a "
+                            f"graph with gamma={g_e}; Chebyshev "
+                            "acceleration needs a connected graph with "
+                            "gamma < 1"
+                        )
+                    omegas.append(
+                        np.asarray(
+                            chebyshev_omegas(g_e, tmax), dtype=np.float32
+                        )
+                    )
+            sched["W"] = jnp.asarray(np.stack(Ws))
+            if self.chebyshev:
+                sched["omegas"] = jnp.asarray(np.stack(omegas))
+        elif self.chebyshev and self.mix_times_schedule is not None:
+            # Static graph, scheduled round count: one omega row serves
+            # every epoch (the prefix property — omegas depend only on
+            # gamma, and the masked recurrence freezes after t rounds).
+            om = np.asarray(
+                chebyshev_omegas(self.engine.gamma, tmax),
+                dtype=np.float32,
+            )
+            sched["omegas"] = jnp.asarray(
+                np.broadcast_to(om, (k, tmax)).copy()
+            )
+        if self._async_sim is not None:
+            sched["tau"] = jnp.asarray(
+                [
+                    self._async_tau(epoch0 + j) if modes[j] else 0
+                    for j in range(k)
+                ],
+                dtype=jnp.int32,
+            )
+        return sched
 
     def _make_superstep_fn(self, k: int):
         """The raw (unjitted) K-epoch superstep program.
@@ -1311,73 +1550,222 @@ class GossipTrainer:
         An outer ``lax.scan`` over ``k`` epochs; each iteration runs the
         SAME epoch body the per-epoch path jits (``self._epoch_fn`` — the
         per-step scan of the vmapped train step) followed by this
-        config's gossip program body (``parallel/consensus.py``
-        ``*_program`` — the same computations the top-level engine entry
-        points jit), selected per epoch by the traced ``modes`` vector so
-        ``epoch_cons_num`` gating and the Gossip-PGA cadence keep their
-        per-epoch semantics inside one compiled program.  The per-epoch
+        config's gossip program body (the traced-knob ``*_program``
+        bodies of ``parallel/consensus.py`` / ``compression.py`` /
+        ``robust.py`` — the same computations the top-level engine entry
+        points jit, with round counts / matrices / omega rows / tau as
+        per-epoch DATA from the ``sched`` operand), selected per epoch
+        by the traced ``modes`` vector so ``epoch_cons_num`` gating and
+        the Gossip-PGA cadence keep their per-epoch semantics inside one
+        compiled program.  Cross-epoch gossip state (CHOCO estimates,
+        the async double-buffer) and the previous epoch's consensus
+        residual (the adaptive controller's input) thread through the
+        scan as the ``gcarry`` operand.  The per-epoch
         loss/acc/grad-norm traces stack to ``(k, steps, n)`` in the scan
         ys (the metrics carry, ``obs/carry.py``), the per-epoch gossip
-        round counts to ``(k,)``, and the post-mix consensus residual of
-        the FINAL state is computed in-program — so one dispatch plus one
-        flush covers everything K calls of ``train_epoch`` would read.
+        round counts to ``(k,)``, the robust redirected mass to ``(k,)``,
+        and the post-mix consensus residual is computed in-program every
+        epoch (branch-uniformly, after the switch) — so one dispatch
+        plus one flush covers everything K calls of ``train_epoch``
+        would read.
         """
         engine = self.engine
-        mix_times = self.mix_times
-        if self.chebyshev:
-            mix_body = engine.chebyshev_program(mix_times)
+        adapt = self._adaptive_times_traced
+        zero_mass = lambda: jnp.float32(0.0)
 
-            def mix_branch(p):
-                return mix_body(p), jnp.int32(mix_times)
-        elif self.mix_eps is not None:
-            until = engine.mix_until_program(
-                eps=self.mix_eps, min_times=mix_times
+        # -- branch 1: this config's mixing program, knobs from sched --- #
+        if self._async_sim is not None:
+            periods = self._async_sim["periods"]
+            if self._robust_cfg is not None:
+                prog = engine.robust_async_times_program(
+                    self._robust_cfg, periods=periods
+                )
+
+                def mix_branch(op):
+                    p, mix, sch, res = op
+                    t = adapt(sch["times"], res)
+                    p, mix, mass = prog(p, mix, t, sch["tau"])
+                    return p, mix, t, mass
+            else:
+                prog = engine.async_gossip_times_program(periods=periods)
+
+                def mix_branch(op):
+                    p, mix, sch, res = op
+                    t = adapt(sch["times"], res)
+                    p, mix = prog(p, mix, t, sch["tau"])
+                    return p, mix, t, zero_mass()
+        elif self._robust_cfg is not None:
+            prog = engine.robust_mix_times_program(self._robust_cfg)
+
+            def mix_branch(op):
+                p, mix, sch, res = op
+                t = adapt(sch["times"], res)
+                p, mass = prog(p, t)
+                return p, mix, t, mass
+        elif self.topology_schedule is not None:
+            if self.chebyshev:
+                prog = engine.chebyshev_masked_with_program()
+
+                def mix_branch(op):
+                    p, mix, sch, res = op
+                    t = sch["times"]  # adaptive excluded with chebyshev
+                    p = prog(p, sch["W"], sch["omegas"], t)
+                    return p, mix, t, zero_mass()
+            elif self.mix_eps is not None:
+                prog = engine.mix_until_with_times_program(eps=self.mix_eps)
+
+                def mix_branch(op):
+                    p, mix, sch, res = op
+                    mn = adapt(sch["times"], res)
+                    p, t, _res = prog(p, sch["W"], mn)
+                    return p, mix, t, zero_mass()
+            else:
+                prog = engine.mix_with_times_program()
+
+                def mix_branch(op):
+                    p, mix, sch, res = op
+                    t = adapt(sch["times"], res)
+                    p = prog(p, sch["W"], t)
+                    return p, mix, t, zero_mass()
+        elif self._choco is not None:
+            from distributed_learning_tpu.parallel.compression import (
+                ChocoState,
             )
 
-            def mix_branch(p):
-                p, t, _res = until(p)
-                return p, t
-        else:
-            mix_body = engine.mix_program(mix_times)
+            layout = None
+            if self._choco.fused:
+                # The fused layout is a static program property; derive
+                # it from the concrete stacked params ONCE at build time
+                # (exactly what ChocoGossipEngine.run does per call).
+                if self._state is None:
+                    self.initialize_nodes()
+                layout = ops.fused_layout(self._state[0])
+            prog = self._choco.superstep_program(layout)
 
-            def mix_branch(p):
-                return mix_body(p), jnp.int32(mix_times)
+            def mix_branch(op):
+                p, mix, sch, res = op
+                t = adapt(sch["times"], res)
+                cs = prog(
+                    ChocoState(
+                        x=p, xhat=mix["xhat"], key=mix["key"],
+                        ef=mix["ef"],
+                    ),
+                    t,
+                )
+                return (
+                    cs.x,
+                    {"xhat": cs.xhat, "key": cs.key, "ef": cs.ef},
+                    t,
+                    zero_mass(),
+                )
+        elif self.chebyshev:
+            if self.mix_times_schedule is not None:
+                prog = engine.chebyshev_masked_program()
+
+                def mix_branch(op):
+                    p, mix, sch, res = op
+                    t = sch["times"]
+                    return prog(p, sch["omegas"], t), mix, t, zero_mass()
+            else:
+                body = engine.chebyshev_program(self.mix_times)
+
+                def mix_branch(op):
+                    p, mix, sch, res = op
+                    return body(p), mix, sch["times"], zero_mass()
+        elif self.mix_eps is not None:
+            prog = engine.mix_until_times_program(eps=self.mix_eps)
+
+            def mix_branch(op):
+                p, mix, sch, res = op
+                mn = adapt(sch["times"], res)
+                p, t, _res = prog(p, mn)
+                return p, mix, t, zero_mass()
+        else:
+            prog = engine.mix_times_program()
+
+            def mix_branch(op):
+                p, mix, sch, res = op
+                t = adapt(sch["times"], res)
+                return prog(p, t), mix, t, zero_mass()
+
+        # -- branches 0 / 2: skip, and the Gossip-PGA all-reduce -------- #
+        def skip_branch(op):
+            p, mix, sch, res = op
+            return p, mix, jnp.int32(0), zero_mass()
 
         gavg_body = engine.global_average_program()
-        branches = [
-            lambda p: (p, jnp.int32(0)),            # mode 0: isolated epoch
-            mix_branch,                              # mode 1: config's mix
-            lambda p: (gavg_body(p), jnp.int32(1)),  # mode 2: Gossip-PGA
-        ]
+        if self._choco is not None:
+            seed = self.seed
+            ef_on = self._choco.error_feedback
+
+            def gavg_branch(op):
+                p, mix, sch, res = op
+                p = gavg_body(p)
+                # Host parity (_gossip's mode 2): the estimates tracked
+                # the pre-all-reduce iterates — reset to the state a
+                # fresh lazy init would build next epoch.
+                mix = {
+                    "xhat": jax.tree.map(jnp.zeros_like, p),
+                    "key": jax.random.key(seed + 2),
+                    "ef": (
+                        jax.tree.map(jnp.zeros_like, p)
+                        if ef_on else None
+                    ),
+                }
+                return p, mix, jnp.int32(1), zero_mass()
+        else:
+
+            def gavg_branch(op):
+                p, mix, sch, res = op
+                return gavg_body(p), mix, jnp.int32(1), zero_mass()
+
+        branches = [skip_branch, mix_branch, gavg_branch]
         max_dev = engine.max_deviation_program()
         epoch_fn = self._epoch_fn
 
-        def superstep_fn(state, Xs, ys, idx, modes):
+        def superstep_fn(state, gcarry, Xs, ys, idx, modes, sched):
             def body(carry, inp):
-                idx_e, mode_e = inp
-                carry, losses, accs, gnorms = epoch_fn(carry, Xs, ys, idx_e)
-                params, bs, opt, rng = carry
-                params, rounds = jax.lax.switch(mode_e, branches, params)
-                return (params, bs, opt, rng), (losses, accs, gnorms, rounds)
+                state, gc = carry
+                idx_e, mode_e, sched_e = inp
+                state, losses, accs, gnorms = epoch_fn(
+                    state, Xs, ys, idx_e
+                )
+                params, bs, opt, rng = state
+                params, mix, rounds, mass = jax.lax.switch(
+                    mode_e, branches,
+                    (params, gc["mix"], sched_e, gc["res"]),
+                )
+                # Post-mix residual, branch-uniform (outside the
+                # switch): the per-epoch consensus trace AND the
+                # adaptive controller's next-epoch input.
+                res = max_dev(params)
+                return (
+                    ((params, bs, opt, rng), {"mix": mix, "res": res}),
+                    (losses, accs, gnorms, rounds, mass, res),
+                )
 
-            state, (losses, accs, gnorms, rounds) = jax.lax.scan(
-                body, state, (idx, modes)
+            (state, gcarry), ys_out = jax.lax.scan(
+                body, (state, gcarry), (idx, modes, sched)
             )
-            dev = max_dev(state[0])
-            return state, losses, accs, gnorms, rounds, dev
+            losses, accs, gnorms, rounds, masses, devs = ys_out
+            return (
+                state, gcarry, losses, accs, gnorms, rounds, masses,
+                devs,
+            )
 
         return superstep_fn
 
     def _build_superstep(self, k: int):
         """Jitted superstep for chunk size ``k`` (cached per k; the index
         array's leading axis is part of the program shape).  The carried
-        state is donated exactly like ``_jit_epoch``'s — across the whole
-        superstep the stacked params/opt buffers are updated in place."""
+        state AND the gossip carry are donated exactly like
+        ``_jit_epoch``'s state — across the whole superstep the stacked
+        params/opt/estimate buffers are updated in place."""
         fn = self._superstep_cache.get(k)
         if fn is None:
             fn = jax.jit(
                 self._make_superstep_fn(k),
-                donate_argnums=(0,) if self._donate_active else (),
+                donate_argnums=(0, 1) if self._donate_active else (),
             )
             self._superstep_cache[k] = fn
         return fn
@@ -1388,33 +1776,21 @@ class GossipTrainer:
 
         The trajectory is bit-identical to ``k`` calls of
         :meth:`train_epoch` — same shuffle streams, same step/gossip
-        programs, same PRNG threading — for the compiled gossip paths
-        (plain ``mix_times``, ``mix_eps``, ``chebyshev``,
-        ``global_avg_every``).  Two reporting differences: test-set
-        evaluation and the consensus residual are produced once per
-        superstep (at the boundary, on the final state) rather than per
-        epoch — intermediate payloads carry ``test_acc=None`` /
-        ``deviation=None``.  Configs with per-epoch host logic
-        (``mix_times_schedule``, ``topology_schedule``, ``compression``)
-        fall back to the per-epoch loop with a one-time warning.
+        programs, same PRNG threading — for EVERY gossip config: plain
+        ``mix_times``, ``mix_eps``, ``chebyshev``, ``global_avg_every``,
+        ``mix_times_schedule``, ``topology_schedule``, ``compression``
+        (CHOCO), ``async_gossip``, ``robust_mixing``, and the
+        ``adaptive_comm`` controller (per-epoch schedules ride as traced
+        data; cross-epoch gossip state threads through the scan carry).
+        One reporting difference: test-set evaluation is produced once
+        per superstep (at the boundary, on the final state) rather than
+        per epoch — intermediate payloads carry ``test_acc=None``.  The
+        consensus residual is computed in-program every epoch, so every
+        payload carries its ``deviation``.
         """
         k = int(k)
         if k < 1:
             raise ValueError(f"train_epochs needs k >= 1, got {k}")
-        if not self._superstep_supported():
-            if k > 1 and not self._superstep_warned:
-                self._superstep_warned = True
-                warnings.warn(
-                    "superstep: mix_times_schedule/topology_schedule/"
-                    "compression/async_gossip/robust_mixing configs run "
-                    "per-epoch host "
-                    "logic between epochs and cannot be fused into one "
-                    "dispatch; "
-                    "falling back to K=1 (the per-epoch path, unchanged "
-                    "semantics)",
-                    stacklevel=2,
-                )
-            return [self.train_epoch() for _ in range(k)]
         if k == 1:
             # One epoch needs no outer scan; the per-epoch program is
             # already compiled (and is the oracle the superstep is
@@ -1431,18 +1807,24 @@ class GossipTrainer:
         idx = self._superstep_indices(epoch0, k)  # ONE host->device copy
         modes_host = [self._epoch_mode(epoch0 + j) for j in range(k)]
         modes = jnp.asarray(modes_host, dtype=jnp.int32)
+        sched = self._superstep_sched(epoch0, k)
+        gcarry = self._superstep_carry()
         fn = self._build_superstep(k)
         timer = self._cost_timer
         sampled = timer.tick() if timer is not None else False
         t0 = time.perf_counter() if sampled else 0.0
         try:
             with self._span("trainer.chunk"):
-                (self._state, losses, accs, gnorms, rounds, dev) = fn(
-                    self._state, self._Xs, self._ys, idx, modes
+                (
+                    self._state, gcarry, losses, accs, gnorms, rounds,
+                    masses, devs,
+                ) = fn(
+                    self._state, gcarry, self._Xs, self._ys, idx, modes,
+                    sched,
                 )
                 self._count_dispatch()
                 # The superstep's single host boundary: traces, per-epoch
-                # round counts, and the final residual all materialize
+                # round counts / residuals / robust mass all materialize
                 # here (flush_chunk collapses the (k, steps, n) traces to
                 # one k*steps-step chunk for the registry).
                 arrs = flush_chunk(
@@ -1455,7 +1837,11 @@ class GossipTrainer:
                 accs = arrs["acc"]
                 gnorms = arrs["grad_norm"]
                 rounds_host = np.asarray(rounds)  # (k,)
-                deviation = float(np.asarray(dev))
+                devs_host = np.asarray(devs)  # (k,)
+                masses_host = (
+                    np.asarray(masses)
+                    if self._robust_cfg is not None else None
+                )
                 if sampled:
                     from distributed_learning_tpu.obs.cost import (
                         get_profile,
@@ -1474,10 +1860,27 @@ class GossipTrainer:
                     )
         except BaseException:
             # Same donation discipline as _train_epoch: the donated input
-            # buffers may already be gone; drop the dangling reference.
+            # buffers may already be gone; drop the dangling references
+            # (the gossip carry is donated too — its host mirrors may
+            # hold deleted arrays).
             if self._donate_active:
                 self._state = None
+                self._choco_xhat = None
+                self._choco_ef = None
+                self._async_state = None
             raise
+
+        # Sync the host mirrors from the returned carry, so per-epoch
+        # calls (or a checkpoint) interleaved with supersteps continue
+        # the same trajectory.
+        if self._choco is not None:
+            self._choco_xhat = gcarry["mix"]["xhat"]
+            self._choco_key = gcarry["mix"]["key"]
+            self._choco_ef = gcarry["mix"]["ef"]
+        elif self._async_sim is not None:
+            self._async_state = gcarry["mix"]
+        if self._adaptive_cfg is not None:
+            self._adaptive_res = np.float32(devs_host[-1])
 
         steps = losses.shape[1]
         params, bs, _opt, _rng = self._state
@@ -1513,8 +1916,29 @@ class GossipTrainer:
                 "grad_norm": gnorms[j].mean(axis=0),
                 "test_acc": test_accs if final else None,
                 "mix_rounds": int(rounds_host[j]),
-                "deviation": deviation if final else None,
+                "deviation": float(devs_host[j]),
             })
+            if self._obs_registry is not None:
+                # Per-epoch consensus traces, as on the per-epoch path
+                # (the adaptive controller's readout; arXiv 2105.09080
+                # headline residual series).
+                self._obs_registry.observe(
+                    "consensus.residual", float(devs_host[j]),
+                    step=self._global_step,
+                )
+                if modes_host[j]:
+                    self._obs_registry.inc(
+                        "consensus.rounds_run", int(rounds_host[j])
+                    )
+                    if masses_host is not None:
+                        mass_j = float(masses_host[j])
+                        self._obs_registry.inc(
+                            "consensus.robust.clipped_mass", mass_j
+                        )
+                        self._obs_registry.observe(
+                            "consensus.robust.mass", mass_j,
+                            step=self._global_step,
+                        )
         if test_accs is not None:
             for a, name in enumerate(self.node_names):
                 node = self.network[name]
@@ -1522,12 +1946,6 @@ class GossipTrainer:
                 node.stats.test_epochs.append(self._global_step)
 
         if self._obs_registry is not None:
-            self._obs_registry.observe(
-                "consensus.residual", deviation, step=self._global_step
-            )
-            total_rounds = int(rounds_host.sum())
-            if total_rounds:
-                self._obs_registry.inc("consensus.rounds_run", total_rounds)
             if test_accs is not None:
                 self._obs_registry.observe(
                     "eval.test_acc", float(np.mean(test_accs)),
@@ -1624,17 +2042,29 @@ class GossipTrainer:
         whether estimates exist yet (no gossip round has run before the
         first consensus epoch)."""
         params = self._state[0]
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
         if self._choco_xhat is not None:
-            return {
+            tree = {
                 "present": 1,
                 "xhat": self._choco_xhat,
                 "key": jax.random.key_data(self._choco_key),
             }
-        return {
+            if self._choco.error_feedback:
+                tree["ef"] = (
+                    self._choco_ef if self._choco_ef is not None
+                    else zeros()
+                )
+            return tree
+        tree = {
             "present": 0,
-            "xhat": jax.tree.map(jnp.zeros_like, params),
+            "xhat": zeros(),
             "key": jax.random.key_data(jax.random.key(self.seed + 2)),
         }
+        if self._choco.error_feedback:
+            # EF banks restart at zero with the estimates; the subtree
+            # shape stays config-determined (error_feedback on/off).
+            tree["ef"] = zeros()
+        return tree
 
     def restore_checkpoint(self, path: str) -> None:
         from distributed_learning_tpu.training.checkpoint import restore_checkpoint
@@ -1702,10 +2132,13 @@ class GossipTrainer:
             jax.random.wrap_key_data(restored["rng"]),
         )
         self._choco_xhat = None
+        self._choco_ef = None
         choco_tree = restored.get("choco")
         if choco_tree is not None and int(choco_tree["present"]):
             self._choco_xhat = choco_tree["xhat"]
             self._choco_key = jax.random.wrap_key_data(choco_tree["key"])
+            if "ef" in choco_tree:
+                self._choco_ef = choco_tree["ef"]
         self._epochs_done = int(restored["epochs_done"])
         self._global_step = int(restored["global_step"])
 

@@ -122,6 +122,50 @@ def test_bench_superstep_smoke(capsys):
     assert rec["dispatches_per_epoch_by_k"]["1"] >= 3
 
 
+def test_bench_superstep_lifted_configs_smoke(capsys):
+    """ISSUE 20 rot guard: the previously chunk-hostile configs now
+    ride the superstep and K=16 beats its own per-epoch path (headline
+    runs show 2.8-4x on the CPU harness for all four lifted configs;
+    the gate is the 1.3x acceptance floor so shared-CI timing noise
+    cannot flake tier-1).  Smoke runs the two headline configs — CHOCO
+    and the round schedule; async/robust ride the full __main__ sweep
+    and the measurement session."""
+    from benchmarks import bench_superstep
+
+    smoke = ("choco", "sched")
+    out = bench_superstep.run_lifted(epochs=16, configs=smoke)
+    assert set(out) == set(smoke)
+    for name, res in out.items():
+        assert res["speedup"] > 1.3, (name, res)
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    recs = {r["metric"]: r for r in lines}
+    for name in out:
+        rec = recs[f"trainer_superstep_{name}_epochs_per_sec"]
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+        assert rec["value"] > 0
+
+
+def test_bench_superstep_adaptive_rounds_saved_smoke(capsys):
+    """Residual-adaptive communication rot guard: at a matched final
+    consensus residual (the static run's bar), the in-program adaptive
+    controller communicates measurably fewer gossip rounds.  The
+    trainer is bit-deterministic on CPU, so the rounds/residual numbers
+    are exact — no timing gate."""
+    from benchmarks import bench_superstep
+
+    out = bench_superstep.run_adaptive(epochs=16)
+    assert out["matched"], out
+    assert out["rounds_saved"] > 0, out
+    assert out["adaptive_rounds"] < out["static_rounds"]
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    (rec,) = [r for r in lines
+              if r["metric"] == "trainer_superstep_adaptive_rounds_saved"]
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["matched_residual"] is True
+
+
 def test_bench_cifar_mlp_smoke(capsys):
     from benchmarks import bench_cifar_mlp
 

@@ -78,6 +78,22 @@ def test_superstep_local_sgd_example():
     assert speed > 0.5, out  # timing under CI load: identity is the claim
     acc = _float_after(r"final mean train acc (\d+\.\d+)", out)
     assert 0.3 <= acc <= 1.0, out
+    # Lifted config (ISSUE 20): CHOCO + round schedule fuse into the
+    # same superstep, still bit-identical.
+    choco_diff = _float_after(
+        r"choco\+schedule max \|param diff\| ([\d.e+-]+)", out)
+    assert choco_diff == 0.0, out
+    # Residual-adaptive communication: the controller must shed a
+    # nonzero number of gossip rounds AND end inside its residual bar
+    # (both counts and residuals are deterministic on the CPU harness).
+    m = re.search(r"adaptive rounds saved (\d+) of (\d+)", out)
+    assert m, out
+    saved, total = int(m.group(1)), int(m.group(2))
+    assert 0 < saved < total, out
+    res = _float_after(r"adaptive residual ([\d.e+-]+) vs target", out)
+    tgt = _float_after(r"vs target ([\d.e+-]+)", out)
+    assert res <= tgt, out
+    assert "(matched)" in out, out
 
 
 def test_gradient_tracking_example():

@@ -1084,16 +1084,19 @@ def _count_weighted_gossip_gemms(jaxpr, n: int, *, mult: int = 1) -> int:
     return total
 
 
-def test_superstep_has_exactly_k_times_mixtimes_gossip_gemms():
-    """The superstep fusion proof (dense route): a K=3, mix_times=2
-    superstep program executes exactly K x 2 gossip GEMMs — the epoch
-    scan's body carries mix_times dot_generals against the (n, n)
-    mixing matrix and the scan runs K times.  Fewer would mean fusion
-    HOISTED gossip out of the epoch loop (mixing once for K epochs);
-    more would mean it duplicated rounds; zero outside the scan means
-    nothing leaked to a per-superstep position.  The per-leaf oracle
-    (fused=False) pays leaf_count GEMMs per round — fused engagement
-    inside the superstep is part of the pin."""
+def test_superstep_has_exactly_k_gossip_gemm_bodies():
+    """The superstep fusion proof (dense route): with the round count
+    now a TRACED operand (mix_times_program's fori_loop — the schedule
+    lift), a K=3 superstep program carries exactly K x 1 gossip GEMMs —
+    the epoch scan's mix branch traces ONE dot_general against the
+    (n, n) mixing matrix inside the round loop body (trip count is
+    data, not unroll) and the scan runs K times.  Zero would mean
+    fusion HOISTED gossip out of the epoch loop (mixing once for K
+    epochs); more would mean the round body was duplicated (e.g. a
+    branch re-specializing per round count); zero outside the scan
+    means nothing leaked to a per-superstep position.  The per-leaf
+    oracle (fused=False) pays leaf_count GEMMs per round body — fused
+    engagement inside the superstep is part of the pin."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1130,12 +1133,15 @@ def test_superstep_has_exactly_k_times_mixtimes_gossip_gemms():
             [tr._epoch_mode(j) for j in range(k)], dtype=jnp.int32
         )
         fn = tr._make_superstep_fn(k)
-        jx = jax.make_jaxpr(fn)(tr.state, tr._Xs, tr._ys, idx, modes)
+        jx = jax.make_jaxpr(fn)(
+            tr.state, tr._superstep_carry(), tr._Xs, tr._ys, idx, modes,
+            tr._superstep_sched(0, k),
+        )
         leaves = len(jax.tree.leaves(tr.state[0]))
         return jx, leaves
 
     fused_jx, leaves = trace(fused=True)
-    assert _count_weighted_gossip_gemms(fused_jx.jaxpr, n) == k * mix_times
+    assert _count_weighted_gossip_gemms(fused_jx.jaxpr, n) == k
     # Top-level (outside every scan): nothing hoisted.
     top = sum(
         1 for eqn in fused_jx.jaxpr.eqns
@@ -1145,6 +1151,4 @@ def test_superstep_has_exactly_k_times_mixtimes_gossip_gemms():
     assert top == 0
     perleaf_jx, leaves = trace(fused=False)
     assert leaves > 1
-    assert _count_weighted_gossip_gemms(perleaf_jx.jaxpr, n) == (
-        k * mix_times * leaves
-    )
+    assert _count_weighted_gossip_gemms(perleaf_jx.jaxpr, n) == k * leaves

@@ -589,17 +589,93 @@ def test_uniform_switch_passes_on_a_real_trace():
     assert jv.entry_findings("seeded_switch", an) == []
 
 
+def _superstep_mode_switch_jaxpr(divergent):
+    """A real trace shaped like the trainer superstep's epoch scan body:
+    ``lax.scan`` over per-epoch modes, ``lax.switch(mode, (skip, mix,
+    global_avg))`` on the carry, then the branch-uniform residual
+    readout AFTER the switch (the train_epochs contract — the per-epoch
+    deviation/adaptive-feedback collective must be outside every
+    branch).  ``divergent=True`` seeds the lift's target defect: the
+    residual psum hoisted INTO the mix branch only, with a per-device
+    (axis-varying) mode vector — half the mesh enters the collective,
+    half never arrives."""
+    import jax
+    import jax.numpy as jnp
+
+    def skip(v):
+        return v
+
+    def mix(v):
+        out = v * jnp.float32(0.5)
+        if divergent:
+            out = out + jnp.float32(0.0) * jax.lax.psum(v, "i")
+        return out
+
+    def gavg(v):
+        return v - jnp.float32(1.0)
+
+    def epoch(carry, mode):
+        carry = jax.lax.switch(mode, (skip, mix, gavg), carry)
+        res = jax.lax.pmax(jnp.max(jnp.abs(carry)), "i")
+        return carry, res
+
+    def step(modes, v):
+        return jax.lax.scan(epoch, v, modes)
+
+    n = jax.local_device_count()
+    modes = jnp.stack([jnp.arange(3, dtype=jnp.int32) % 3] * n) + (
+        jnp.arange(n, dtype=jnp.int32)[:, None] % 2  # axis-varying pred
+    )
+    modes = modes % 3
+    vals = jnp.ones((n, 4), dtype=jnp.float32)
+    return jax.make_jaxpr(jax.pmap(step, axis_name="i"))(modes, vals)
+
+
+def test_seeded_collective_in_one_superstep_mode_branch_is_caught():
+    """The ISSUE 20 mutation: a collective present in only ONE
+    ``lax.switch`` mode branch of the superstep-shaped scan body is a
+    branch-divergent-collective finding naming the branch."""
+    an = jv.analyze_jaxpr(_superstep_mode_switch_jaxpr(divergent=True))
+    labs = [p for p in an.branches if p.endswith("cond[0]")]
+    assert labs, sorted(an.branches)
+    b = an.branches[labs[0]]
+    assert not b.uniform and "i" in b.axis_scope
+    assert b.sequences[0] == [] and b.sequences[2] == []
+    assert b.sequences[1] == ["psum|i"]
+    fs = jv.entry_findings("seeded_superstep", an)
+    rules = [f.rule for f in fs]
+    assert "branch-divergent-collective" in rules, rules
+    msg = [f for f in fs if f.rule == "branch-divergent-collective"][0].message
+    assert "branch 1 runs ['psum|i']" in msg
+
+
+def test_branch_uniform_superstep_mode_switch_passes():
+    """The shipped shape: collective-free mode branches, residual
+    psum/pmax AFTER the switch — no branch findings, and the scan body
+    pins the readout collective in its ordered sequence."""
+    an = jv.analyze_jaxpr(_superstep_mode_switch_jaxpr(divergent=False))
+    labs = [p for p in an.branches if p.endswith("cond[0]")]
+    assert labs and an.branches[labs[0]].uniform
+    fs = jv.entry_findings("seeded_superstep", an)
+    assert [f.rule for f in fs] == [], [str(f) for f in fs]
+    scans = {p: l for p, l in an.loops.items() if l.kind == "scan"}
+    assert any("pmax|i" in l.sequence for l in scans.values()), scans
+
+
 # --------------------------------------------------------------------- #
 # The live registry                                                     #
 # --------------------------------------------------------------------- #
 def test_dense_superstep_reverifies_against_its_pin():
-    """The always-live dataflow entry: trace, compare against the
-    shipped dataflow: pin, and hold the 9/9 donation aliasing."""
-    res, fs, summary = jv.verify(names=["gossip_superstep_dense"])
-    st = res["gossip_superstep_dense"]
-    assert st["status"] == "ok", st
-    don = st["observed"]["donation"]
-    assert don["aliased"] == don["leaves"] > 0
+    """The always-live dataflow entries (plain + schedule-bearing):
+    trace, compare against the shipped dataflow: pins, and hold the
+    full state+carry donation aliasing under donate_argnums=(0, 1)."""
+    names = ["gossip_superstep_dense", "gossip_superstep_sched_dense"]
+    res, fs, summary = jv.verify(names=names)
+    for name in names:
+        st = res[name]
+        assert st["status"] == "ok", (name, st)
+        don = st["observed"]["donation"]
+        assert don["aliased"] == don["leaves"] > 0, (name, don)
     hard = [f for f in fs if f.rule in (
         "branch-divergent-collective", "vma-discipline", "donation-alias"
     )]

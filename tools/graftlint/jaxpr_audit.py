@@ -390,15 +390,19 @@ def _consensus_mix_until_cost() -> dict:
     return _compiled_cost(jax.jit(fn).lower(x).compile())
 
 
-@functools.lru_cache(maxsize=2)
-def _superstep_fixture(sharded: bool):
+@functools.lru_cache(maxsize=4)
+def _superstep_fixture(sharded: bool, scheduled: bool = False):
     """(trainer, superstep_args, k) for the K-epoch superstep — ONE
     fixture shared by the inventory, cost, dataflow-trace, and
     donation builders (it was previously duplicated per builder).
     ``sharded=True`` is the ring(8) agent-mesh program (needs
     jax.shard_map); ``sharded=False`` is the dense (mesh=None) trainer
     on 3 nodes, traceable on any jax — the dataflow stage's live
-    entry on 0.4.x environments."""
+    entry on 0.4.x environments.  ``scheduled=True`` is the
+    schedule-bearing program: per-epoch ``mix_times_schedule`` +
+    ``topology_schedule`` round/matrix vectors as traced scan data,
+    the Gossip-PGA cadence, and the residual-adaptive controller —
+    the config matrix the superstep lift exists for."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -415,6 +419,17 @@ def _superstep_fixture(sharded: bool):
         )
         for i in range(n)
     }
+    extra = {}
+    if scheduled:
+        extra = dict(
+            mix_times_schedule=lambda e: 1 + (e % 2),
+            topology_schedule=lambda e: (
+                Topology.ring(n) if e % 2 == 0 else Topology.star(n)
+            ),
+            global_avg_every=2,
+            epoch_cons_num=2,
+            adaptive_comm={"target": 0.05, "gain": 1.0, "max_times": 4},
+        )
     tr = GossipTrainer(
         node_names=list(range(n)),
         model="mlp",
@@ -427,50 +442,62 @@ def _superstep_fixture(sharded: bool):
         dropout=False,
         mesh=make_agent_mesh(n) if sharded else None,
         superstep=k,
+        **extra,
     )
     tr.initialize_nodes()
     idx = tr._superstep_indices(0, k)
     modes = jnp.asarray(
         [tr._epoch_mode(j) for j in range(k)], dtype=jnp.int32
     )
-    args = (tr.state, tr._Xs, tr._ys, idx, modes)
+    args = (
+        tr.state, tr._superstep_carry(), tr._Xs, tr._ys, idx, modes,
+        tr._superstep_sched(0, k),
+    )
     return tr, args, k
 
 
-def _superstep_trace(sharded: bool):
+def _superstep_trace(sharded: bool, scheduled: bool = False):
     import jax
 
-    tr, args, k = _superstep_fixture(sharded)
+    tr, args, k = _superstep_fixture(sharded, scheduled)
     return jax.make_jaxpr(tr._make_superstep_fn(k))(*args)
 
 
-@functools.lru_cache(maxsize=2)
-def _superstep_donation(sharded: bool) -> Tuple[str, int]:
-    """(lowered_text, n_state_leaves) of the superstep under
-    donate_argnums=(0,) — the tests/test_trainer.py donation-guard
-    lowering, shared with the dataflow stage's donation-alias lint."""
+@functools.lru_cache(maxsize=4)
+def _superstep_donation(
+    sharded: bool, scheduled: bool = False
+) -> Tuple[str, int]:
+    """(lowered_text, n_carry_leaves) of the superstep under
+    donate_argnums=(0, 1) — the tests/test_trainer.py donation-guard
+    lowering (state AND gossip carry donated), shared with the
+    dataflow stage's donation-alias lint."""
     import jax
 
-    tr, args, k = _superstep_fixture(sharded)
+    tr, args, k = _superstep_fixture(sharded, scheduled)
     fn = tr._make_superstep_fn(k)
-    lowered = jax.jit(fn, donate_argnums=(0,)).lower(*args)
-    return lowered.as_text(), len(jax.tree_util.tree_leaves(tr.state))
+    lowered = jax.jit(fn, donate_argnums=(0, 1)).lower(*args)
+    return lowered.as_text(), len(
+        jax.tree_util.tree_leaves((args[0], args[1]))
+    )
 
 
 @entry("gossip_superstep", kind="jaxpr", requires=("shard_map",))
 def _gossip_superstep() -> Counter:
     """The trainer's K-epoch superstep on a ring(8) agent mesh
     (``GossipTrainer.train_epochs``): K=3 epochs of the per-step scan
-    plus the static-2-round gossip program fused into ONE program.
+    plus the traced-times gossip program fused into ONE program.
 
-    Pin: the epoch scan's mix branch moves one ppermute per matching
-    per dtype bucket per round (ring(8) Metropolis = 2 matchings, one
-    f32 bucket, 2 rounds -> 4 ppermutes), the Gossip-PGA branch is one
-    pmean (psum) per bucket, and the boundary residual readout is one
-    pmean (psum) plus the pmax.  The counts are flat (per scan-body
-    trace): a drift upward means fusing duplicated gossip, a gossip
-    collective OUTSIDE the scan means it was hoisted — either fails
-    tier-1 with the op and axis named.
+    Pin: the epoch scan's mix branch runs the traced-round-count
+    fori_loop — one ppermute per matching per dtype bucket in the loop
+    body (ring(8) Metropolis = 2 matchings, one f32 bucket -> 2
+    ppermutes, round count is data), the Gossip-PGA branch is one
+    pmean (psum) per bucket, and the per-epoch residual readout (the
+    payload deviation AND the adaptive controller's feedback signal)
+    is one pmean (psum) plus the pmax, branch-uniform AFTER the mode
+    switch.  The counts are flat (per scan-body trace): a drift upward
+    means fusing duplicated gossip, a gossip collective OUTSIDE the
+    scan means it was hoisted — either fails tier-1 with the op and
+    axis named.
     """
     return collect_collectives(_gossip_superstep_trace().jaxpr)
 
@@ -522,6 +549,61 @@ def _gossip_superstep_dense_trace():
 @donate_entry("gossip_superstep_dense")
 def _gossip_superstep_dense_donate() -> Tuple[str, int]:
     return _superstep_donation(False)
+
+
+@entry("gossip_superstep_sched", kind="jaxpr", requires=("shard_map",))
+def _gossip_superstep_sched() -> Counter:
+    """The SCHEDULE-BEARING superstep on the ring(8) agent mesh: the
+    same K=3 fused dispatch with ``mix_times_schedule`` +
+    ``topology_schedule`` riding as traced per-epoch scan data (round
+    counts, W matrix rows), the Gossip-PGA cadence routed through the
+    mode switch, and the residual-adaptive controller modulating the
+    next epoch's round budget in-program.
+
+    Pin: the traced-W mixing route replaces the matching ppermutes
+    with the all_gather neighborhood exchange (W rows are data, the
+    matching decomposition is not available), the Gossip-PGA branch
+    stays one pmean (psum) per bucket, and the per-epoch residual
+    readout stays one pmean (psum) + pmax.  This is the entry that
+    keeps the lifted-schedule path honest: a ppermute appearing here
+    means a branch re-specialized on a concrete W (schedule silently
+    constant-folded); collective drift between the switch branches is
+    the branch-divergent-collective lint's business and fails there
+    with the branch index named.
+    """
+    return collect_collectives(_gossip_superstep_sched_trace().jaxpr)
+
+
+@trace_entry("gossip_superstep_sched")
+@functools.lru_cache(maxsize=1)
+def _gossip_superstep_sched_trace():
+    return _superstep_trace(True, True)
+
+
+@donate_entry("gossip_superstep_sched")
+def _gossip_superstep_sched_donate() -> Tuple[str, int]:
+    return _superstep_donation(True, True)
+
+
+@entry("gossip_superstep_sched_dense", kind="jaxpr")
+def _gossip_superstep_sched_dense() -> Counter:
+    """The schedule-bearing superstep on the dense (mesh=None) 3-node
+    trainer: no collectives to pin, but the dataflow stage gets a live
+    trace of the full mode switch (skip / scheduled-mix / global-avg
+    branches) and the adaptive-controller carry on every environment,
+    including jax 0.4.x where the shard_map entries skip."""
+    return collect_collectives(_gossip_superstep_sched_dense_trace().jaxpr)
+
+
+@trace_entry("gossip_superstep_sched_dense")
+@functools.lru_cache(maxsize=1)
+def _gossip_superstep_sched_dense_trace():
+    return _superstep_trace(False, True)
+
+
+@donate_entry("gossip_superstep_sched_dense")
+def _gossip_superstep_sched_dense_donate() -> Tuple[str, int]:
+    return _superstep_donation(False, True)
 
 
 @entry("choco_run_fused", kind="jaxpr", requires=("shard_map",))
